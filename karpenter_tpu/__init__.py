@@ -1,0 +1,25 @@
+"""karpenter-tpu: a TPU-native cluster-autoscaling framework.
+
+A from-scratch re-creation of the capabilities of Karpenter
+(reference: preflightsiren/karpenter — the AWS provider half plus the
+karpenter-core engine it drives), re-designed TPU-first: scheduling and
+consolidation are compiled into dense pod x instance-type x zone tensors and
+solved in batched JAX/XLA/Pallas passes instead of the reference's greedy
+first-fit-decreasing loop (reference designs/bin-packing.md:18-42) and
+sequential consolidation scans (reference designs/consolidation.md).
+
+Layer map (mirrors reference SURVEY.md section 1):
+  api/          data model: requirements algebra, resources, CRD-like objects
+  scheduling/   constraint tensorization, FFD oracle, JAX/Pallas solver
+  ops/          device kernels (annealing sweeps, feasibility)
+  parallel/     device-mesh sharding of large solves (shard_map + collectives)
+  cloud/        CloudProvider plugin boundary + fake cloud backend
+  providers/    instance-type / instance / pricing / subnet / ... providers
+  controllers/  provisioning, deprovisioning, interruption, GC, nodeclass
+  state/        in-memory cluster state (reference: karpenter-core state.Cluster)
+  batcher/      request coalescing (reference pkg/batcher)
+  cache/        TTL + unavailable-offerings caches (reference pkg/cache)
+  metrics/      prometheus-style metrics registry
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,26 @@
+"""API data model: the CRD-equivalent objects and the requirements algebra.
+
+Mirrors reference pkg/apis (v1beta1 NodePool/NodeClaim/EC2NodeClass and the
+karpenter-core scheduling.Requirements algebra observed through
+pkg/cloudprovider/cloudprovider.go:301-306).
+"""
+
+from karpenter_tpu.api.labels import *  # noqa: F401,F403
+from karpenter_tpu.api.resources import Resources, parse_quantity  # noqa: F401
+from karpenter_tpu.api.requirements import Requirement, Requirements, Op  # noqa: F401
+from karpenter_tpu.api.objects import (  # noqa: F401
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    PodAffinityTerm,
+    Pod,
+    Offering,
+    Offerings,
+    Overhead,
+    InstanceType,
+    Disruption,
+    NodePool,
+    NodeClaim,
+    NodeClass,
+)
+from karpenter_tpu.api.settings import Settings  # noqa: F401

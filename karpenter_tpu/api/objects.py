@@ -1,0 +1,436 @@
+"""CRD-equivalent objects: Pod, InstanceType, NodePool, NodeClaim, NodeClass.
+
+Re-creations of the reference's API surface:
+- Pod scheduling fields: the subset karpenter-core schedules on (resources,
+  nodeSelector, nodeAffinity, tolerations, topologySpreadConstraints, pod
+  (anti-)affinity — reference website v0.31 concepts/scheduling.md:124-430).
+- InstanceType/Offering: karpenter-core cloudprovider types observed at
+  reference pkg/providers/instancetype/types.go:52-67,130-158 and
+  pkg/cloudprovider/cloudprovider.go:296-307.
+- NodePool: karpenter-core v1beta1 NodePool (designs/v1beta1-api.md).
+- NodeClaim: the desired-machine handshake object
+  (pkg/cloudprovider/cloudprovider.go:94-120).
+- NodeClass: the provider-specific class, analogous to EC2NodeClass
+  (pkg/apis/v1beta1/ec2nodeclass.go:28-107).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.requirements import Op, Requirement, Requirements
+from karpenter_tpu.api.resources import Resources
+
+# ---------------------------------------------------------------------------
+# Taints and tolerations
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = L.TAINT_EFFECT_NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+def tolerates_all(tolerations: Sequence[Toleration], taints: Sequence[Taint]) -> bool:
+    """A pod schedules onto a node iff every NoSchedule/NoExecute taint is
+    tolerated (PreferNoSchedule is soft and ignored for feasibility)."""
+    for t in taints:
+        if t.effect == L.TAINT_EFFECT_PREFER_NO_SCHEDULE:
+            continue
+        if not any(tol.tolerates(t) for tol in tolerations):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Pod scheduling constraints
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Tuple[Tuple[str, str], ...] = ()  # matchLabels, sorted
+
+    def selects(self, pod: "Pod") -> bool:
+        return all(pod.labels.get(k) == v for k, v in self.label_selector)
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    """requiredDuringScheduling pod (anti-)affinity term."""
+
+    topology_key: str
+    label_selector: Tuple[Tuple[str, str], ...] = ()  # matchLabels, sorted
+    anti: bool = False
+    namespaces: Tuple[str, ...] = ()
+
+    def selects(self, pod: "Pod") -> bool:
+        if self.namespaces and pod.namespace not in self.namespaces:
+            return False
+        return all(pod.labels.get(k) == v for k, v in self.label_selector)
+
+
+_pod_seq = itertools.count()
+
+
+@dataclass
+class Pod:
+    """The scheduling-relevant projection of a v1.Pod."""
+
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    requests: Resources = field(default_factory=Resources)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    required_affinity: List[Requirement] = field(default_factory=list)
+    preferred_affinity: List[Requirement] = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+    pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
+    priority: int = 0
+    has_controller: bool = True
+    node_name: str = ""  # bound node ("" = pending)
+    is_daemonset: bool = False
+    phase: str = "Pending"
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = f"pod-{next(_pod_seq)}"
+        # every pod consumes one pod slot
+        if self.requests.get(L.RESOURCE_PODS) == 0:
+            self.requests = self.requests + Resources({L.RESOURCE_PODS: 1})
+
+    # -- derived scheduling state -------------------------------------------
+    def scheduling_requirements(self) -> Requirements:
+        """nodeSelector + required node affinity as one conjunction."""
+        reqs = Requirements.from_labels(self.node_selector)
+        for r in self.required_affinity:
+            reqs.add(r)
+        return reqs
+
+    def do_not_evict(self) -> bool:
+        return self.annotations.get(L.ANNOTATION_DO_NOT_EVICT, "") == "true"
+
+    def deletion_cost(self) -> float:
+        try:
+            return float(self.annotations.get(L.ANNOTATION_POD_DELETION_COST, 0))
+        except ValueError:
+            return 0.0
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def constraint_signature(self) -> Tuple:
+        """Hashable signature of everything that affects where this pod can
+        go.  Pods with equal signatures are interchangeable to the solver
+        (they may still differ in resource requests)."""
+        return (
+            tuple(sorted(self.node_selector.items())),
+            tuple(sorted(map(repr, self.required_affinity))),
+            tuple(sorted(self.tolerations, key=repr)),
+            tuple(sorted(self.topology_spread, key=repr)),
+            tuple(sorted(self.pod_affinity, key=repr)),
+            tuple(sorted(self.labels.items())),
+            self.namespace,
+        )
+
+
+# ---------------------------------------------------------------------------
+# InstanceType and offerings
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Offering:
+    """zone x capacity-type purchasing option (reference
+    pkg/providers/instancetype/types.go:130-158)."""
+
+    zone: str
+    capacity_type: str
+    price: float
+    available: bool = True
+
+    def requirements(self) -> Requirements:
+        return Requirements(
+            [
+                Requirement(L.LABEL_ZONE, Op.IN, [self.zone]),
+                Requirement(L.LABEL_CAPACITY_TYPE, Op.IN, [self.capacity_type]),
+            ]
+        )
+
+
+class Offerings(list):
+    """list[Offering] with the reference's query helpers
+    (`Offerings.Available().Requirements(reqs).Cheapest()`,
+    reference pkg/providers/instance/instance.go:396-400)."""
+
+    def available(self) -> "Offerings":
+        return Offerings(o for o in self if o.available)
+
+    def compatible(self, reqs: Requirements) -> "Offerings":
+        out = Offerings()
+        for o in self:
+            zr = reqs.get(L.LABEL_ZONE)
+            cr = reqs.get(L.LABEL_CAPACITY_TYPE)
+            if zr is not None and not zr.has(o.zone):
+                continue
+            if cr is not None and not cr.has(o.capacity_type):
+                continue
+            out.append(o)
+        return out
+
+    def cheapest(self) -> Optional[Offering]:
+        return min(self, key=lambda o: o.price, default=None)
+
+    def zones(self) -> List[str]:
+        return sorted({o.zone for o in self})
+
+
+@dataclass(frozen=True)
+class Overhead:
+    """Node resource overhead; Allocatable = Capacity - sum(overheads)
+    (reference pkg/providers/instancetype/types.go:326-416)."""
+
+    kube_reserved: Resources = field(default_factory=Resources)
+    system_reserved: Resources = field(default_factory=Resources)
+    eviction_threshold: Resources = field(default_factory=Resources)
+
+    def total(self) -> Resources:
+        return self.kube_reserved + self.system_reserved + self.eviction_threshold
+
+
+@dataclass
+class InstanceType:
+    """One launchable machine shape (reference
+    pkg/providers/instancetype/types.go:52-67)."""
+
+    name: str
+    requirements: Requirements
+    capacity: Resources
+    overhead: Overhead = field(default_factory=Overhead)
+    offerings: Offerings = field(default_factory=Offerings)
+
+    def allocatable(self) -> Resources:
+        return (self.capacity - self.overhead.total()).clamp_nonnegative()
+
+    def cheapest_price(self, reqs: Optional[Requirements] = None) -> float:
+        offs = self.offerings.available()
+        if reqs is not None:
+            offs = offs.compatible(reqs)
+        o = offs.cheapest()
+        return o.price if o is not None else float("inf")
+
+    def __repr__(self) -> str:
+        return f"InstanceType({self.name})"
+
+
+# ---------------------------------------------------------------------------
+# NodePool (the provisioner) and NodeClaim
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Disruption:
+    """NodePool disruption policy (karpenter-core v1beta1 NodePool.spec.disruption;
+    semantics per reference website v0.31 concepts/deprovisioning.md)."""
+
+    consolidation_policy: str = "WhenUnderutilized"  # or WhenEmpty
+    consolidate_after: Optional[float] = None  # seconds; None = immediately
+    expire_after: Optional[float] = None  # seconds; None = never
+    budgets: List[str] = field(default_factory=list)  # e.g. ["10%", "5"]
+
+
+@dataclass
+class NodePool:
+    name: str
+    weight: int = 0  # higher first (designs/provisioner-priority.md)
+    requirements: Requirements = field(default_factory=Requirements)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    limits: Resources = field(default_factory=Resources)  # empty = unlimited
+    disruption: Disruption = field(default_factory=Disruption)
+    node_class_ref: str = ""
+    kubelet_max_pods: Optional[int] = None
+    deleted: bool = False
+
+    def template_requirements(self) -> Requirements:
+        reqs = Requirements.from_labels(self.labels)
+        reqs = reqs.union(self.requirements)
+        reqs.add(Requirement(L.LABEL_NODEPOOL, Op.IN, [self.name]))
+        return reqs
+
+
+class NodeClaimCondition:
+    LAUNCHED = "Launched"
+    REGISTERED = "Registered"
+    INITIALIZED = "Initialized"
+    EMPTY = "Empty"
+    EXPIRED = "Expired"
+    DRIFTED = "Drifted"
+
+
+_claim_seq = itertools.count()
+
+
+@dataclass
+class NodeClaim:
+    """Desired-machine handshake object: core hands this down, the cloud
+    provider launches and fills in status (reference
+    pkg/cloudprovider/cloudprovider.go:94-120,348-383)."""
+
+    name: str = ""
+    pool_name: str = ""
+    node_class_ref: str = ""
+    requirements: Requirements = field(default_factory=Requirements)
+    requests: Resources = field(default_factory=Resources)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    kubelet_max_pods: Optional[int] = None
+    # status
+    provider_id: str = ""
+    instance_type_name: str = ""
+    zone: str = ""
+    capacity_type: str = ""
+    image_id: str = ""
+    price: float = 0.0
+    capacity: Resources = field(default_factory=Resources)
+    allocatable: Resources = field(default_factory=Resources)
+    conditions: Dict[str, bool] = field(default_factory=dict)
+    created_at: float = 0.0
+    deleted_at: Optional[float] = None
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = f"nodeclaim-{next(_claim_seq)}"
+
+    def set_condition(self, cond: str, value: bool = True) -> None:
+        self.conditions[cond] = value
+
+    def has_condition(self, cond: str) -> bool:
+        return self.conditions.get(cond, False)
+
+    @property
+    def launched(self) -> bool:
+        return self.has_condition(NodeClaimCondition.LAUNCHED)
+
+    @property
+    def registered(self) -> bool:
+        return self.has_condition(NodeClaimCondition.REGISTERED)
+
+    @property
+    def initialized(self) -> bool:
+        return self.has_condition(NodeClaimCondition.INITIALIZED)
+
+
+# ---------------------------------------------------------------------------
+# NodeClass (provider-specific; analogous to EC2NodeClass)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SelectorTerm:
+    """Tag/id selector term (reference pkg/apis/v1beta1/ec2nodeclass.go
+    subnet/SG/AMI selector terms): OR-ed terms, AND-ed tag matches."""
+
+    tags: Tuple[Tuple[str, str], ...] = ()
+    id: str = ""
+    name: str = ""
+
+    @classmethod
+    def of(cls, id: str = "", name: str = "", **tags: str) -> "SelectorTerm":
+        return cls(tags=tuple(sorted(tags.items())), id=id, name=name)
+
+    def matches(self, obj_id: str, obj_name: str, obj_tags: Mapping[str, str]) -> bool:
+        if self.id:
+            return self.id == obj_id
+        if self.name and self.name != obj_name:
+            return False
+        return all(
+            (k in obj_tags) if v == "*" else obj_tags.get(k) == v
+            for k, v in self.tags
+        )
+
+
+@dataclass(frozen=True)
+class BlockDeviceMapping:
+    device_name: str = "/dev/xvda"
+    volume_size: float = 20 * 2**30
+    volume_type: str = "gp3"
+    encrypted: bool = True
+    delete_on_termination: bool = True
+
+
+@dataclass
+class NodeClass:
+    """Provider-side machine class (image family, networking, storage).
+
+    Analogous to EC2NodeClass (reference pkg/apis/v1beta1/ec2nodeclass.go:
+    28-107): selector terms resolve against the cloud inventory into status,
+    and the hash of the launch-relevant spec drives drift detection
+    (reference pkg/cloudprovider/drift.go:136-152).
+    """
+
+    name: str
+    subnet_selector_terms: List[SelectorTerm] = field(default_factory=list)
+    security_group_selector_terms: List[SelectorTerm] = field(default_factory=list)
+    image_selector_terms: List[SelectorTerm] = field(default_factory=list)
+    image_family: str = "standard"  # standard | accelerated | custom
+    user_data: str = ""
+    role: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+    block_device_mappings: List[BlockDeviceMapping] = field(default_factory=list)
+    detailed_monitoring: bool = False
+    metadata_options: Dict[str, str] = field(default_factory=dict)
+    # status (resolved by the nodeclass controller)
+    resolved_subnets: List[str] = field(default_factory=list)
+    resolved_security_groups: List[str] = field(default_factory=list)
+    resolved_images: List[str] = field(default_factory=list)
+    resolved_instance_profile: str = ""
+    deleted: bool = False
+
+    def static_hash(self) -> str:
+        """Hash of launch-relevant spec fields for drift detection
+        (reference drift.go:136-152: NodeClass(Template)Drift)."""
+        spec = {
+            "image_family": self.image_family,
+            "user_data": self.user_data,
+            "role": self.role,
+            "tags": sorted(self.tags.items()),
+            "bdm": [dataclasses.astuple(b) for b in self.block_device_mappings],
+            "detailed_monitoring": self.detailed_monitoring,
+            "metadata_options": sorted(self.metadata_options.items()),
+        }
+        return hashlib.sha256(json.dumps(spec, sort_keys=True).encode()).hexdigest()[
+            :16
+        ]

@@ -1,0 +1,36 @@
+"""Global settings (reference pkg/apis/settings/settings.go:32-61 plus the
+batching windows from website v0.31 concepts/settings.md:43-47,94-102)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class Settings:
+    cluster_name: str = "default"
+    cluster_endpoint: str = ""
+    isolated_vpc: bool = False
+    vm_memory_overhead_percent: float = 0.075  # settings.go:48-61 default
+    interruption_queue_name: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+    reserved_enis: int = 0
+    enable_pod_eni: bool = False
+    enable_eni_limited_pod_density: bool = True
+    feature_gate_drift: bool = True
+    # pod batching window (settings.md:43-47)
+    batch_idle_duration: float = 1.0
+    batch_max_duration: float = 10.0
+
+    def validate(self) -> None:
+        if not self.cluster_name:
+            raise ValueError("cluster_name is required")
+        if not (0.0 <= self.vm_memory_overhead_percent < 1.0):
+            raise ValueError("vm_memory_overhead_percent must be in [0,1)")
+        if self.batch_idle_duration < 0 or self.batch_max_duration < 0:
+            raise ValueError("batch windows must be non-negative")
+        if self.batch_max_duration < self.batch_idle_duration:
+            raise ValueError("batch_max_duration must be >= batch_idle_duration")
+        if self.reserved_enis < 0:
+            raise ValueError("reserved_enis must be >= 0")

@@ -1,0 +1,12 @@
+"""Test bootstrap: force JAX onto an 8-device virtual CPU mesh so every
+sharding/pjit path is exercised without TPU hardware (the driver separately
+dry-runs the multichip path; bench.py runs on the real chip)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,109 @@
+from karpenter_tpu.api import (
+    InstanceType,
+    NodeClass,
+    NodePool,
+    Offering,
+    Offerings,
+    Op,
+    Overhead,
+    Pod,
+    Requirement,
+    Requirements,
+    Resources,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import SelectorTerm, tolerates_all
+
+
+def test_tolerations():
+    taint = Taint("team", "ml", L.TAINT_EFFECT_NO_SCHEDULE)
+    assert Toleration("team", "Equal", "ml").tolerates(taint)
+    assert Toleration("team", "Exists").tolerates(taint)
+    assert Toleration(operator="Exists").tolerates(taint)  # wildcard
+    assert not Toleration("team", "Equal", "web").tolerates(taint)
+    assert not Toleration("team", "Equal", "ml", "NoExecute").tolerates(taint)
+    # PreferNoSchedule is soft
+    soft = Taint("x", "y", L.TAINT_EFFECT_PREFER_NO_SCHEDULE)
+    assert tolerates_all([], [soft])
+    assert not tolerates_all([], [taint])
+
+
+def test_pod_defaults_pod_slot():
+    p = Pod(requests=Resources(cpu=1))
+    assert p.requests.get(L.RESOURCE_PODS) == 1
+
+
+def test_pod_scheduling_requirements():
+    p = Pod(
+        node_selector={L.LABEL_ZONE: "z1"},
+        required_affinity=[Requirement(L.LABEL_ARCH, Op.IN, ["arm64"])],
+    )
+    reqs = p.scheduling_requirements()
+    assert reqs.get(L.LABEL_ZONE).has("z1")
+    assert reqs.get(L.LABEL_ARCH).has("arm64")
+
+
+def test_offerings_queries():
+    offs = Offerings(
+        [
+            Offering("z1", "on-demand", 1.0),
+            Offering("z2", "on-demand", 0.9),
+            Offering("z1", "spot", 0.3, available=False),
+            Offering("z2", "spot", 0.25),
+        ]
+    )
+    assert offs.available().cheapest().price == 0.25
+    reqs = Requirements([Requirement(L.LABEL_ZONE, Op.IN, ["z1"])])
+    assert offs.available().compatible(reqs).cheapest().price == 1.0
+    assert offs.zones() == ["z1", "z2"]
+
+
+def test_instance_type_allocatable():
+    it = InstanceType(
+        name="std-4",
+        requirements=Requirements(),
+        capacity=Resources(cpu=4, memory="16Gi", pods=110),
+        overhead=Overhead(
+            kube_reserved=Resources(cpu="80m", memory="1Gi"),
+            eviction_threshold=Resources(memory="100Mi"),
+        ),
+    )
+    alloc = it.allocatable()
+    assert abs(alloc.cpu - 3.92) < 1e-9
+    assert alloc.memory == 16 * 2**30 - 2**30 - 100 * 2**20
+    assert alloc.get(L.RESOURCE_PODS) == 110
+
+
+def test_nodepool_template_requirements():
+    pool = NodePool(
+        name="default",
+        labels={"team": "ml"},
+        requirements=Requirements([Requirement(L.LABEL_ARCH, Op.IN, ["amd64"])]),
+    )
+    reqs = pool.template_requirements()
+    assert reqs.get("team").has("ml")
+    assert reqs.get(L.LABEL_NODEPOOL).has("default")
+    assert reqs.get(L.LABEL_ARCH).has("amd64")
+
+
+def test_selector_term_and_nodeclass_hash():
+    term = SelectorTerm.of(environment="prod")
+    assert term.matches("id-1", "n", {"environment": "prod", "x": "y"})
+    assert not term.matches("id-1", "n", {"environment": "dev"})
+    by_id = SelectorTerm.of(id="subnet-123")
+    assert by_id.matches("subnet-123", "", {})
+
+    nc = NodeClass(name="default", user_data="echo hi")
+    h1 = nc.static_hash()
+    nc.user_data = "echo bye"
+    assert nc.static_hash() != h1
+    nc.user_data = "echo hi"
+    assert nc.static_hash() == h1
+
+
+def test_selector_wildcard_requires_key():
+    term = SelectorTerm.of(environment="*")
+    assert term.matches("id", "n", {"environment": "anything"})
+    assert not term.matches("id", "n", {})  # key must exist

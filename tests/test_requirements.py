@@ -1,0 +1,129 @@
+"""Requirements-algebra semantics (reference: karpenter-core
+scheduling.Requirements as used at pkg/cloudprovider/cloudprovider.go:301-306)."""
+
+from karpenter_tpu.api import Op, Requirement, Requirements
+
+
+def test_in_has():
+    r = Requirement("zone", Op.IN, ["a", "b"])
+    assert r.has("a") and r.has("b") and not r.has("c")
+
+
+def test_not_in_has():
+    r = Requirement("zone", Op.NOT_IN, ["a"])
+    assert not r.has("a") and r.has("b")
+
+
+def test_exists_does_not_exist():
+    assert Requirement("k", Op.EXISTS).has("anything")
+    assert not Requirement("k", Op.DOES_NOT_EXIST).has("anything")
+
+
+def test_gt_lt():
+    gt = Requirement("cpu", Op.GT, ["4"])
+    assert gt.has("8") and not gt.has("4") and not gt.has("2")
+    lt = Requirement("cpu", Op.LT, ["4"])
+    assert lt.has("2") and not lt.has("4")
+    assert not gt.has("not-a-number")
+
+
+def test_intersection_in_in():
+    a = Requirement("z", Op.IN, ["a", "b"])
+    b = Requirement("z", Op.IN, ["b", "c"])
+    got = a.intersection(b)
+    assert got.has("b") and not got.has("a") and not got.has("c")
+    assert a.intersects(b)
+    assert not a.intersects(Requirement("z", Op.IN, ["c"]))
+
+
+def test_intersection_in_notin():
+    a = Requirement("z", Op.IN, ["a", "b"])
+    b = Requirement("z", Op.NOT_IN, ["a"])
+    got = a.intersection(b)
+    assert got.has("b") and not got.has("a")
+
+
+def test_intersection_gt_with_in():
+    a = Requirement("cpu", Op.IN, ["2", "4", "8"])
+    b = Requirement("cpu", Op.GT, ["2"])
+    assert a.intersects(b)
+    merged = a.intersection(b)
+    assert merged.has("4") and not merged.has("2")
+
+
+def test_allows_absent():
+    assert Requirement("k", Op.NOT_IN, ["x"]).allows_absent()
+    assert Requirement("k", Op.DOES_NOT_EXIST).allows_absent()
+    assert not Requirement("k", Op.EXISTS).allows_absent()
+    assert not Requirement("k", Op.IN, ["x"]).allows_absent()
+    assert not Requirement("k", Op.GT, ["1"]).allows_absent()
+
+
+def test_requirements_add_intersects():
+    reqs = Requirements([Requirement("z", Op.IN, ["a", "b"])])
+    reqs.add(Requirement("z", Op.NOT_IN, ["a"]))
+    assert reqs.get("z").has("b") and not reqs.get("z").has("a")
+
+
+def test_compatible_missing_key():
+    node = Requirements([Requirement("zone", Op.IN, ["a"])])
+    # incoming In on undefined key -> incompatible
+    assert not node.compatible(Requirements([Requirement("gpu", Op.IN, ["true"])]))
+    # incoming NotIn/DoesNotExist on undefined key -> compatible
+    assert node.compatible(Requirements([Requirement("gpu", Op.NOT_IN, ["true"])]))
+    assert node.compatible(Requirements([Requirement("gpu", Op.DOES_NOT_EXIST)]))
+    # shared key must intersect
+    assert node.compatible(Requirements([Requirement("zone", Op.IN, ["a", "b"])]))
+    assert not node.compatible(Requirements([Requirement("zone", Op.IN, ["b"])]))
+
+
+def test_from_labels_and_node_selector_terms():
+    reqs = Requirements.from_labels({"zone": "a"})
+    assert reqs.get("zone").has("a") and not reqs.get("zone").has("b")
+    reqs2 = Requirements.from_node_selector_terms(
+        [{"key": "zone", "operator": "In", "values": ["a", "b"]}]
+    )
+    assert reqs2.get("zone").has("b")
+
+
+def test_unsatisfiable():
+    reqs = Requirements([Requirement("z", Op.IN, ["a"])])
+    reqs.add(Requirement("z", Op.IN, ["b"]))
+    assert reqs.is_unsatisfiable()
+
+
+def test_labels_projection():
+    reqs = Requirements(
+        [Requirement("a", Op.IN, ["x"]), Requirement("b", Op.NOT_IN, ["y"])]
+    )
+    assert reqs.labels() == {"a": "x"}
+
+
+def test_does_not_exist_is_satisfiable():
+    # regression: DoesNotExist is an empty allow-list but satisfiable by absence
+    assert not Requirements([Requirement("gpu", Op.DOES_NOT_EXIST)]).is_unsatisfiable()
+    # while In ∩ In = ∅ is genuinely unsatisfiable
+    r = Requirements([Requirement("z", Op.IN, ["a"])])
+    r.add(Requirement("z", Op.IN, ["b"]))
+    assert r.is_unsatisfiable()
+    # DoesNotExist ∩ In = unsatisfiable (must be absent AND present)
+    r2 = Requirements([Requirement("z", Op.DOES_NOT_EXIST)])
+    r2.add(Requirement("z", Op.IN, ["a"]))
+    assert r2.is_unsatisfiable()
+
+
+def test_contradictory_bounds():
+    gt = Requirement("cpu", Op.GT, ["5"])
+    lt = Requirement("cpu", Op.LT, ["3"])
+    assert not gt.intersects(lt)
+    r = Requirements([gt])
+    r.add(lt)
+    assert r.is_unsatisfiable()
+    # non-contradictory bounds still intersect
+    assert Requirement("cpu", Op.GT, ["2"]).intersects(Requirement("cpu", Op.LT, ["8"]))
+
+
+def test_min_values_survives_intersection():
+    r = Requirements([Requirement("t", Op.EXISTS, min_values=3)])
+    r.add(Requirement("t", Op.NOT_IN, ["t1"]))
+    assert r.get("t").min_values == 3

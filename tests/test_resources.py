@@ -1,0 +1,48 @@
+from karpenter_tpu.api import Resources
+from karpenter_tpu.api.resources import parse_quantity
+
+
+def test_parse_quantity():
+    assert parse_quantity("100m") == 0.1
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("2") == 2.0
+    assert parse_quantity(3) == 3.0
+    assert parse_quantity("1.5") == 1.5
+    assert parse_quantity("2k") == 2000.0
+
+
+def test_arithmetic_and_fits():
+    a = Resources(cpu="500m", memory="1Gi")
+    b = Resources(cpu="250m", memory="512Mi")
+    s = a + b
+    assert s.cpu == 0.75 and s.memory == 1.5 * 2**30
+    cap = Resources(cpu=1, memory="2Gi")
+    assert s.fits(cap)
+    assert not (s + a).fits(cap)
+    # axes absent from the request never block
+    assert Resources(cpu="100m").fits(cap)
+    # but axes absent from capacity do
+    assert not Resources(gpu=1).fits(cap)
+
+
+def test_exceeds_limits():
+    usage = Resources(cpu=10)
+    assert usage.exceeds(Resources(cpu=8))
+    assert not usage.exceeds(Resources(cpu=16))
+    assert not usage.exceeds(Resources())  # empty limit = unlimited
+
+
+def test_vector_projection():
+    r = Resources(cpu=2, memory="4Gi")
+    assert r.as_vector(["cpu", "memory", "pods"]) == (2.0, 4 * 2**30, 0.0)
+
+
+def test_parse_quantity_errors_and_small_suffixes():
+    import pytest
+
+    assert parse_quantity("10u") == pytest.approx(1e-5)
+    assert parse_quantity("100n") == pytest.approx(1e-7)
+    with pytest.raises(ValueError):
+        parse_quantity("1g")  # unknown suffix -> ValueError, not KeyError
+    with pytest.raises(ValueError):
+        parse_quantity("1Qx")
