@@ -62,6 +62,40 @@ LABEL_INSTANCE_ACCELERATOR_COUNT = "karpenter.tpu/instance-accelerator-count"
 # matching instance types).
 RESTRICTED_FROM_TYPE_MATCHING = frozenset({LABEL_HOSTNAME})
 
+# Catalog labels: the labels an instance type itself defines.  When matching
+# requirements against instance types, a requirement on a key OUTSIDE this
+# set that the type doesn't define is satisfiable anyway — it becomes a node
+# label stamped by the pool (karpenter-core's
+# AllowUndefinedWellKnownLabels compatibility mode).
+CATALOG_LABELS = frozenset(
+    {
+        LABEL_ARCH,
+        LABEL_OS,
+        LABEL_ZONE,
+        LABEL_REGION,
+        LABEL_INSTANCE_TYPE,
+        LABEL_WINDOWS_BUILD,
+        LABEL_CAPACITY_TYPE,
+        LABEL_INSTANCE_CATEGORY,
+        LABEL_INSTANCE_FAMILY,
+        LABEL_INSTANCE_GENERATION,
+        LABEL_INSTANCE_SIZE,
+        LABEL_INSTANCE_CPU,
+        LABEL_INSTANCE_MEMORY,
+        LABEL_INSTANCE_NETWORK_BANDWIDTH,
+        LABEL_INSTANCE_HYPERVISOR,
+        LABEL_INSTANCE_ENCRYPTION_IN_TRANSIT,
+        LABEL_INSTANCE_LOCAL_NVME,
+        LABEL_INSTANCE_GPU_NAME,
+        LABEL_INSTANCE_GPU_MANUFACTURER,
+        LABEL_INSTANCE_GPU_COUNT,
+        LABEL_INSTANCE_GPU_MEMORY,
+        LABEL_INSTANCE_ACCELERATOR_NAME,
+        LABEL_INSTANCE_ACCELERATOR_MANUFACTURER,
+        LABEL_INSTANCE_ACCELERATOR_COUNT,
+    }
+)
+
 # --- resource names ---------------------------------------------------------
 RESOURCE_CPU = "cpu"
 RESOURCE_MEMORY = "memory"

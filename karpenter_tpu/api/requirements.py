@@ -306,7 +306,9 @@ class Requirements:
                 return False
         return True
 
-    def compatible(self, incoming: "Requirements") -> bool:
+    def compatible(
+        self, incoming: "Requirements", allow_undefined: bool = False
+    ) -> bool:
         """Whether a node described by `self` can satisfy `incoming`.
 
         For every incoming requirement: if self defines the key, the sets
@@ -314,10 +316,20 @@ class Requirements:
         requirement must tolerate an absent label (NotIn/DoesNotExist).
         Mirrors the instance-type pre-filter at reference
         pkg/cloudprovider/cloudprovider.go:301-306.
+
+        With ``allow_undefined`` (karpenter-core's
+        AllowUndefinedWellKnownLabels mode, used when `self` is an instance
+        type's requirements): undefined keys outside the catalog-label set
+        are satisfiable anyway — they become node labels stamped by the
+        NodePool rather than properties of the machine shape.
         """
+        from karpenter_tpu.api.labels import CATALOG_LABELS
+
         for key, inc in incoming._reqs.items():
             mine = self._reqs.get(key)
             if mine is None:
+                if allow_undefined and key not in CATALOG_LABELS:
+                    continue
                 if not inc.allows_absent():
                     return False
             elif not mine.intersects(inc):

@@ -64,7 +64,9 @@ class Resources:
                 q[k] = parse_quantity(v)
         for k, v in kw.items():
             q[k.replace("_", "-")] = parse_quantity(v)
-        self._q = {k: v for k, v in q.items() if v != 0.0}
+        # explicit zeros are kept: `limits: {cpu: 0}` means "provision
+        # nothing" (karpenter limits idiom), not "unlimited"
+        self._q = q
 
     # -- accessors -----------------------------------------------------------
     def get(self, name: str, default: float = 0.0) -> float:
@@ -77,7 +79,7 @@ class Resources:
         return self._q.items()
 
     def is_zero(self) -> bool:
-        return not self._q
+        return all(v == 0.0 for v in self._q.values())
 
     @property
     def cpu(self) -> float:
@@ -136,7 +138,7 @@ class Resources:
         return hash(tuple(sorted(self._q.items())))
 
     def __bool__(self) -> bool:
-        return bool(self._q)
+        return not self.is_zero()
 
     def __repr__(self) -> str:
         inner = ", ".join(

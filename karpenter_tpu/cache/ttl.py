@@ -1,0 +1,49 @@
+"""TTL cache (reference pkg/cache/cache.go:20-33 — patrickmn/go-cache usage).
+
+Default TTLs mirror the reference constants: 1m default, 5m instance
+types/zones, 3m unavailable offerings, 15m instance profiles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from karpenter_tpu.utils.clock import Clock
+
+DEFAULT_TTL = 60.0
+INSTANCE_TYPES_ZONES_TTL = 300.0
+UNAVAILABLE_OFFERINGS_TTL = 180.0
+INSTANCE_PROFILE_TTL = 900.0
+
+
+class TTLCache:
+    def __init__(self, clock: Clock, ttl: float = DEFAULT_TTL):
+        self.clock = clock
+        self.ttl = ttl
+        self._items: Dict[Any, Tuple[float, Any]] = {}
+
+    def get(self, key) -> Optional[Any]:
+        item = self._items.get(key)
+        if item is None:
+            return None
+        expires, value = item
+        if self.clock.now() >= expires:
+            del self._items[key]
+            return None
+        return value
+
+    def set(self, key, value, ttl: Optional[float] = None) -> None:
+        self._items[key] = (self.clock.now() + (ttl or self.ttl), value)
+
+    def delete(self, key) -> None:
+        self._items.pop(key, None)
+
+    def flush(self) -> None:
+        self._items.clear()
+
+    def keys(self):
+        now = self.clock.now()
+        return [k for k, (exp, _) in self._items.items() if exp > now]
+
+    def __len__(self) -> int:
+        return len(self.keys())

@@ -1,0 +1,478 @@
+"""Fake cloud backend: the in-memory analogue of the reference's fake AWS
+(pkg/fake/ec2api.go:40-196 plus fake SSM/IAM/Pricing/SQS).
+
+One object simulates the whole cloud surface the providers consume:
+machine-shape catalog, zonal offerings, subnets/security-groups/images,
+fleet launches with per-pool capacity and injectable insufficient-capacity
+errors (`InsufficientCapacityPools`, reference ec2api.go:40-44), an instance
+store so describe reflects prior launches (ec2api.go:112-196), spot/on-demand
+pricing, an interruption message queue (fake SQS), and instance profiles
+(fake IAM).  Every API records its calls and supports one-shot error
+injection (`NextError`, ec2api.go:66-67).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.utils.clock import Clock
+
+
+class CloudAPIError(Exception):
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(message or code)
+        self.code = code
+
+
+class InsufficientCapacityError(CloudAPIError):
+    def __init__(self, pool: Tuple[str, str, str]):
+        super().__init__(
+            "InsufficientInstanceCapacity",
+            f"no capacity in pool {pool}",
+        )
+        self.pool = pool  # (instance_type, zone, capacity_type)
+
+
+@dataclass
+class MachineShape:
+    """Catalog row (analogue of one DescribeInstanceTypes entry)."""
+
+    name: str
+    cpu: float
+    memory: float  # bytes
+    arch: str = "amd64"
+    os: str = "linux"
+    category: str = "general"  # general | compute | memory | accelerated
+    family: str = "std"
+    generation: int = 1
+    size: str = "large"
+    gpu_count: int = 0
+    gpu_name: str = ""
+    tpu_chips: int = 0
+    accelerator_name: str = ""
+    accelerator_manufacturer: str = ""
+    local_nvme: float = 0.0  # bytes of instance storage
+    network_bandwidth: float = 1.0  # Gbps
+    max_pods: int = 110
+    bare_metal: bool = False
+    hypervisor: str = "nitro"
+    od_price: float = 0.1  # on-demand $/h
+
+
+@dataclass
+class FakeSubnet:
+    id: str
+    zone: str
+    available_ips: int = 4096
+    tags: Dict[str, str] = field(default_factory=dict)
+    name: str = ""
+    public: bool = False
+
+
+@dataclass
+class FakeSecurityGroup:
+    id: str
+    name: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class FakeImage:
+    id: str
+    family: str = "standard"
+    arch: str = "amd64"
+    created_at: float = 0.0
+    name: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+    deprecated: bool = False
+
+
+@dataclass
+class FakeInstance:
+    id: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    subnet_id: str = ""
+    image_id: str = ""
+    security_group_ids: List[str] = field(default_factory=list)
+    tags: Dict[str, str] = field(default_factory=dict)
+    state: str = "running"  # pending|running|shutting-down|stopping|stopped|terminated
+    launch_time: float = 0.0
+    launch_template: str = ""
+
+
+@dataclass
+class QueueMessage:
+    id: str
+    body: dict
+    receipt: str = ""
+
+
+class _CallRecorder:
+    """MockedFunction-style call capture (reference pkg/fake/utils.go)."""
+
+    def __init__(self):
+        self.calls: Dict[str, List[tuple]] = {}
+        self._next_error: Dict[str, Exception] = {}
+
+    def record(self, api: str, *args) -> None:
+        self.calls.setdefault(api, []).append(args)
+        err = self._next_error.pop(api, None)
+        if err is not None:
+            raise err
+
+    def set_next_error(self, api: str, err: Exception) -> None:
+        self._next_error[api] = err
+
+    def count(self, api: str) -> int:
+        return len(self.calls.get(api, ()))
+
+
+class FakeCloud:
+    """The programmable cloud.  Thread-safe where the batcher needs it."""
+
+    def __init__(
+        self,
+        clock: Clock,
+        shapes: Sequence[MachineShape] = (),
+        zones: Sequence[str] = ("zone-a", "zone-b", "zone-c"),
+        region: str = "region-1",
+        spot_discount: float = 0.3,
+    ):
+        self.clock = clock
+        self.region = region
+        self.zones = list(zones)
+        self.shapes: Dict[str, MachineShape] = {s.name: s for s in shapes}
+        self.spot_discount = spot_discount
+        # offering availability: (type, zone) present = offered there.
+        # default: every type offered in every zone.
+        self.offerings: Dict[Tuple[str, str], bool] = {}
+        # spot price overrides per (type, zone); default od_price * discount
+        self.spot_prices: Dict[Tuple[str, str], float] = {}
+        # capacity pools: (type, zone, capacity_type) -> remaining launchable
+        # count; missing key = unlimited (reference fakes default to success)
+        self.capacity_pools: Dict[Tuple[str, str, str], int] = {}
+        # ICE injection (reference InsufficientCapacityPools ec2api.go:40-44)
+        self.insufficient_pools: set[Tuple[str, str, str]] = set()
+        self.subnets: Dict[str, FakeSubnet] = {}
+        self.security_groups: Dict[str, FakeSecurityGroup] = {}
+        self.images: Dict[str, FakeImage] = {}
+        self.instances: Dict[str, FakeInstance] = {}
+        self.instance_profiles: Dict[str, str] = {}  # name -> role
+        self.queue: List[QueueMessage] = []
+        self.kube_version = "1.28"
+        self.recorder = _CallRecorder()
+        self._seq = itertools.count(1)
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------ setup
+    def with_default_topology(self) -> "FakeCloud":
+        """One private subnet + one SG per zone, one image per arch/family."""
+        for i, z in enumerate(self.zones):
+            self.add_subnet(FakeSubnet(id=f"subnet-{i}", zone=z, name=f"private-{z}"))
+        self.add_security_group(FakeSecurityGroup(id="sg-default", name="default"))
+        now = self.clock.now()
+        for fam in ("standard", "accelerated"):
+            for arch in ("amd64", "arm64"):
+                self.add_image(
+                    FakeImage(
+                        id=f"image-{fam}-{arch}",
+                        family=fam,
+                        arch=arch,
+                        created_at=now,
+                        name=f"{fam}-{arch}",
+                    )
+                )
+        return self
+
+    def add_subnet(self, s: FakeSubnet) -> None:
+        s.tags.setdefault("Name", s.name or s.id)
+        self.subnets[s.id] = s
+
+    def add_security_group(self, g: FakeSecurityGroup) -> None:
+        g.tags.setdefault("Name", g.name or g.id)
+        self.security_groups[g.id] = g
+
+    def add_image(self, im: FakeImage) -> None:
+        self.images[im.id] = im
+
+    def set_capacity(self, instance_type: str, zone: str, capacity_type: str, n: int):
+        self.capacity_pools[(instance_type, zone, capacity_type)] = n
+
+    def mark_insufficient(self, instance_type: str, zone: str, capacity_type: str):
+        self.insufficient_pools.add((instance_type, zone, capacity_type))
+
+    # -------------------------------------------------------------- catalog
+    def describe_instance_types(self) -> List[MachineShape]:
+        self.recorder.record("DescribeInstanceTypes")
+        return list(self.shapes.values())
+
+    def describe_instance_type_offerings(self) -> List[Tuple[str, str]]:
+        """(instance_type, zone) pairs currently offered."""
+        self.recorder.record("DescribeInstanceTypeOfferings")
+        if self.offerings:
+            return [k for k, v in self.offerings.items() if v]
+        return [(t, z) for t in self.shapes for z in self.zones]
+
+    # -------------------------------------------------------------- network
+    def describe_subnets(self, selector_terms) -> List[FakeSubnet]:
+        self.recorder.record("DescribeSubnets", tuple(selector_terms))
+        return [
+            s
+            for s in self.subnets.values()
+            if any(t.matches(s.id, s.name, s.tags) for t in selector_terms)
+        ]
+
+    def describe_security_groups(self, selector_terms) -> List[FakeSecurityGroup]:
+        self.recorder.record("DescribeSecurityGroups", tuple(selector_terms))
+        return [
+            g
+            for g in self.security_groups.values()
+            if any(t.matches(g.id, g.name, g.tags) for t in selector_terms)
+        ]
+
+    def describe_images(self, selector_terms) -> List[FakeImage]:
+        self.recorder.record("DescribeImages", tuple(selector_terms))
+        return [
+            im
+            for im in self.images.values()
+            if any(t.matches(im.id, im.name, im.tags) for t in selector_terms)
+        ]
+
+    def latest_image(self, family: str, arch: str) -> Optional[FakeImage]:
+        """SSM-parameter analogue: newest non-deprecated image of a family
+        (reference pkg/providers/amifamily/ami.go:65-79)."""
+        self.recorder.record("GetParameter", family, arch)
+        cands = [
+            im
+            for im in self.images.values()
+            if im.family == family and im.arch == arch and not im.deprecated
+        ]
+        return max(cands, key=lambda im: im.created_at, default=None)
+
+    # -------------------------------------------------------------- pricing
+    def on_demand_price(self, instance_type: str) -> float:
+        return self.shapes[instance_type].od_price
+
+    def spot_price(self, instance_type: str, zone: str) -> float:
+        key = (instance_type, zone)
+        if key in self.spot_prices:
+            return self.spot_prices[key]
+        return self.shapes[instance_type].od_price * self.spot_discount
+
+    def describe_spot_price_history(self) -> Dict[Tuple[str, str], float]:
+        self.recorder.record("DescribeSpotPriceHistory")
+        return {
+            (t, z): self.spot_price(t, z) for t in self.shapes for z in self.zones
+        }
+
+    def get_products(self) -> Dict[str, float]:
+        self.recorder.record("GetProducts")
+        return {t: s.od_price for t, s in self.shapes.items()}
+
+    # -------------------------------------------------------------- fleet
+    def create_fleet(
+        self,
+        overrides: Sequence[Mapping],
+        capacity_type: str,
+        count: int = 1,
+        launch_template: str = "",
+        image_id: str = "",
+        security_group_ids: Sequence[str] = (),
+        tags: Optional[Mapping[str, str]] = None,
+    ) -> Tuple[List[FakeInstance], List[InsufficientCapacityError]]:
+        """Launch `count` instances, trying overrides cheapest-first.
+
+        Overrides are (instance_type, zone, subnet_id[, price]) candidates —
+        the analogue of CreateFleet's LaunchTemplateOverrides cross-product
+        (reference pkg/providers/instance/instance.go:324-363).  Pools marked
+        insufficient or exhausted yield per-pool errors, which the caller
+        feeds back into the unavailable-offerings cache (instance.go:365-371).
+        """
+        with self._lock:
+            self.recorder.record("CreateFleet", len(overrides), capacity_type, count)
+            errors: Dict[Tuple[str, str, str], InsufficientCapacityError] = {}
+            launched: List[FakeInstance] = []
+            ordered = sorted(
+                overrides,
+                key=lambda o: o.get(
+                    "price",
+                    self.spot_price(o["instance_type"], o["zone"])
+                    if capacity_type == L.CAPACITY_TYPE_SPOT
+                    else self.on_demand_price(o["instance_type"]),
+                ),
+            )
+            for _ in range(count):
+                placed = False
+                for o in ordered:
+                    pool = (o["instance_type"], o["zone"], capacity_type)
+                    if pool in self.insufficient_pools:
+                        errors[pool] = InsufficientCapacityError(pool)
+                        continue
+                    remaining = self.capacity_pools.get(pool)
+                    if remaining is not None and remaining <= 0:
+                        errors[pool] = InsufficientCapacityError(pool)
+                        continue
+                    subnet = self.subnets.get(o.get("subnet_id", ""))
+                    if subnet is not None and subnet.available_ips <= 0:
+                        continue
+                    if remaining is not None:
+                        self.capacity_pools[pool] = remaining - 1
+                    if subnet is not None:
+                        subnet.available_ips -= 1
+                    inst = FakeInstance(
+                        id=f"i-{next(self._seq):08d}",
+                        instance_type=o["instance_type"],
+                        zone=o["zone"],
+                        subnet_id=o.get("subnet_id", ""),
+                        capacity_type=capacity_type,
+                        image_id=image_id,
+                        security_group_ids=list(security_group_ids),
+                        tags=dict(tags or {}),
+                        state="running",
+                        launch_time=self.clock.now(),
+                        launch_template=launch_template,
+                    )
+                    self.instances[inst.id] = inst
+                    launched.append(inst)
+                    placed = True
+                    break
+                if not placed:
+                    break
+            return launched, list(errors.values())
+
+    def describe_instances(
+        self, ids: Optional[Iterable[str]] = None, tag_filters: Optional[Mapping] = None
+    ) -> List[FakeInstance]:
+        with self._lock:
+            self.recorder.record(
+                "DescribeInstances", tuple(ids or ()), tuple((tag_filters or {}).items())
+            )
+            out = []
+            for inst in self.instances.values():
+                if ids is not None and inst.id not in set(ids):
+                    continue
+                if tag_filters and not all(
+                    inst.tags.get(k) == v or (v == "*" and k in inst.tags)
+                    for k, v in tag_filters.items()
+                ):
+                    continue
+                out.append(inst)
+            return out
+
+    def terminate_instances(self, ids: Iterable[str]) -> List[str]:
+        with self._lock:
+            ids = list(ids)
+            self.recorder.record("TerminateInstances", tuple(ids))
+            done = []
+            for i in ids:
+                inst = self.instances.get(i)
+                if inst is not None and inst.state != "terminated":
+                    inst.state = "terminated"
+                    subnet = self.subnets.get(inst.subnet_id)
+                    if subnet is not None:
+                        subnet.available_ips += 1
+                    done.append(i)
+            return done
+
+    # -------------------------------------------------------------- IAM
+    def ensure_instance_profile(self, name: str, role: str) -> str:
+        self.recorder.record("CreateInstanceProfile", name, role)
+        self.instance_profiles[name] = role
+        return name
+
+    def delete_instance_profile(self, name: str) -> None:
+        self.recorder.record("DeleteInstanceProfile", name)
+        self.instance_profiles.pop(name, None)
+
+    # -------------------------------------------------------------- queue
+    def send_message(self, body: dict) -> None:
+        with self._lock:
+            self.queue.append(QueueMessage(id=f"m-{next(self._seq)}", body=body))
+
+    def receive_messages(self, max_messages: int = 10) -> List[QueueMessage]:
+        with self._lock:
+            self.recorder.record("ReceiveMessage", max_messages)
+            batch = self.queue[:max_messages]
+            for m in batch:
+                m.receipt = f"r-{m.id}"
+            return list(batch)
+
+    def delete_message(self, message: QueueMessage) -> None:
+        with self._lock:
+            self.recorder.record("DeleteMessage", message.id)
+            self.queue = [m for m in self.queue if m.id != message.id]
+
+
+# ---------------------------------------------------------------------------
+# Catalog generation (analogue of the reference's generated instance-type
+# tables: zz_generated.pricing_aws.go ~717 types across 104 families)
+# ---------------------------------------------------------------------------
+
+_FAMILY_SPECS = {
+    # family -> (category, mem GiB per cpu, $ per cpu-hour, arch, accels/8cpu)
+    "std": ("general", 4, 0.048, "amd64", 0),
+    "cpu": ("compute", 2, 0.042, "amd64", 0),
+    "mem": ("memory", 8, 0.062, "amd64", 0),
+    "arm": ("general", 4, 0.038, "arm64", 0),
+    "armc": ("compute", 2, 0.034, "arm64", 0),
+    "gpu": ("accelerated", 8, 0.35, "amd64", 1),
+    "tpu": ("accelerated", 16, 0.30, "amd64", 2),
+}
+
+_SIZE_NAMES = {
+    1: "small", 2: "medium", 4: "large", 8: "xlarge", 16: "2xlarge",
+    32: "4xlarge", 48: "6xlarge", 64: "8xlarge", 96: "12xlarge",
+    128: "16xlarge", 192: "24xlarge",
+}
+
+
+def generate_catalog(
+    families: Sequence[str] = tuple(_FAMILY_SPECS),
+    generations: Sequence[int] = (1, 2, 3),
+    cpus: Sequence[int] = (1, 2, 4, 8, 16, 32, 48, 64, 96, 128, 192),
+) -> List[MachineShape]:
+    """Deterministic synthetic catalog with plausible shapes/prices.
+
+    Newer generations are ~5% cheaper and have ~10% more network bandwidth,
+    giving the price-aware scheduler real structure to exploit.
+    """
+    out: List[MachineShape] = []
+    for fam in families:
+        category, mem_per_cpu, price_per_cpu, arch, accels_per_8 = _FAMILY_SPECS[fam]
+        for gen in generations:
+            for cpu in cpus:
+                if fam in ("gpu", "tpu") and cpu < 4:
+                    continue
+                price = cpu * price_per_cpu * (0.95 ** (gen - 1))
+                accel_count = (cpu // 8) * accels_per_8 if accels_per_8 else 0
+                if fam in ("gpu", "tpu"):
+                    accel_count = max(accel_count, 1)
+                is_tpu = fam == "tpu"
+                out.append(
+                    MachineShape(
+                        name=f"{fam}{gen}.{_SIZE_NAMES[cpu]}",
+                        cpu=float(cpu),
+                        memory=cpu * mem_per_cpu * 2**30,
+                        arch=arch,
+                        category=category,
+                        family=f"{fam}{gen}",
+                        generation=gen,
+                        size=_SIZE_NAMES[cpu],
+                        gpu_count=0 if is_tpu or not accel_count else accel_count,
+                        gpu_name="gpu-a" if accel_count and not is_tpu else "",
+                        tpu_chips=accel_count if is_tpu else 0,
+                        accelerator_name=f"tpu-v{4 + gen}e" if is_tpu else "",
+                        accelerator_manufacturer="tpu-vendor" if is_tpu else "",
+                        network_bandwidth=min(100.0, cpu / 4 * (1.1 ** (gen - 1))),
+                        max_pods=min(110, max(8, 3 * cpu + 2)),
+                        od_price=round(price, 5),
+                    )
+                )
+    return out

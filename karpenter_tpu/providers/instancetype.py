@@ -1,0 +1,254 @@
+"""InstanceType provider: catalog + offerings + overhead -> InstanceTypes.
+
+Re-creation of reference pkg/providers/instancetype: turns the machine-shape
+catalog, zonal offerings, live pricing, the ICE cache, and per-pool kubelet
+config into `[]InstanceType` for the scheduler.
+
+Key behaviors mirrored:
+- cache key mixes the instance-type-set and ICE-cache seqnums so offerings
+  flip availability without waiting out the 5m TTL (instancetype.go:97-104)
+- requirements vector of well-known labels per type (types.go:70-149)
+- capacity: cpu / memory (minus VM overhead percent, types.go:196-206) /
+  pods / gpu / local-nvme (types.go:171-190)
+- overhead: kubeReserved piecewise CPU curve + 11*pods+255Mi memory
+  (types.go:326-362), eviction threshold 100Mi (types.go:369-399)
+- offerings = zone x capacityType with per-offering price and availability
+  masked by the ICE cache (instancetype.go:130-158)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.api import (
+    InstanceType,
+    NodeClass,
+    NodePool,
+    Offering,
+    Offerings,
+    Overhead,
+    Requirement,
+    Requirements,
+    Resources,
+    Settings,
+)
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.cache.ttl import INSTANCE_TYPES_ZONES_TTL, TTLCache
+from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+from karpenter_tpu.cloud.fake.backend import FakeCloud, MachineShape
+from karpenter_tpu.providers.pricing import PricingProvider
+from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.utils.clock import Clock
+
+
+def kube_reserved_cpu(cpu_cores: float) -> float:
+    """Piecewise kubelet CPU reservation (reference types.go:343-362):
+    6% of the first core, 1% of the second, 0.5% of cores 3-4, 0.25% of the
+    rest."""
+    reserved = 0.0
+    remaining = cpu_cores
+    for band, frac in ((1, 0.06), (1, 0.01), (2, 0.005), (float("inf"), 0.0025)):
+        take = min(remaining, band)
+        if take <= 0:
+            break
+        reserved += take * frac
+        remaining -= take
+    return reserved
+
+
+def kube_reserved_memory(max_pods: int) -> float:
+    """11 MiB per pod + 255 MiB (reference types.go:338)."""
+    return (11 * max_pods + 255) * 2**20
+
+
+class InstanceTypeProvider:
+    def __init__(
+        self,
+        cloud: FakeCloud,
+        pricing: PricingProvider,
+        subnets: SubnetProvider,
+        unavailable: UnavailableOfferings,
+        settings: Settings,
+        clock: Clock,
+    ):
+        self.cloud = cloud
+        self.pricing = pricing
+        self.subnets = subnets
+        self.unavailable = unavailable
+        self.settings = settings
+        self._cache = TTLCache(clock, INSTANCE_TYPES_ZONES_TTL)
+        self.catalog_seq = 0  # bump when the catalog changes
+
+    # ------------------------------------------------------------------ list
+    def list(
+        self, pool: Optional[NodePool] = None, node_class: Optional[NodeClass] = None
+    ) -> List[InstanceType]:
+        """All instance types with offerings restricted to the node class's
+        resolved subnets' zones (reference instancetype.go:85-121)."""
+        zones = self._zones(node_class)
+        max_pods = pool.kubelet_max_pods if pool is not None else None
+        key = (
+            tuple(sorted(zones)),
+            max_pods,
+            self.catalog_seq,
+            self.unavailable.seq_num,
+        )
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        shapes = {s.name: s for s in self.cloud.describe_instance_types()}
+        offered = self.cloud.describe_instance_type_offerings()
+        zones_by_type: Dict[str, List[str]] = {}
+        for t, z in offered:
+            if z in zones:
+                zones_by_type.setdefault(t, []).append(z)
+        out = [
+            self._build(shape, zones_by_type.get(name, []), max_pods)
+            for name, shape in sorted(shapes.items())
+        ]
+        self._cache.set(key, out)
+        return out
+
+    def _zones(self, node_class: Optional[NodeClass]) -> List[str]:
+        if node_class is not None and node_class.subnet_selector_terms:
+            subnets = self.subnets.list(node_class)
+            return sorted({s.zone for s in subnets})
+        return list(self.cloud.zones)
+
+    # ----------------------------------------------------------------- build
+    def _build(
+        self, shape: MachineShape, zones: Sequence[str], max_pods_override: Optional[int]
+    ) -> InstanceType:
+        max_pods = (
+            max_pods_override if max_pods_override is not None else shape.max_pods
+        )
+        capacity = self._capacity(shape, max_pods)
+        overhead = Overhead(
+            kube_reserved=Resources(
+                cpu=kube_reserved_cpu(shape.cpu),
+                memory=kube_reserved_memory(max_pods),
+            ),
+            system_reserved=Resources(),
+            eviction_threshold=Resources(memory=100 * 2**20),
+        )
+        return InstanceType(
+            name=shape.name,
+            requirements=self._requirements(shape, zones),
+            capacity=capacity,
+            overhead=overhead,
+            offerings=self._offerings(shape, zones),
+        )
+
+    def _capacity(self, shape: MachineShape, max_pods: int) -> Resources:
+        q = {
+            L.RESOURCE_CPU: shape.cpu,
+            # VM overhead shaves reported memory (types.go:196-206)
+            L.RESOURCE_MEMORY: shape.memory
+            * (1 - self.settings.vm_memory_overhead_percent),
+            L.RESOURCE_PODS: float(max_pods),
+            L.RESOURCE_EPHEMERAL_STORAGE: 20 * 2**30
+            + shape.local_nvme,  # root volume + instance store
+        }
+        if shape.gpu_count:
+            q[L.RESOURCE_GPU] = float(shape.gpu_count)
+        if shape.tpu_chips:
+            q[L.RESOURCE_TPU] = float(shape.tpu_chips)
+        return Resources(q)
+
+    def _requirements(self, shape: MachineShape, zones: Sequence[str]) -> Requirements:
+        reqs = Requirements(
+            [
+                Requirement(L.LABEL_INSTANCE_TYPE, Op.IN, [shape.name]),
+                Requirement(L.LABEL_ARCH, Op.IN, [shape.arch]),
+                Requirement(L.LABEL_OS, Op.IN, [shape.os]),
+                Requirement(L.LABEL_ZONE, Op.IN, zones),
+                Requirement(L.LABEL_REGION, Op.IN, [self.cloud.region]),
+                Requirement(
+                    L.LABEL_CAPACITY_TYPE,
+                    Op.IN,
+                    [L.CAPACITY_TYPE_ON_DEMAND, L.CAPACITY_TYPE_SPOT],
+                ),
+                Requirement(L.LABEL_INSTANCE_CATEGORY, Op.IN, [shape.category]),
+                Requirement(L.LABEL_INSTANCE_FAMILY, Op.IN, [shape.family]),
+                Requirement(
+                    L.LABEL_INSTANCE_GENERATION, Op.IN, [str(shape.generation)]
+                ),
+                Requirement(L.LABEL_INSTANCE_SIZE, Op.IN, [shape.size]),
+                Requirement(L.LABEL_INSTANCE_CPU, Op.IN, [str(int(shape.cpu))]),
+                Requirement(
+                    L.LABEL_INSTANCE_MEMORY,
+                    Op.IN,
+                    [str(int(shape.memory / 2**20))],  # MiB, as the reference
+                ),
+                Requirement(
+                    L.LABEL_INSTANCE_NETWORK_BANDWIDTH,
+                    Op.IN,
+                    [str(int(shape.network_bandwidth * 1000))],  # Mbps
+                ),
+                Requirement(L.LABEL_INSTANCE_HYPERVISOR, Op.IN, [shape.hypervisor]),
+            ]
+        )
+        if shape.gpu_count:
+            reqs.add(Requirement(L.LABEL_INSTANCE_GPU_NAME, Op.IN, [shape.gpu_name]))
+            reqs.add(
+                Requirement(L.LABEL_INSTANCE_GPU_COUNT, Op.IN, [str(shape.gpu_count)])
+            )
+        if shape.tpu_chips:
+            reqs.add(
+                Requirement(
+                    L.LABEL_INSTANCE_ACCELERATOR_NAME,
+                    Op.IN,
+                    [shape.accelerator_name or "tpu"],
+                )
+            )
+            reqs.add(
+                Requirement(
+                    L.LABEL_INSTANCE_ACCELERATOR_MANUFACTURER,
+                    Op.IN,
+                    [shape.accelerator_manufacturer or "tpu-vendor"],
+                )
+            )
+            reqs.add(
+                Requirement(
+                    L.LABEL_INSTANCE_ACCELERATOR_COUNT, Op.IN, [str(shape.tpu_chips)]
+                )
+            )
+        if shape.local_nvme:
+            reqs.add(
+                Requirement(
+                    L.LABEL_INSTANCE_LOCAL_NVME,
+                    Op.IN,
+                    [str(int(shape.local_nvme / 2**30))],
+                )
+            )
+        return reqs
+
+    def _offerings(self, shape: MachineShape, zones: Sequence[str]) -> Offerings:
+        out = Offerings()
+        for zone in zones:
+            od = self.pricing.on_demand_price(shape.name)
+            if od is not None:
+                out.append(
+                    Offering(
+                        zone=zone,
+                        capacity_type=L.CAPACITY_TYPE_ON_DEMAND,
+                        price=od,
+                        available=not self.unavailable.is_unavailable(
+                            L.CAPACITY_TYPE_ON_DEMAND, shape.name, zone
+                        ),
+                    )
+                )
+            spot = self.pricing.spot_price(shape.name, zone)
+            if spot is not None:
+                out.append(
+                    Offering(
+                        zone=zone,
+                        capacity_type=L.CAPACITY_TYPE_SPOT,
+                        price=spot,
+                        available=not self.unavailable.is_unavailable(
+                            L.CAPACITY_TYPE_SPOT, shape.name, zone
+                        ),
+                    )
+                )
+        return out

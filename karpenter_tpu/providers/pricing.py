@@ -1,0 +1,52 @@
+"""Pricing provider (reference pkg/providers/pricing/pricing.go:50-143).
+
+Seeds on-demand prices from the cloud catalog at construction (the analogue
+of the compiled-in zz_generated price tables), then refreshes on demand /
+via the pricing controller: on-demand from the pricing API (GetProducts),
+spot per-zone from spot price history, with the on-demand default-price
+fallback until the first spot update (pricing.go:130-143).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from karpenter_tpu.cloud.fake.backend import FakeCloud
+
+PRICING_UPDATE_PERIOD = 12 * 3600.0  # reference pricing/controller.go:39-41
+
+
+class PricingProvider:
+    def __init__(self, cloud: FakeCloud):
+        self.cloud = cloud
+        # static seed (compiled-in table analogue)
+        self._od: Dict[str, float] = {
+            s.name: s.od_price for s in cloud.shapes.values()
+        }
+        self._spot: Dict[Tuple[str, str], float] = {}
+        self._spot_updated = False
+        self.last_update: float = 0.0
+
+    def on_demand_price(self, instance_type: str) -> Optional[float]:
+        return self._od.get(instance_type)
+
+    def spot_price(self, instance_type: str, zone: str) -> Optional[float]:
+        """Per-zone spot price; falls back to the on-demand price until the
+        first spot refresh lands (reference pricing.go:130-143)."""
+        if self._spot_updated:
+            p = self._spot.get((instance_type, zone))
+            if p is not None:
+                return p
+        return self._od.get(instance_type)
+
+    def update_on_demand(self) -> None:
+        self._od.update(self.cloud.get_products())
+        self.last_update = self.cloud.clock.now()
+
+    def update_spot(self) -> None:
+        self._spot.update(self.cloud.describe_spot_price_history())
+        self._spot_updated = True
+        self.last_update = self.cloud.clock.now()
+
+    def instance_types(self):
+        return list(self._od)

@@ -1,0 +1,318 @@
+"""The reference scheduling oracle: first-fit-decreasing simulation.
+
+Re-derivation of karpenter-core's provisioning scheduler (reference
+designs/bin-packing.md:18-42; website v0.31 concepts/scheduling.md): sort
+pending pods by descending size, place each onto (a) an existing/in-flight
+node, else (b) an open virtual node whose feasible instance-type set narrows
+as pods accumulate, else (c) a new virtual node from the highest-weight
+compatible NodePool.  Taints/tolerations, label requirements, zonal
+offerings, topology spread, and pod (anti-)affinity all constrain placement.
+
+This pure-Python packer is the correctness oracle and the <= node-count
+baseline for the batched JAX solver (scheduling/solver.py); it is also what
+consolidation reuses to simulate evicted-pod rescheduling.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from karpenter_tpu.api import (
+    InstanceType,
+    NodePool,
+    Pod,
+    Requirement,
+    Requirements,
+    Resources,
+)
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import tolerates_all
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.scheduling.topology import HOSTNAME, NEW_DOMAIN, ZONE, TopologyTracker
+from karpenter_tpu.state.cluster import StateNode
+
+_vnode_seq = itertools.count()
+
+
+def _zone_constrained(pod: Pod) -> bool:
+    """Pod carries a zone-keyed topology constraint (spread or affinity)."""
+    return any(
+        c.topology_key == ZONE
+        and c.selects(pod)
+        and c.when_unsatisfiable == "DoNotSchedule"
+        for c in pod.topology_spread
+    ) or any(t.topology_key == ZONE for t in pod.pod_affinity)
+
+
+def pod_sort_key(pod: Pod) -> Tuple:
+    """Descending-size FFD order; most-constrained (affinity/topology) pods
+    first so their narrow placements aren't crowded out."""
+    constrained = bool(pod.pod_affinity or pod.topology_spread)
+    return (
+        not constrained,
+        -pod.priority,
+        -(pod.requests.cpu + pod.requests.memory / (4 * 2**30)),
+    )
+
+
+@dataclass
+class VirtualNode:
+    """A node being composed during the solve (karpenter-core's inflight
+    scheduling.Node)."""
+
+    pool: NodePool
+    requirements: Requirements
+    feasible_types: List[InstanceType]
+    daemon_overhead: Resources
+    name: str = ""
+    pods: List[Pod] = field(default_factory=list)
+    used: Resources = field(default_factory=Resources)
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = f"vnode-{next(_vnode_seq)}"
+        self.used = self.used + self.daemon_overhead
+
+    # -- helpers -------------------------------------------------------------
+    def zone_options(self) -> Set[str]:
+        """Zones this node could still land in: zone requirement x available
+        offerings of the still-feasible types."""
+        zr = self.requirements.get(ZONE)
+        zones: Set[str] = set()
+        for t in self.feasible_types:
+            for o in t.offerings.available():
+                if zr is None or zr.has(o.zone):
+                    zones.add(o.zone)
+        return zones
+
+    def _fits_some_type(
+        self, reqs: Requirements, used: Resources
+    ) -> List[InstanceType]:
+        out = []
+        for t in self.feasible_types:
+            if not t.requirements.compatible(reqs, allow_undefined=True):
+                continue
+            if not used.fits(t.allocatable()):
+                continue
+            if not t.offerings.available().compatible(reqs):
+                continue
+            out.append(t)
+        return out
+
+    def try_add(self, pod: Pod, topology: TopologyTracker) -> bool:
+        if not tolerates_all(pod.tolerations, self.pool.taints):
+            return False
+        reqs = Requirements(iter(self.requirements))
+        for r in pod.scheduling_requirements():
+            reqs.add(r)
+        if reqs.is_unsatisfiable():
+            return False
+
+        # topology: hostname-keyed constraints treat this node as a domain;
+        # a node with no pods yet is a fresh domain (NEW_DOMAIN)
+        host_allowed = topology.allowed_domains(pod, HOSTNAME)
+        if host_allowed is not None and self.name not in host_allowed:
+            if not (NEW_DOMAIN in host_allowed and not self.pods):
+                return False
+        # zone-keyed constraints narrow the node's zone choice; any pod
+        # carrying one must PIN a zone so the placement is counted/anchored
+        # (first affinity pod anchors the domain for followers)
+        zone_choice: Optional[str] = None
+        if _zone_constrained(pod) or topology.selected_by_group(pod, ZONE):
+            zone_allowed = topology.allowed_domains(pod, ZONE)
+            options = self.zone_options()
+            if zone_allowed is not None:
+                options &= zone_allowed
+            zr = reqs.get(ZONE)
+            if zr is not None:
+                options = {z for z in options if zr.has(z)}
+            if not options:
+                return False
+            zone_choice = topology.preferred_domain(pod, ZONE, options)
+            reqs.add(Requirement(ZONE, Op.IN, [zone_choice]))
+
+        new_used = self.used + pod.requests
+        feasible = self._fits_some_type(reqs, new_used)
+        if not feasible:
+            return False
+
+        # commit
+        self.requirements = reqs
+        self.feasible_types = feasible
+        self.used = new_used
+        self.pods.append(pod)
+        domains = {HOSTNAME: self.name}
+        if zone_choice is not None:
+            domains[ZONE] = zone_choice
+        elif (zr := reqs.get(ZONE)) is not None and (v := zr.any_value()) is not None:
+            # node already pinned to one zone: placements count against it
+            opts = self.zone_options()
+            if len(opts) == 1:
+                domains[ZONE] = next(iter(opts))
+        topology.record(pod, domains)
+        return True
+
+    def cheapest_price(self) -> float:
+        return min(
+            (t.cheapest_price(self.requirements) for t in self.feasible_types),
+            default=float("inf"),
+        )
+
+    def final_instance_types(self) -> List[InstanceType]:
+        """Feasible types, price-ascending (reference
+        pkg/providers/instance/instance.go:391-408)."""
+        return sorted(self.feasible_types, key=lambda t: t.cheapest_price(self.requirements))
+
+
+@dataclass
+class ExistingNode:
+    """An already-running (or in-flight) node considered for placements."""
+
+    state: StateNode
+    used: Resources
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.state.name
+
+    def try_add(self, pod: Pod, topology: TopologyTracker) -> bool:
+        if self.state.marked_for_deletion() or (
+            self.state.node is not None and self.state.node.cordoned
+        ):
+            return False
+        if not tolerates_all(pod.tolerations, self.state.taints):
+            return False
+        node_reqs = Requirements.from_labels(self.state.labels)
+        if not node_reqs.compatible(pod.scheduling_requirements()):
+            return False
+        if not (self.used + pod.requests).fits(self.state.allocatable):
+            return False
+        host_allowed = topology.allowed_domains(pod, HOSTNAME)
+        if host_allowed is not None and self.name not in host_allowed:
+            return False
+        zone_allowed = topology.allowed_domains(pod, ZONE)
+        zone = self.state.zone
+        if zone_allowed is not None and zone and zone not in zone_allowed:
+            return False
+        self.used = self.used + pod.requests
+        self.pods.append(pod)
+        domains = {HOSTNAME: self.name}
+        if zone:
+            domains[ZONE] = zone
+        topology.record(pod, domains)
+        return True
+
+
+@dataclass
+class SchedulingResult:
+    new_nodes: List[VirtualNode] = field(default_factory=list)
+    existing_placements: Dict[str, str] = field(default_factory=dict)  # pod -> node
+    unschedulable: Dict[str, str] = field(default_factory=dict)  # pod -> reason
+
+    def node_count(self) -> int:
+        return len(self.new_nodes)
+
+    def total_price(self) -> float:
+        return sum(n.cheapest_price() for n in self.new_nodes)
+
+
+class Scheduler:
+    """One scheduling solve over a pod batch (the oracle path)."""
+
+    def __init__(
+        self,
+        pools: Sequence[NodePool],
+        instance_types: Dict[str, List[InstanceType]],  # pool name -> types
+        existing: Sequence[StateNode] = (),
+        daemonsets: Sequence[Pod] = (),
+        zones: Sequence[str] = (),
+    ):
+        # highest weight first (reference designs/provisioner-priority.md)
+        self.pools = sorted(
+            (p for p in pools if not p.deleted), key=lambda p: -p.weight
+        )
+        self.instance_types = instance_types
+        self.daemonsets = list(daemonsets)
+        zones = set(zones)
+        for types in instance_types.values():
+            for t in types:
+                zones.update(o.zone for o in t.offerings)
+        self.topology = TopologyTracker(sorted(zones))
+        self.existing = [ExistingNode(sn, used=sn.used) for sn in existing]
+        # every existing node is a hostname domain even while empty
+        self.topology.universe.setdefault(HOSTNAME, set()).update(
+            en.name for en in self.existing
+        )
+        # seed topology with already-bound pods
+        for en in self.existing:
+            for pod in en.state.pods:
+                domains = {HOSTNAME: en.name}
+                if en.state.zone:
+                    domains[ZONE] = en.state.zone
+                self.topology.record(pod, domains)
+
+    # ------------------------------------------------------------------ solve
+    def solve(self, pods: Iterable[Pod]) -> SchedulingResult:
+        result = SchedulingResult()
+        for pod in sorted(pods, key=pod_sort_key):
+            if self._schedule_existing(pod, result):
+                continue
+            if self._schedule_open_vnode(pod, result):
+                continue
+            reason = self._schedule_new_vnode(pod, result)
+            if reason is not None:
+                result.unschedulable[pod.key()] = reason
+        return result
+
+    def _schedule_existing(self, pod: Pod, result: SchedulingResult) -> bool:
+        for en in self.existing:
+            if en.try_add(pod, self.topology):
+                result.existing_placements[pod.key()] = en.name
+                return True
+        return False
+
+    def _schedule_open_vnode(self, pod: Pod, result: SchedulingResult) -> bool:
+        return any(vn.try_add(pod, self.topology) for vn in result.new_nodes)
+
+    def _schedule_new_vnode(self, pod: Pod, result: SchedulingResult) -> Optional[str]:
+        reason = "no nodepool matched pod constraints"
+        for pool in self.pools:
+            types = self.instance_types.get(pool.name, [])
+            if not types:
+                reason = f"nodepool {pool.name} has no instance types"
+                continue
+            vn = self._new_vnode(pool, types)
+            if vn.try_add(pod, self.topology):
+                result.new_nodes.append(vn)
+                return None
+            reason = "pod incompatible with every instance type / offering"
+        return reason
+
+    def _new_vnode(self, pool: NodePool, types: List[InstanceType]) -> VirtualNode:
+        reqs = pool.template_requirements()
+        feasible = [
+            t for t in types if t.requirements.compatible(reqs, allow_undefined=True)
+        ]
+        overhead = self._daemon_overhead(pool, reqs)
+        return VirtualNode(
+            pool=pool,
+            requirements=reqs,
+            feasible_types=feasible,
+            daemon_overhead=overhead,
+        )
+
+    def _daemon_overhead(self, pool: NodePool, reqs: Requirements) -> Resources:
+        """Daemonset pods that will land on any node of this pool charge
+        their requests up front (karpenter-core does the same per-node
+        daemonset overhead computation)."""
+        out = Resources()
+        for d in self.daemonsets:
+            if not tolerates_all(d.tolerations, pool.taints):
+                continue
+            if not reqs.compatible(d.scheduling_requirements()):
+                continue
+            out = out + d.requests
+        return out

@@ -1,0 +1,192 @@
+"""Topology constraint tracking across one scheduling solve.
+
+Re-creation of karpenter-core's topology group machinery (observed behavior
+documented at reference website v0.31 concepts/scheduling.md:124-430):
+
+- topologySpreadConstraints: per (topologyKey, selector) domain counts over
+  existing + in-flight placements; a pod may only land in domains whose
+  count <= min(count) + maxSkew - 1.
+- required pod affinity: pod must land in a domain that holds (or will
+  hold) a matching pod; the first matching placement anchors the domain.
+- required pod anti-affinity: pod must avoid every domain holding a
+  matching pod.
+
+Hostname-keyed constraints treat every node (virtual or real) as its own
+domain.  Zone-keyed constraints use the zone label.  Groups are created
+lazily at query time and initialized by replaying the placement log, so
+counts always reflect every pod recorded so far regardless of creation
+order.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from karpenter_tpu.api import Pod, PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.api import labels as L
+
+HOSTNAME = L.LABEL_HOSTNAME
+ZONE = L.LABEL_ZONE
+
+# sentinel domain meaning "a brand-new domain may be opened" (hostname keys)
+NEW_DOMAIN = "*new*"
+
+
+def _selector_key(sel: Tuple[Tuple[str, str], ...]) -> Tuple:
+    return tuple(sorted(sel))
+
+
+@dataclass
+class _SpreadGroup:
+    constraint: TopologySpreadConstraint
+    counts: Dict[str, int] = field(default_factory=lambda: defaultdict(int))
+
+    def allowed(self, universe: Iterable[str], allow_new: bool) -> Set[str]:
+        """Domains a selected pod may enter without exceeding max_skew.
+
+        Skew is measured against the global minimum: a domain with no pods
+        counts as 0, so whenever any domain sits at 0 the ceiling is
+        maxSkew-1... i.e. `count <= min + maxSkew - 1` after placement.
+        """
+        known = {d: self.counts.get(d, 0) for d in universe}
+        floor = min(known.values(), default=0)
+        if allow_new:
+            floor = min(floor, 0)
+        limit = floor + self.constraint.max_skew - 1
+        out = {d for d, c in known.items() if c <= limit}
+        if allow_new and 0 <= limit:
+            out.add(NEW_DOMAIN)
+        return out
+
+
+@dataclass
+class _AffinityGroup:
+    """Domains holding pods matched by one (anti-)affinity selector."""
+
+    term: PodAffinityTerm
+    domains: Set[str] = field(default_factory=set)
+
+
+class TopologyTracker:
+    """Shared mutable state for one solve.
+
+    `universe[key]` enumerates the candidate domains for a topology key
+    (zones come from the inventory; hostnames are open-ended).
+    """
+
+    def __init__(self, zones: Sequence[str] = ()):
+        self.universe: Dict[str, Set[str]] = {ZONE: set(zones)}
+        self._spread: Dict[Tuple, _SpreadGroup] = {}
+        self._affinity: Dict[Tuple, _AffinityGroup] = {}
+        self._placements: List[Tuple[Pod, Dict[str, str]]] = []
+
+    # -- group creation (lazy, replaying history) ----------------------------
+    def _spread_group(self, c: TopologySpreadConstraint) -> _SpreadGroup:
+        key = ("s", c.topology_key, _selector_key(c.label_selector), c.max_skew)
+        g = self._spread.get(key)
+        if g is None:
+            g = _SpreadGroup(c)
+            for pod, domains in self._placements:
+                if c.selects(pod) and c.topology_key in domains:
+                    g.counts[domains[c.topology_key]] += 1
+            self._spread[key] = g
+        return g
+
+    def _affinity_group(self, t: PodAffinityTerm) -> _AffinityGroup:
+        key = ("a", t.topology_key, _selector_key(t.label_selector), t.namespaces)
+        g = self._affinity.get(key)
+        if g is None:
+            g = _AffinityGroup(t)
+            for pod, domains in self._placements:
+                if t.selects(pod) and t.topology_key in domains:
+                    g.domains.add(domains[t.topology_key])
+            self._affinity[key] = g
+        return g
+
+    # -- queries -------------------------------------------------------------
+    def allowed_domains(self, pod: Pod, key: str) -> Optional[Set[str]]:
+        """Intersection of all constraints' allowed domains for `pod` on
+        topology `key`.  None = unconstrained.  NEW_DOMAIN membership means a
+        fresh domain (a new node, for hostname keys) is acceptable."""
+        allow_new = key == HOSTNAME
+        universe = self.universe.get(key, set())
+        result: Optional[Set[str]] = None
+
+        for c in pod.topology_spread:
+            if c.topology_key != key or not c.selects(pod):
+                continue
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue  # ScheduleAnyway is soft; best-effort only
+            allowed = self._spread_group(c).allowed(universe, allow_new)
+            result = allowed if result is None else (result & allowed)
+
+        for t in pod.pod_affinity:
+            if t.topology_key != key:
+                continue
+            g = self._affinity_group(t)
+            if t.anti:
+                # anti-affinity constrains the incoming pod away from domains
+                # with matching pods; symmetric self-exclusion is covered
+                # because a self-selecting pod's own placements land in g.
+                banned = set(g.domains)
+                if banned or t.selects(pod):
+                    cand = (universe - banned) | ({NEW_DOMAIN} if allow_new else set())
+                    result = cand if result is None else (result & cand)
+            else:
+                if g.domains:
+                    result = set(g.domains) if result is None else (result & g.domains)
+                # else: no matching pod anywhere yet — first pod anchors the
+                # domain, unconstrained on this term.
+        return result
+
+    def selected_by_group(self, pod: Pod, key: str) -> bool:
+        """Whether any REGISTERED group on `key` counts this pod as a member.
+
+        Pods selected by someone else's spread/affinity selector must have
+        their domain pinned at placement time so the group's counts stay
+        sound — even when the pod carries no constraint of its own.
+        """
+        return any(
+            g.constraint.topology_key == key and g.constraint.selects(pod)
+            for g in self._spread.values()
+        ) or any(
+            g.term.topology_key == key and g.term.selects(pod)
+            for g in self._affinity.values()
+        )
+
+    def preferred_domain(self, pod: Pod, key: str, candidates: Set[str]) -> str:
+        """Pick the candidate domain with the lowest aggregate spread count
+        over every group that counts this pod (own constraints or membership
+        in others') — keeps skew balanced; deterministic tie-break by name."""
+
+        # make sure the pod's own groups exist, then count each group once
+        for c in pod.topology_spread:
+            if c.topology_key == key and c.selects(pod):
+                self._spread_group(c)
+
+        def load(d: str) -> int:
+            return sum(
+                g.counts.get(d, 0)
+                for g in self._spread.values()
+                if g.constraint.topology_key == key and g.constraint.selects(pod)
+            )
+
+        return min(sorted(candidates), key=load)
+
+    # -- recording -----------------------------------------------------------
+    def record(self, pod: Pod, domains: Dict[str, str]) -> None:
+        """Record a placement: `domains` maps topology key -> chosen domain
+        (e.g. {zone: 'zone-a', hostname: 'node-3'})."""
+        self._placements.append((pod, dict(domains)))
+        for key, domain in domains.items():
+            self.universe.setdefault(key, set()).add(domain)
+        for g in self._spread.values():
+            c = g.constraint
+            if c.selects(pod) and c.topology_key in domains:
+                g.counts[domains[c.topology_key]] += 1
+        for g in self._affinity.values():
+            t = g.term
+            if t.selects(pod) and t.topology_key in domains:
+                g.domains.add(domains[t.topology_key])

@@ -1,0 +1,152 @@
+"""In-memory cluster state: the scheduling snapshot.
+
+Analogue of karpenter-core's `state.Cluster` (instantiated at reference
+cmd/controller/main.go:49-55): a cache over nodes + bound pods that the
+provisioner and deprovisioner consult.  Where the reference incrementally
+maintains it from informer events, we rebuild the snapshot from the
+KubeStore on demand (cheap at our scale) plus track in-flight NodeClaims
+that have no Node yet — those still reserve capacity against scheduling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_tpu.api import NodeClaim, Pod, Resources, Taint
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.state.kube import KubeStore, Node
+
+
+@dataclass
+class StateNode:
+    """A node (or not-yet-registered claim) with its live usage."""
+
+    name: str
+    provider_id: str
+    labels: Dict[str, str]
+    taints: List[Taint]
+    allocatable: Resources
+    capacity: Resources = field(default_factory=Resources)
+    pods: List[Pod] = field(default_factory=list)
+    used: Resources = field(default_factory=Resources)
+    node: Optional[Node] = None
+    claim: Optional[NodeClaim] = None
+    nominated: bool = False  # has in-flight pod reservations
+
+    @property
+    def registered(self) -> bool:
+        return self.node is not None
+
+    @property
+    def initialized(self) -> bool:
+        return self.claim is not None and self.claim.initialized or (
+            self.claim is None and self.node is not None and self.node.ready
+        )
+
+    @property
+    def pool_name(self) -> str:
+        return self.labels.get(L.LABEL_NODEPOOL, "")
+
+    @property
+    def capacity_type(self) -> str:
+        return self.labels.get(L.LABEL_CAPACITY_TYPE, L.CAPACITY_TYPE_ON_DEMAND)
+
+    @property
+    def zone(self) -> str:
+        return self.labels.get(L.LABEL_ZONE, "")
+
+    @property
+    def instance_type_name(self) -> str:
+        return self.labels.get(L.LABEL_INSTANCE_TYPE, "")
+
+    def available(self) -> Resources:
+        return (self.allocatable - self.used).clamp_nonnegative()
+
+    def marked_for_deletion(self) -> bool:
+        return (self.node is not None and self.node.deleted_at is not None) or (
+            self.claim is not None and self.claim.deleted_at is not None
+        )
+
+
+class Cluster:
+    """Snapshot builder + nomination ledger.
+
+    Nominations (pods the provisioner has decided to place on an in-flight
+    node) prevent double-provisioning between the launch and the kube
+    scheduler binding the pod — the reference tracks these the same way in
+    state.Cluster's podNominations.
+    """
+
+    def __init__(self, kube: KubeStore):
+        self.kube = kube
+        self._nominations: Dict[str, str] = {}  # pod key -> node/claim name
+
+    def nominate(self, pod_key: str, node_name: str) -> None:
+        self._nominations[pod_key] = node_name
+
+    def clear_nomination(self, pod_key: str) -> None:
+        self._nominations.pop(pod_key, None)
+
+    def nominated_node(self, pod_key: str) -> Optional[str]:
+        return self._nominations.get(pod_key)
+
+    def snapshot(self) -> List[StateNode]:
+        nodes: Dict[str, StateNode] = {}
+        claims_by_provider = {
+            c.provider_id: c for c in self.kube.node_claims.values() if c.provider_id
+        }
+        for n in self.kube.nodes.values():
+            claim = claims_by_provider.get(n.provider_id)
+            nodes[n.name] = StateNode(
+                name=n.name,
+                provider_id=n.provider_id,
+                labels=dict(n.labels),
+                taints=list(n.taints),
+                allocatable=n.allocatable,
+                capacity=n.capacity,
+                node=n,
+                claim=claim,
+            )
+        # in-flight claims (launched, not yet registered as Nodes)
+        registered_provider_ids = {n.provider_id for n in self.kube.nodes.values()}
+        for c in self.kube.node_claims.values():
+            if c.provider_id and c.provider_id in registered_provider_ids:
+                continue
+            nodes[c.name] = StateNode(
+                name=c.name,
+                provider_id=c.provider_id,
+                labels=dict(c.labels),
+                taints=list(c.taints),
+                allocatable=c.allocatable,
+                capacity=c.capacity,
+                claim=c,
+            )
+        # charge bound pods
+        for p in self.kube.pods.values():
+            if p.node_name and p.node_name in nodes:
+                sn = nodes[p.node_name]
+                sn.pods.append(p)
+                sn.used = sn.used + p.requests
+        # charge nominated (in-flight) pods
+        for pod_key, node_name in list(self._nominations.items()):
+            pod = self.kube.pods.get(pod_key)
+            sn = nodes.get(node_name)
+            if pod is None or pod.node_name or sn is None:
+                # nomination resolved or stale; drop it
+                self._nominations.pop(pod_key, None)
+                continue
+            sn.pods.append(pod)
+            sn.used = sn.used + pod.requests
+            sn.nominated = True
+        return list(nodes.values())
+
+    def pool_usage(self, pool_name: str) -> Resources:
+        """Total capacity consumed by a pool (for NodePool.limits
+        enforcement; reference designs/limits.md).  Uses node capacity
+        uniformly regardless of how the node joined (claim or adoption)."""
+        out = Resources()
+        for sn in self.snapshot():
+            if sn.pool_name == pool_name and not sn.marked_for_deletion():
+                out = out + (sn.capacity if sn.capacity else sn.allocatable)
+        return out

@@ -1,0 +1,140 @@
+"""In-memory kube-apiserver analogue.
+
+The reference keeps all durable state in the kube-apiserver (CRDs:
+NodePool/Provisioner, NodeClaim/Machine, EC2NodeClass) — SURVEY.md section 5
+"checkpoint/resume: none needed".  We mirror that: this store is the single
+source of durable truth; caches elsewhere are reconstructable from it.  Its
+test role matches controller-runtime envtest in the reference suites
+(pkg/cloudprovider/suite_test.go:64-78).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from karpenter_tpu.api import NodeClaim, NodeClass, NodePool, Pod, Resources, Taint
+
+
+@dataclass
+class Node:
+    """A registered cluster node (the v1.Node analogue)."""
+
+    name: str
+    provider_id: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    capacity: Resources = field(default_factory=Resources)
+    allocatable: Resources = field(default_factory=Resources)
+    ready: bool = False
+    created_at: float = 0.0
+    deleted_at: Optional[float] = None
+    cordoned: bool = False
+
+
+class KubeStore:
+    """Typed object store with the handful of list/get/delete verbs the
+    controllers need, plus simple event hooks for cache invalidation."""
+
+    def __init__(self):
+        self.pods: Dict[str, Pod] = {}  # key: ns/name
+        self.nodes: Dict[str, Node] = {}
+        self.node_claims: Dict[str, NodeClaim] = {}
+        self.node_pools: Dict[str, NodePool] = {}
+        self.node_classes: Dict[str, NodeClass] = {}
+        self.events: List[tuple] = []  # (kind, reason, obj_name, message)
+        self._watchers: List[Callable[[str, str, object], None]] = []
+        self._seq = itertools.count(1)
+
+    # -- watch hooks ---------------------------------------------------------
+    def watch(self, fn: Callable[[str, str, object], None]) -> None:
+        """fn(kind, verb, obj) on every mutation."""
+        self._watchers.append(fn)
+
+    def _notify(self, kind: str, verb: str, obj) -> None:
+        for fn in self._watchers:
+            fn(kind, verb, obj)
+
+    # -- pods ----------------------------------------------------------------
+    def put_pod(self, pod: Pod) -> Pod:
+        self.pods[pod.key()] = pod
+        self._notify("Pod", "put", pod)
+        return pod
+
+    def delete_pod(self, key: str) -> None:
+        pod = self.pods.pop(key, None)
+        if pod is not None:
+            self._notify("Pod", "delete", pod)
+
+    def pending_pods(self) -> List[Pod]:
+        return [
+            p for p in self.pods.values() if p.phase == "Pending" and not p.node_name
+        ]
+
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        return [p for p in self.pods.values() if p.node_name == node_name]
+
+    def bind_pod(self, key: str, node_name: str) -> None:
+        pod = self.pods[key]
+        pod.node_name = node_name
+        pod.phase = "Running"
+        self._notify("Pod", "bind", pod)
+
+    # -- nodes ---------------------------------------------------------------
+    def put_node(self, node: Node) -> Node:
+        self.nodes[node.name] = node
+        self._notify("Node", "put", node)
+        return node
+
+    def delete_node(self, name: str) -> None:
+        node = self.nodes.pop(name, None)
+        if node is not None:
+            for p in self.pods_on_node(name):
+                # pods on a deleted node go back to pending (controller-owned
+                # pods are recreated by their controller in a real cluster)
+                p.node_name = ""
+                p.phase = "Pending"
+            self._notify("Node", "delete", node)
+
+    def node_by_provider_id(self, provider_id: str) -> Optional[Node]:
+        for n in self.nodes.values():
+            if n.provider_id == provider_id:
+                return n
+        return None
+
+    # -- node claims ---------------------------------------------------------
+    def put_node_claim(self, claim: NodeClaim) -> NodeClaim:
+        self.node_claims[claim.name] = claim
+        self._notify("NodeClaim", "put", claim)
+        return claim
+
+    def delete_node_claim(self, name: str) -> None:
+        claim = self.node_claims.pop(name, None)
+        if claim is not None:
+            self._notify("NodeClaim", "delete", claim)
+
+    def claim_by_provider_id(self, provider_id: str) -> Optional[NodeClaim]:
+        for c in self.node_claims.values():
+            if c.provider_id == provider_id:
+                return c
+        return None
+
+    # -- pools / classes -----------------------------------------------------
+    def put_node_pool(self, pool: NodePool) -> NodePool:
+        self.node_pools[pool.name] = pool
+        self._notify("NodePool", "put", pool)
+        return pool
+
+    def put_node_class(self, nc: NodeClass) -> NodeClass:
+        self.node_classes[nc.name] = nc
+        self._notify("NodeClass", "put", nc)
+        return nc
+
+    def get_node_class(self, name: str) -> Optional[NodeClass]:
+        return self.node_classes.get(name)
+
+    # -- events --------------------------------------------------------------
+    def record_event(self, kind: str, reason: str, obj_name: str, message: str = ""):
+        self.events.append((kind, reason, obj_name, message))

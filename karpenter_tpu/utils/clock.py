@@ -1,0 +1,32 @@
+"""Clock abstraction: real and fake (reference uses k8s.io/utils/clock's
+FakeClock in every suite, e.g. pkg/cloudprovider/suite_test.go:71, to control
+TTL/expiry; we keep the same test shape)."""
+
+from __future__ import annotations
+
+import time as _time
+
+
+class Clock:
+    def now(self) -> float:
+        return _time.time()
+
+    def sleep(self, seconds: float) -> None:
+        _time.sleep(seconds)
+
+
+class FakeClock(Clock):
+    """Manually-stepped clock.  `sleep` advances time instead of blocking so
+    controller loops run instantly under test."""
+
+    def __init__(self, start: float = 1_700_000_000.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self._now += seconds
+
+    def step(self, seconds: float) -> None:
+        self._now += seconds

