@@ -1,0 +1,12 @@
+"""Device-side kernels and constraint compilation for the scheduling solver."""
+
+from karpenter_tpu.ops.packer import PackResult, pack_kernel, run_pack
+from karpenter_tpu.ops.tensorize import CompiledProblem, compile_problem
+
+__all__ = [
+    "CompiledProblem",
+    "compile_problem",
+    "PackResult",
+    "pack_kernel",
+    "run_pack",
+]

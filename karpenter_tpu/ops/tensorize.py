@@ -1,0 +1,549 @@
+"""Constraint compilation: pods x pools x instance-types -> dense tensors.
+
+This is the front-end of the TPU scheduling solver.  The reference computes
+feasibility pod-by-pod inside the FFD loop (karpenter-core bin-packing,
+reference designs/bin-packing.md:18-42, with the instance-type pre-filter at
+pkg/cloudprovider/cloudprovider.go:296-307).  We instead *compile* the
+problem once per solve:
+
+- **Pod classes** (axis G): pods grouped by (constraint signature, resource
+  vector).  Pods in a class are interchangeable, so the packer places whole
+  classes at once — the key to sub-200ms solves at 10k pods.
+- **Node configs** (axis C): every launchable (pool, instance-type, zone,
+  capacity-type) combination with an available offering, plus one row per
+  existing node.  Each row carries an allocatable-resource vector (minus the
+  pool's daemonset overhead) and a price.
+- **Feasibility** `feas[G, C]`: computed EXACTLY with the Requirements
+  algebra (api/requirements.py) — pool taints vs tolerations, the merged
+  (pool ∧ pod) requirement conjunction vs the type's catalog labels, zone
+  and capacity-type admission, offering availability (ICE cache already
+  masked upstream by the instance-type provider).
+
+The resulting `CompiledProblem` is pure numpy; `ops/packer.py` moves it to
+device and runs the packing scan under jit.
+
+Constraint coverage: the tensor path handles resource requests, node
+selectors/affinity, taints/tolerations, zonal offerings, capacity types,
+self-selecting hostname anti-affinity (max 1 per node), hostname topology
+spread (max `maxSkew` per node while any empty node exists — exact in the
+scale-out regime), and zone topology spread (classes split across allowed
+zones, balanced against already-placed counts).  Anything else — inter-class
+pod affinity, zone-keyed anti-affinity — is reported via
+``unsupported_reason`` and the caller falls back to the pure-Python oracle
+(scheduling/scheduler.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.api import (
+    InstanceType,
+    NodePool,
+    Pod,
+    Requirement,
+    Requirements,
+    Resources,
+)
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import tolerates_all
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.state.cluster import StateNode
+
+# Resource canonical axes.  Byte-denominated axes are scaled to MiB so every
+# quantity fits comfortably in float32 (f32 has a 24-bit mantissa; bytes
+# counts overflow its integer range, MiB counts do not).
+_MIB = 2.0**20
+_SCALE = {L.RESOURCE_MEMORY: _MIB, L.RESOURCE_EPHEMERAL_STORAGE: _MIB}
+
+BIG = 2**30  # "unbounded" per-node pod cap
+
+
+def _axes_for(pods: Sequence[Pod]) -> Tuple[str, ...]:
+    extra = sorted(
+        {k for p in pods for k in p.requests.keys()} - set(L.WELL_KNOWN_RESOURCES)
+    )
+    return tuple(L.WELL_KNOWN_RESOURCES) + tuple(extra)
+
+
+def _vec(r: Resources, axes: Sequence[str]) -> np.ndarray:
+    return np.array(
+        [r.get(a) / _SCALE.get(a, 1.0) for a in axes], dtype=np.float32
+    )
+
+
+# ---------------------------------------------------------------------------
+# Compiled problem
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ConfigMeta:
+    """Host-side description of one node-config row (C axis)."""
+
+    pool: Optional[NodePool]
+    instance_type: Optional[InstanceType]
+    zone: str
+    capacity_type: str
+    price: float
+    existing: Optional[StateNode] = None  # set for existing-node rows
+
+
+@dataclass
+class ClassMeta:
+    """Host-side description of one pod class (G axis)."""
+
+    pods: List[Pod]
+    requests: Resources
+    signature: Tuple
+    zone_pin: str = ""  # non-empty when the class was split by zone spread
+    max_per_node: int = BIG
+    track_slot: int = 0  # sig-count slot for anti-affinity/hostname-spread
+
+
+@dataclass
+class CompiledProblem:
+    axes: Tuple[str, ...]
+    classes: List[ClassMeta]
+    configs: List[ConfigMeta]
+    # class tensors [G]
+    req: np.ndarray  # [G, R] float32
+    cnt: np.ndarray  # [G] int32
+    maxper: np.ndarray  # [G] int32
+    slot: np.ndarray  # [G] int32  (anti-affinity tracking slot)
+    # config tensors [C]
+    alloc: np.ndarray  # [C, R] float32 (minus pool daemonset overhead)
+    price: np.ndarray  # [C] float32
+    openable: np.ndarray  # [C] bool (False for existing-node rows)
+    feas: np.ndarray  # [G, C] bool
+    # per-pool daemonset overhead (already subtracted from alloc rows;
+    # decode adds it back onto each new node's `used`)
+    pool_daemon_overhead: Dict[str, Resources]
+    # existing-node prefill
+    used0: np.ndarray  # [E, R] float32
+    cfg0: np.ndarray  # [E] int32 (config row index)
+    npods0: np.ndarray  # [E] int32 — pods already bound per existing node
+    sig_used0: np.ndarray  # [S, E] int32 — tracked-signature counts per node
+    n_track_slots: int = 1
+    unsupported_reason: str = ""
+
+    @property
+    def supported(self) -> bool:
+        return not self.unsupported_reason
+
+    def total_pods(self) -> int:
+        return int(self.cnt.sum())
+
+
+# ---------------------------------------------------------------------------
+# Support detection
+# ---------------------------------------------------------------------------
+
+
+def _unsupported_reason(pods: Sequence[Pod]) -> str:
+    """Constraint shapes the tensor kernel cannot express yet.
+
+    Cross-class coupling (pod affinity; anti-affinity whose selector reaches
+    other pods) needs the anchoring logic of the oracle
+    (scheduling/topology.py); everything else compiles to masks.
+    """
+    for p in pods:
+        for t in p.pod_affinity:
+            if not t.anti:
+                return "required pod affinity needs domain anchoring"
+            if t.topology_key != L.LABEL_HOSTNAME:
+                return f"anti-affinity on topology key {t.topology_key}"
+            if not t.selects(p):
+                return "anti-affinity selector reaching other pods"
+        for c in p.topology_spread:
+            if c.topology_key not in (L.LABEL_HOSTNAME, L.LABEL_ZONE):
+                return f"topology spread on key {c.topology_key}"
+    # anti-affinity selectors must not couple distinct classes
+    sigs: Dict[Tuple, Pod] = {}
+    for p in pods:
+        sigs.setdefault(p.constraint_signature(), p)
+    reps = list(sigs.values())
+    for a in reps:
+        for t in a.pod_affinity:
+            for b in reps:
+                if b.constraint_signature() != a.constraint_signature() and t.selects(b):
+                    return "anti-affinity coupling distinct pod classes"
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# Compilation
+# ---------------------------------------------------------------------------
+
+
+def _max_per_node(pod: Pod) -> int:
+    """Per-node cap induced by hostname-keyed constraints.
+
+    Self-selecting hostname anti-affinity = 1 pod per node (the 500-node
+    scale config, reference test/suites/scale/provisioning_test.go:92-135).
+    Hostname spread with maxSkew m allows at most m per node while any
+    empty candidate node exists — exact during scale-out.
+    """
+    cap = BIG
+    for t in pod.pod_affinity:
+        if t.anti and t.topology_key == L.LABEL_HOSTNAME and t.selects(pod):
+            cap = 1
+    for c in pod.topology_spread:
+        if (
+            c.topology_key == L.LABEL_HOSTNAME
+            and c.selects(pod)
+            and c.when_unsatisfiable == "DoNotSchedule"
+        ):
+            cap = min(cap, c.max_skew)
+    return cap
+
+
+def _zone_spread_zones(pod: Pod) -> bool:
+    return any(
+        c.topology_key == L.LABEL_ZONE
+        and c.selects(pod)
+        and c.when_unsatisfiable == "DoNotSchedule"
+        for c in pod.topology_spread
+    )
+
+
+def _daemon_overhead(
+    pool: NodePool, reqs: Requirements, daemonsets: Sequence[Pod]
+) -> Resources:
+    out = Resources()
+    for d in daemonsets:
+        if not tolerates_all(d.tolerations, pool.taints):
+            continue
+        if not reqs.compatible(d.scheduling_requirements()):
+            continue
+        out = out + d.requests
+    return out
+
+
+def compile_problem(
+    pods: Sequence[Pod],
+    pools: Sequence[NodePool],
+    instance_types: Dict[str, List[InstanceType]],
+    existing: Sequence[StateNode] = (),
+    daemonsets: Sequence[Pod] = (),
+) -> CompiledProblem:
+    """Compile one scheduling problem to tensors."""
+    pods = list(pods)
+    axes = _axes_for(pods)
+    reason = _unsupported_reason(pods)
+    pools = sorted((p for p in pools if not p.deleted), key=lambda p: -p.weight)
+
+    # ------------------------------------------------------------- configs
+    configs: List[ConfigMeta] = []
+    pool_overhead: Dict[str, Resources] = {}
+    for pool in pools:
+        treqs = pool.template_requirements()
+        pool_overhead[pool.name] = _daemon_overhead(pool, treqs, daemonsets)
+        for it in instance_types.get(pool.name, []):
+            for off in it.offerings.available():
+                configs.append(
+                    ConfigMeta(
+                        pool=pool,
+                        instance_type=it,
+                        zone=off.zone,
+                        capacity_type=off.capacity_type,
+                        price=off.price,
+                    )
+                )
+    first_existing = len(configs)
+    live = [
+        sn
+        for sn in existing
+        if not sn.marked_for_deletion()
+        and not (sn.node is not None and sn.node.cordoned)
+    ]
+    for sn in live:
+        configs.append(
+            ConfigMeta(
+                pool=None,
+                instance_type=None,
+                zone=sn.zone,
+                capacity_type=sn.capacity_type,
+                price=0.0,
+                existing=sn,
+            )
+        )
+
+    # ------------------------------------------------------------- classes
+    # signatures first (feasibility is per signature), then resource classes
+    zones_by_sig: Dict[Tuple, List[str]] = {}
+    all_zones = sorted(
+        {c.zone for c in configs if c.zone}
+        | {sn.zone for sn in live if sn.zone}
+    )
+    groups: Dict[Tuple, List[Pod]] = {}
+    for p in pods:
+        groups.setdefault((p.constraint_signature(), p.requests), []).append(p)
+
+    classes: List[ClassMeta] = []
+    track_slots: Dict[Tuple, int] = {}
+    spread_keys_seen: Dict[Tuple, List[Pod]] = {}
+    for (sig, requests), members in groups.items():
+        rep = members[0]
+        maxper = _max_per_node(rep)
+        slot = 0
+        if maxper < BIG:
+            slot = track_slots.setdefault(sig, len(track_slots) + 1)
+        if _zone_spread_zones(rep) and len(all_zones) > 1:
+            # Split the class across zones, balancing against existing skew.
+            # Candidate domains are filtered by the pod's own zone
+            # requirements (Kubernetes counts skew only over nodes that
+            # satisfy the pod's nodeAffinity/nodeSelector).
+            c0 = next(
+                c
+                for c in rep.topology_spread
+                if c.topology_key == L.LABEL_ZONE
+                and c.selects(rep)
+                and c.when_unsatisfiable == "DoNotSchedule"
+            )
+            zr = rep.scheduling_requirements().get(L.LABEL_ZONE)
+            split_zones = [z for z in all_zones if zr is None or zr.has(z)]
+            if not split_zones:
+                split_zones = all_zones
+            # seed with bound pods the constraint's SELECTOR matches (the
+            # oracle replays placements the same way, topology.py:91-93)
+            zcounts = {z: 0 for z in split_zones}
+            for sn in live:
+                if sn.zone in zcounts:
+                    zcounts[sn.zone] += sum(
+                        1 for bp in sn.pods if c0.selects(bp)
+                    )
+            share = _balanced_split(len(members), zcounts)
+            cursor = 0
+            for z in split_zones:
+                take = share[z]
+                if take == 0:
+                    continue
+                classes.append(
+                    ClassMeta(
+                        pods=members[cursor : cursor + take],
+                        requests=requests,
+                        signature=sig,
+                        zone_pin=z,
+                        max_per_node=maxper,
+                        track_slot=slot,
+                    )
+                )
+                cursor += take
+        else:
+            classes.append(
+                ClassMeta(
+                    pods=members,
+                    requests=requests,
+                    signature=sig,
+                    max_per_node=maxper,
+                    track_slot=slot,
+                )
+            )
+
+    # FFD order: constrained classes first, then descending size
+    def class_key(cm: ClassMeta) -> Tuple:
+        constrained = cm.max_per_node < BIG or bool(cm.zone_pin)
+        r = cm.requests
+        return (
+            not constrained,
+            -(r.cpu + r.memory / (4 * 2**30)),
+        )
+
+    classes.sort(key=class_key)
+
+    # --------------------------------------------------------- feasibility
+    # Vectorized assembly: exact Requirements-algebra checks run once per
+    # (signature, pool) over the TYPE axis (and once per zone / capacity
+    # type), then broadcast onto the full config axis with numpy — the
+    # per-config Python loop would dominate the 200ms solve budget.
+    G, C, R = len(classes), len(configs), len(axes)
+    feas = np.zeros((G, C), dtype=bool)
+    # config structure, grouped by pool
+    rows_by_pool: Dict[str, List[int]] = {}
+    for c, cfg in enumerate(configs):
+        if cfg.existing is None:
+            rows_by_pool.setdefault(cfg.pool.name, []).append(c)
+    pool_rows: Dict[str, Tuple[np.ndarray, List[InstanceType], np.ndarray, np.ndarray, List[str], List[str]]] = {}
+    for pname, rows in rows_by_pool.items():
+        uniq_types: List[InstanceType] = []
+        tindex: Dict[str, int] = {}
+        zones_u: List[str] = []
+        zindex: Dict[str, int] = {}
+        cts_u: List[str] = []
+        ctindex: Dict[str, int] = {}
+        t_of = np.empty(len(rows), np.int32)
+        z_of = np.empty(len(rows), np.int32)
+        ct_of = np.empty(len(rows), np.int32)
+        for i, c in enumerate(rows):
+            cfg = configs[c]
+            if cfg.instance_type.name not in tindex:
+                tindex[cfg.instance_type.name] = len(uniq_types)
+                uniq_types.append(cfg.instance_type)
+            if cfg.zone not in zindex:
+                zindex[cfg.zone] = len(zones_u)
+                zones_u.append(cfg.zone)
+            if cfg.capacity_type not in ctindex:
+                ctindex[cfg.capacity_type] = len(cts_u)
+                cts_u.append(cfg.capacity_type)
+            t_of[i] = tindex[cfg.instance_type.name]
+            z_of[i] = zindex[cfg.zone]
+            ct_of[i] = ctindex[cfg.capacity_type]
+        pool_rows[pname] = (np.array(rows), uniq_types, t_of, z_of, ct_of, zones_u, cts_u)
+
+    # classes grouped by (signature, zone_pin): identical feasibility rows
+    classes_by_sig: Dict[Tuple, List[int]] = {}
+    for g, cm in enumerate(classes):
+        classes_by_sig.setdefault((cm.signature, cm.zone_pin), []).append(g)
+
+    pools_by_name = {p.name: p for p in pools}
+    for (sig, zone_pin), g_idx in classes_by_sig.items():
+        rep = classes[g_idx[0]].pods[0]
+        sched = rep.scheduling_requirements()
+        if zone_pin:
+            sched = Requirements(iter(sched))
+            sched.add(Requirement(L.LABEL_ZONE, Op.IN, [zone_pin]))
+        row = np.zeros(C, dtype=bool)
+        for pname, (rows, uniq_types, t_of, z_of, ct_of, zones_u, cts_u) in pool_rows.items():
+            merged = _merge_pool(rep, sched, pools_by_name[pname])
+            if merged is None:
+                continue
+            type_ok = np.array(
+                [
+                    it.requirements.compatible(merged, allow_undefined=True)
+                    for it in uniq_types
+                ],
+                dtype=bool,
+            )
+            zr = merged.get(L.LABEL_ZONE)
+            zone_ok = np.array(
+                [zr is None or zr.has(z) for z in zones_u], dtype=bool
+            )
+            cr = merged.get(L.LABEL_CAPACITY_TYPE)
+            ct_ok = np.array(
+                [cr is None or cr.has(ct) for ct in cts_u], dtype=bool
+            )
+            row[rows] = type_ok[t_of] & zone_ok[z_of] & ct_ok[ct_of]
+        for e, sn in enumerate(live):
+            row[first_existing + e] = _fits_existing(rep, sched, sn)
+        feas[g_idx] = row
+
+    # pool weight priority (reference designs/provisioner-priority.md): the
+    # oracle tries pools highest-weight-first and commits to the first that
+    # admits the pod.  Enforce the same by restricting each class's new-node
+    # feasibility to its highest-weight admitting pool (label-compatible AND
+    # resource-fitting at least one config).
+    if len(pools) > 1:
+        req_mat = (
+            np.stack([_vec(cm.requests, axes) for cm in classes])
+            if classes
+            else np.zeros((0, R), np.float32)
+        )
+        alloc_mat = _alloc_matrix(configs, pool_overhead, axes)
+        pool_of = np.array(
+            [
+                pools.index(cfg.pool) if cfg.pool is not None else -1
+                for cfg in configs
+            ],
+            dtype=np.int32,
+        )
+        for g in range(G):
+            fits = (req_mat[g][None, :] <= alloc_mat + 1e-6).all(axis=1)
+            for rank, pool in enumerate(pools):
+                sel = (pool_of == rank) & feas[g] & fits
+                if sel.any():
+                    feas[g] &= (pool_of == rank) | (pool_of == -1)
+                    break
+
+    # seed per-signature counters with pods already bound to existing nodes
+    # (so anti-affinity/hostname-spread caps see prior placements)
+    S = len(track_slots) + 1
+    sig_used0 = np.zeros((S, len(live)), np.int32)
+    if track_slots:
+        for e, sn in enumerate(live):
+            for bound in sn.pods:
+                s = track_slots.get(bound.constraint_signature())
+                if s is not None:
+                    sig_used0[s, e] += 1
+
+    prob = CompiledProblem(
+        axes=axes,
+        classes=classes,
+        configs=configs,
+        req=np.stack([_vec(cm.requests, axes) for cm in classes])
+        if classes
+        else np.zeros((0, R), np.float32),
+        cnt=np.array([len(cm.pods) for cm in classes], dtype=np.int32),
+        maxper=np.array(
+            [min(cm.max_per_node, BIG) for cm in classes], dtype=np.int32
+        ),
+        slot=np.array([cm.track_slot for cm in classes], dtype=np.int32),
+        alloc=_alloc_matrix(configs, pool_overhead, axes),
+        price=np.array([c.price for c in configs], dtype=np.float32),
+        openable=np.array([c.existing is None for c in configs], dtype=bool),
+        feas=feas,
+        pool_daemon_overhead=pool_overhead,
+        used0=np.stack([_vec(sn.used, axes) for sn in live])
+        if live
+        else np.zeros((0, R), np.float32),
+        cfg0=np.arange(first_existing, first_existing + len(live), dtype=np.int32),
+        npods0=np.array([len(sn.pods) for sn in live], dtype=np.int32),
+        sig_used0=sig_used0,
+        n_track_slots=S,
+        unsupported_reason=reason,
+    )
+    return prob
+
+
+def _balanced_split(n: int, existing_counts: Dict[str, int]) -> Dict[str, int]:
+    """Distribute n pods over zones so final (existing + new) counts are as
+    level as possible — the maxSkew>=1 optimum a spread constraint wants."""
+    zones = sorted(existing_counts)
+    counts = dict(existing_counts)
+    out = {z: 0 for z in zones}
+    for _ in range(n):
+        z = min(zones, key=lambda z: (counts[z], z))
+        counts[z] += 1
+        out[z] += 1
+    return out
+
+
+def _merge_pool(
+    rep: Pod, sched: Requirements, pool: NodePool
+) -> Optional[Requirements]:
+    """Pool template ∧ pod requirements, or None if structurally infeasible."""
+    if not tolerates_all(rep.tolerations, pool.taints):
+        return None
+    merged = pool.template_requirements().union(sched)
+    if merged.is_unsatisfiable():
+        return None
+    return merged
+
+
+def _fits_existing(rep: Pod, sched: Requirements, sn: StateNode) -> bool:
+    if not tolerates_all(rep.tolerations, sn.taints):
+        return False
+    node_reqs = Requirements.from_labels(sn.labels)
+    return node_reqs.compatible(sched)
+
+
+def _alloc_matrix(
+    configs: Sequence[ConfigMeta],
+    pool_overhead: Dict[str, Resources],
+    axes: Sequence[str],
+) -> np.ndarray:
+    rows = []
+    for cfg in configs:
+        if cfg.existing is not None:
+            rows.append(_vec(cfg.existing.allocatable, axes))
+        else:
+            alloc = (
+                cfg.instance_type.allocatable() - pool_overhead[cfg.pool.name]
+            ).clamp_nonnegative()
+            rows.append(_vec(alloc, axes))
+    if not rows:
+        return np.zeros((0, len(axes)), np.float32)
+    return np.stack(rows)

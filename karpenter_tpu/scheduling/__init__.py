@@ -1,0 +1,6 @@
+"""Scheduling: the FFD oracle, the tensor solver, and topology tracking."""
+
+from karpenter_tpu.scheduling.scheduler import Scheduler, SchedulingResult, VirtualNode
+from karpenter_tpu.scheduling.solver import TensorScheduler
+
+__all__ = ["Scheduler", "SchedulingResult", "TensorScheduler", "VirtualNode"]

@@ -1,0 +1,168 @@
+"""The TPU scheduling solver: compile -> pack -> decode, with oracle fallback.
+
+`TensorScheduler` presents the same interface as the pure-Python oracle
+(scheduling/scheduler.py) but runs the solve as tensors: constraint
+compilation (ops/tensorize.py) followed by the jitted packing scan
+(ops/packer.py).  Constraint shapes the kernel cannot express (inter-class
+pod affinity, zone anti-affinity) automatically fall back to the oracle, so
+callers always get a correct answer — the tensor path is a fast path, the
+oracle is the semantics definition.
+
+Decoded output is the oracle's `SchedulingResult` (VirtualNode /
+existing-placement / unschedulable), so the provisioning controller is
+agnostic to which path solved the batch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence
+
+import numpy as np
+
+from karpenter_tpu.api import InstanceType, NodePool, Pod, Requirement
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.api.resources import Resources
+from karpenter_tpu.ops.packer import run_pack
+from karpenter_tpu.ops.tensorize import CompiledProblem, ConfigMeta, compile_problem
+from karpenter_tpu.scheduling.scheduler import (
+    Scheduler,
+    SchedulingResult,
+    VirtualNode,
+)
+from karpenter_tpu.state.cluster import StateNode
+
+
+class TensorScheduler:
+    """Drop-in replacement for the oracle `Scheduler` backed by the kernel."""
+
+    def __init__(
+        self,
+        pools: Sequence[NodePool],
+        instance_types: Dict[str, List[InstanceType]],
+        existing: Sequence[StateNode] = (),
+        daemonsets: Sequence[Pod] = (),
+        zones: Sequence[str] = (),
+        objective: str = "nodes",
+    ):
+        self.pools = list(pools)
+        self.instance_types = instance_types
+        self.existing = list(existing)
+        self.daemonsets = list(daemonsets)
+        self.zones = list(zones)
+        self.objective = objective
+        self.last_path = ""  # "tensor" | "oracle" (observability)
+
+    # ------------------------------------------------------------------ solve
+    def solve(self, pods: Iterable[Pod]) -> SchedulingResult:
+        pods = list(pods)
+        prob = compile_problem(
+            pods,
+            self.pools,
+            self.instance_types,
+            existing=self.existing,
+            daemonsets=self.daemonsets,
+        )
+        if not prob.supported:
+            return self._oracle(pods)
+        self.last_path = "tensor"
+        result = run_pack(prob, objective=self.objective)
+        # grow the slot bucket if the solve ran out of node slots while
+        # feasible configs remained
+        k = int(result.node_cfg.shape[0])
+        max_k = len(prob.used0) + prob.total_pods()
+        while self._overflowed(prob, result) and k < max_k:
+            k *= 2
+            result = run_pack(prob, k_slots=k, objective=self.objective)
+        return self._decode(prob, result)
+
+    def _oracle(self, pods: List[Pod]) -> SchedulingResult:
+        self.last_path = "oracle"
+        return Scheduler(
+            self.pools,
+            self.instance_types,
+            existing=self.existing,
+            daemonsets=self.daemonsets,
+            zones=self.zones,
+        ).solve(pods)
+
+    # ------------------------------------------------------------- internals
+    @staticmethod
+    def _overflowed(prob: CompiledProblem, result) -> bool:
+        """Leftover pods whose class has an openable config that would truly
+        HOLD them (label-feasible AND resource-fitting) mean the solve ran
+        out of node slots — only then is a bigger-K retry worthwhile."""
+        leftover = np.asarray(result.leftover)
+        G = len(prob.classes)
+        if not leftover[:G].any():
+            return False
+        fits = (prob.req[:, None, :] <= prob.alloc[None, :, :] + 1e-6).all(
+            axis=2
+        )  # [G, C]
+        placeable = (prob.feas & prob.openable[None, :] & fits).any(axis=1)
+        return bool((leftover[:G] > 0)[placeable].any())
+
+    def _decode(self, prob: CompiledProblem, result) -> SchedulingResult:
+        take = np.asarray(result.take)  # [Gp, Kp]
+        leftover = np.asarray(result.leftover)
+        node_cfg = np.asarray(result.node_cfg)  # [Kp]
+        out = SchedulingResult()
+
+        # slot -> decoded node (lazily created so empty slots cost nothing)
+        vnodes: Dict[int, VirtualNode] = {}
+
+        def vnode_for(k: int) -> VirtualNode:
+            vn = vnodes.get(k)
+            if vn is None:
+                cfg = prob.configs[node_cfg[k]]
+                vn = _make_vnode(
+                    cfg, prob.pool_daemon_overhead.get(cfg.pool.name, Resources())
+                )
+                vnodes[k] = vn
+                out.new_nodes.append(vn)
+            return vn
+        for g, cm in enumerate(prob.classes):
+            cursor = 0
+            for k in np.nonzero(take[g])[0]:
+                n = int(take[g, k])
+                batch = cm.pods[cursor : cursor + n]
+                cursor += n
+                cfg = prob.configs[node_cfg[k]]
+                if cfg.existing is not None:
+                    for p in batch:
+                        out.existing_placements[p.key()] = cfg.existing.name
+                else:
+                    vn = vnode_for(int(k))
+                    vn.pods.extend(batch)
+                    for p in batch:
+                        vn.used = vn.used + p.requests
+            for p in cm.pods[cursor:]:
+                out.unschedulable[p.key()] = self._why_unschedulable(prob, g)
+        return out
+
+    @staticmethod
+    def _why_unschedulable(prob: CompiledProblem, g: int) -> str:
+        row = prob.feas[g]
+        if not row.any():
+            return "pod incompatible with every instance type / offering"
+        return "no node with remaining capacity fits the pod"
+
+
+def _make_vnode(cfg: ConfigMeta, daemon_overhead: Resources) -> VirtualNode:
+    """Materialize a decoded slot as the oracle's VirtualNode so downstream
+    (NodeClaim creation, pricing, consolidation headroom math) is
+    path-agnostic.  Requirements carry the committed type/zone/capacity-type
+    pins; `used` starts at the pool's daemonset overhead exactly like the
+    oracle's nodes (the kernel packed against allocatable-minus-overhead, so
+    the accounting matches)."""
+    it = cfg.instance_type
+    reqs = cfg.pool.template_requirements()
+    reqs.add(Requirement(L.LABEL_INSTANCE_TYPE, Op.IN, [it.name]))
+    reqs.add(Requirement(L.LABEL_ZONE, Op.IN, [cfg.zone]))
+    reqs.add(Requirement(L.LABEL_CAPACITY_TYPE, Op.IN, [cfg.capacity_type]))
+    return VirtualNode(
+        pool=cfg.pool,
+        requirements=reqs,
+        feasible_types=[it],
+        daemon_overhead=daemon_overhead,
+    )

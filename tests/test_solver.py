@@ -1,0 +1,380 @@
+"""Tensor-solver tests: kernel behavior + parity with the FFD oracle.
+
+Mirrors the reference's test strategy (SURVEY.md §4): real scheduling logic
+over the fake cloud, with the oracle (scheduling/scheduler.py) as the
+semantics definition the kernel must match or beat.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import Pod, Requirement, Resources, Taint, Toleration
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.ops.tensorize import compile_problem
+from karpenter_tpu.scheduling import Scheduler, TensorScheduler
+from karpenter_tpu.testing import Environment
+
+
+@pytest.fixture(scope="module")
+def env():
+    return Environment()
+
+
+@pytest.fixture(scope="module")
+def setup(env):
+    pool = env.default_node_pool()
+    nc = env.default_node_class()
+    types = env.instance_types.list(pool, nc)
+    return pool, types
+
+
+def both(pool, types, pods, **kw):
+    oracle = Scheduler([pool], {pool.name: types}, **kw).solve(pods)
+    ts = TensorScheduler([pool], {pool.name: types}, **kw)
+    tensor = ts.solve(pods)
+    return oracle, tensor, ts
+
+
+# ---------------------------------------------------------------------------
+# compile_problem
+# ---------------------------------------------------------------------------
+
+
+class TestTensorize:
+    def test_classes_group_identical_pods(self, setup):
+        pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(50)]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        assert len(prob.classes) == 1
+        assert prob.cnt[0] == 50
+        assert prob.supported
+
+    def test_configs_cover_zones_and_capacity_types(self, setup):
+        pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1))]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        zones = {c.zone for c in prob.configs}
+        cts = {c.capacity_type for c in prob.configs}
+        assert zones == {"zone-a", "zone-b", "zone-c"}
+        assert cts == {L.CAPACITY_TYPE_ON_DEMAND, L.CAPACITY_TYPE_SPOT}
+
+    def test_node_selector_masks_feasibility(self, setup):
+        pool, types = setup
+        pod = Pod(
+            requests=Resources(cpu=1),
+            node_selector={L.LABEL_ARCH: "arm64"},
+        )
+        prob = compile_problem([pod], [pool], {pool.name: types})
+        for c_idx in np.nonzero(prob.feas[0])[0]:
+            cfg = prob.configs[c_idx]
+            req = cfg.instance_type.requirements.get(L.LABEL_ARCH)
+            assert req.has("arm64")
+
+    def test_unsupported_constraints_reported(self, setup):
+        pool, types = setup
+        pod = Pod(
+            requests=Resources(cpu=1),
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=L.LABEL_ZONE,
+                    label_selector=(("app", "x"),),
+                    anti=False,
+                )
+            ],
+        )
+        prob = compile_problem([pod], [pool], {pool.name: types})
+        assert not prob.supported
+
+    def test_zone_spread_splits_classes(self, setup):
+        pool, types = setup
+        sel = (("app", "s"),)
+        pods = [
+            Pod(
+                labels={"app": "s"},
+                requests=Resources(cpu=1),
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+                    )
+                ],
+            )
+            for _ in range(10)
+        ]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        zone_pins = sorted(cm.zone_pin for cm in prob.classes)
+        assert zone_pins == ["zone-a", "zone-b", "zone-c"]
+        counts = sorted(len(cm.pods) for cm in prob.classes)
+        assert counts == [3, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# Solver vs oracle parity
+# ---------------------------------------------------------------------------
+
+
+class TestParity:
+    def test_homogeneous_matches_oracle(self, setup):
+        pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(200)]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        assert tensor.node_count() <= oracle.node_count()
+        assert sum(len(n.pods) for n in tensor.new_nodes) == 200
+
+    def test_heterogeneous_close_to_oracle(self, setup):
+        pool, types = setup
+        random.seed(7)
+        pods = []
+        for i in range(300):
+            pods.append(
+                Pod(
+                    requests=Resources(
+                        cpu=random.choice([0.25, 0.5, 1, 2]),
+                        memory=random.choice(["256Mi", "1Gi", "4Gi"]),
+                    )
+                )
+            )
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        # quality bar: within 15% of the oracle's node count
+        assert tensor.node_count() <= max(oracle.node_count() * 1.15, 1)
+
+    def test_hostname_anti_affinity_one_per_node(self, setup):
+        pool, types = setup
+        sel = (("app", "dense"),)
+        pods = [
+            Pod(
+                labels={"app": "dense"},
+                requests=Resources(cpu=0.25),
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME, label_selector=sel, anti=True
+                    )
+                ],
+            )
+            for _ in range(40)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert tensor.node_count() == oracle.node_count() == 40
+        assert all(len(n.pods) == 1 for n in tensor.new_nodes)
+
+    def test_zone_spread_balances(self, setup):
+        pool, types = setup
+        sel = (("app", "z"),)
+        pods = [
+            Pod(
+                labels={"app": "z"},
+                requests=Resources(cpu=1, memory="1Gi"),
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+                    )
+                ],
+            )
+            for _ in range(90)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        zone_counts = {}
+        for n in tensor.new_nodes:
+            zone = n.requirements.get(L.LABEL_ZONE).any_value()
+            zone_counts[zone] = zone_counts.get(zone, 0) + len(n.pods)
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+    def test_zone_spread_levels_existing_skew(self, setup):
+        """Bound pods matched by the spread SELECTOR (even if they carry no
+        constraint themselves) must seed the skew counts — new placements go
+        to the under-filled zones."""
+        pool, types = setup
+        from karpenter_tpu.state.cluster import StateNode
+
+        bound = [Pod(labels={"app": "z"}, node_name="node-a") for _ in range(4)]
+        existing = StateNode(
+            name="node-a",
+            provider_id="i-a",
+            labels={
+                L.LABEL_ZONE: "zone-a",
+                L.LABEL_ARCH: "amd64",
+                L.LABEL_OS: "linux",
+            },
+            taints=[],
+            allocatable=Resources(cpu=0.5, pods=110),  # no room for new pods
+            pods=bound,
+        )
+        sel = (("app", "z"),)
+        pods = [
+            Pod(
+                labels={"app": "z"},
+                requests=Resources(cpu=1, memory="1Gi"),
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+                    )
+                ],
+            )
+            for _ in range(5)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types}, existing=[existing])
+        r = ts.solve(pods)
+        assert ts.last_path == "tensor"
+        totals = {"zone-a": 4, "zone-b": 0, "zone-c": 0}
+        for n in r.new_nodes:
+            zone = n.requirements.get(L.LABEL_ZONE).any_value()
+            totals[zone] += len(n.pods)
+        # leveling optimum given the pre-existing 4-in-zone-a: 4/3/2 (the
+        # oracle produces the same); the buggy blank-slate split gave 6/2/1
+        assert totals == {"zone-a": 4, "zone-b": 3, "zone-c": 2}, totals
+
+    def test_zone_spread_respects_pod_zone_requirements(self, setup):
+        """A zone-spread pod restricted to two zones must only split across
+        those zones (Kubernetes filters skew domains by nodeAffinity)."""
+        pool, types = setup
+        sel = (("app", "zz"),)
+        pods = [
+            Pod(
+                labels={"app": "zz"},
+                requests=Resources(cpu=1),
+                node_selector={},
+                required_affinity=[
+                    Requirement(L.LABEL_ZONE, Op.IN, ["zone-a", "zone-b"])
+                ],
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+                    )
+                ],
+            )
+            for _ in range(8)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types})
+        r = ts.solve(pods)
+        assert not r.unschedulable
+        zones = {
+            n.requirements.get(L.LABEL_ZONE).any_value() for n in r.new_nodes
+        }
+        assert zones <= {"zone-a", "zone-b"}
+
+    def test_tolerations_against_tainted_pool(self, env, setup):
+        _, types = setup
+        tainted = env.default_node_pool(
+            name="tainted", taints=[Taint(key="team", value="ml")]
+        )
+        pods_no_tol = [Pod(requests=Resources(cpu=1))]
+        pods_tol = [
+            Pod(
+                requests=Resources(cpu=1),
+                tolerations=[Toleration(key="team", value="ml")],
+            )
+        ]
+        ts = TensorScheduler([tainted], {"tainted": types})
+        r1 = ts.solve(pods_no_tol)
+        assert len(r1.unschedulable) == 1
+        r2 = ts.solve(pods_tol)
+        assert r2.node_count() == 1
+
+    def test_oracle_fallback_for_pod_affinity(self, setup):
+        pool, types = setup
+        sel = (("app", "a"),)
+        pods = [
+            Pod(
+                labels={"app": "a"},
+                requests=Resources(cpu=1),
+                pod_affinity=[
+                    PodAffinityTerm(topology_key=L.LABEL_ZONE, label_selector=sel)
+                ],
+            )
+            for _ in range(6)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types})
+        r = ts.solve(pods)
+        assert ts.last_path == "oracle"
+        assert not r.unschedulable
+        # all anchored in one zone
+        zones = {
+            n.requirements.get(L.LABEL_ZONE).any_value() for n in r.new_nodes
+        }
+        assert len(zones) == 1
+
+    def test_requirement_gt_lt(self, setup):
+        pool, types = setup
+        pod = Pod(
+            requests=Resources(cpu=1),
+            required_affinity=[
+                Requirement(L.LABEL_INSTANCE_CPU, Op.GT, ["8"]),
+                Requirement(L.LABEL_INSTANCE_CPU, Op.LT, ["64"]),
+            ],
+        )
+        ts = TensorScheduler([pool], {pool.name: types})
+        r = ts.solve([pod])
+        assert ts.last_path == "tensor"
+        assert r.node_count() == 1
+        it = r.new_nodes[0].feasible_types[0]
+        assert 8 < it.capacity.cpu < 64
+
+    def test_existing_nodes_used_first(self, env, setup):
+        pool, types = setup
+        from karpenter_tpu.state.cluster import StateNode
+
+        existing = StateNode(
+            name="node-1",
+            provider_id="i-1",
+            labels={
+                L.LABEL_ARCH: "amd64",
+                L.LABEL_OS: "linux",
+                L.LABEL_ZONE: "zone-a",
+                L.LABEL_NODEPOOL: pool.name,
+            },
+            taints=[],
+            allocatable=Resources(cpu=8, memory="32Gi", pods=110),
+        )
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(4)]
+        ts = TensorScheduler([pool], {pool.name: types}, existing=[existing])
+        r = ts.solve(pods)
+        assert ts.last_path == "tensor"
+        assert r.node_count() == 0
+        assert len(r.existing_placements) == 4
+
+    def test_unschedulable_when_nothing_fits(self, setup):
+        pool, types = setup
+        pod = Pod(requests=Resources(cpu=10000))
+        ts = TensorScheduler([pool], {pool.name: types})
+        r = ts.solve([pod])
+        assert len(r.unschedulable) == 1
+
+    def test_spot_preferred_when_flexible(self, setup):
+        pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(10)]
+        _, tensor, _ = both(pool, types, pods)
+        for n in tensor.new_nodes:
+            ct = n.requirements.get(L.LABEL_CAPACITY_TYPE).any_value()
+            assert ct == L.CAPACITY_TYPE_SPOT  # spot is cheaper in the fake
+
+    def test_on_demand_when_pinned(self, setup):
+        pool, types = setup
+        pods = [
+            Pod(
+                requests=Resources(cpu=1),
+                node_selector={L.LABEL_CAPACITY_TYPE: L.CAPACITY_TYPE_ON_DEMAND},
+            )
+        ]
+        ts = TensorScheduler([pool], {pool.name: types})
+        r = ts.solve(pods)
+        ct = r.new_nodes[0].requirements.get(L.LABEL_CAPACITY_TYPE).any_value()
+        assert ct == L.CAPACITY_TYPE_ON_DEMAND
+
+    def test_weighted_pools_respected(self, env, setup):
+        _, types = setup
+        heavy = env.default_node_pool(name="heavy", weight=10)
+        light = env.default_node_pool(name="light", weight=1)
+        pods = [Pod(requests=Resources(cpu=1)) for _ in range(5)]
+        ts = TensorScheduler([light, heavy], {"heavy": types, "light": types})
+        r = ts.solve(pods)
+        for n in r.new_nodes:
+            assert n.pool.name == "heavy"
