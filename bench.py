@@ -1,0 +1,89 @@
+"""Flagship benchmark: the north-star scheduling solve.
+
+Config (BASELINE.md north-star): 10,000 pending pods, ~500 instance types,
+3 zones, 2 capacity types — measure END-TO-END schedule latency (constraint
+compilation + device packing + decode back to placements), p50 over
+measured iterations after warmup.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the speedup vs the 200 ms north-star budget
+(>1.0 = faster than target).  The reference's own FFD implementation has no
+published latency number at this scale (SURVEY.md §6); 200 ms is the
+driver-supplied bar.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+
+def build_problem():
+    from karpenter_tpu.api import Pod, Resources
+    from karpenter_tpu.cloud.fake.backend import generate_catalog
+    from karpenter_tpu.testing import Environment
+
+    shapes = generate_catalog(
+        generations=(1, 2, 3, 4, 5),
+        cpus=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192),
+    )
+    env = Environment(shapes=shapes)
+    pool = env.default_node_pool()
+    nc = env.default_node_class()
+    types = env.instance_types.list(pool, nc)
+
+    sizes = [
+        Resources(cpu=0.25, memory="512Mi"),
+        Resources(cpu=0.5, memory="1Gi"),
+        Resources(cpu=1, memory="2Gi"),
+        Resources(cpu=1, memory="4Gi"),
+        Resources(cpu=2, memory="4Gi"),
+        Resources(cpu=2, memory="8Gi"),
+        Resources(cpu=4, memory="8Gi"),
+        Resources(cpu=8, memory="32Gi"),
+    ]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(10_000)]
+    return pool, types, pods
+
+
+def main() -> None:
+    from karpenter_tpu.scheduling import TensorScheduler
+
+    pool, types, pods = build_problem()
+    # one scheduler across solves, like the long-lived provisioning
+    # controller (instance-type lists are TTL-cached for 5m in the
+    # reference, instancetype.go:97-104 — the catalog cache mirrors that)
+    ts = TensorScheduler([pool], {pool.name: types})
+
+    def solve_once() -> float:
+        t0 = time.perf_counter()
+        result = ts.solve(pods)
+        dt = time.perf_counter() - t0
+        assert ts.last_path == "tensor", ts.last_path
+        placed = sum(len(n.pods) for n in result.new_nodes)
+        assert placed == len(pods) and not result.unschedulable, (
+            placed,
+            len(result.unschedulable),
+        )
+        return dt
+
+    for _ in range(2):  # warmup: jit compile + cache fill
+        solve_once()
+    samples = [solve_once() for _ in range(10)]
+    p50_ms = statistics.median(samples) * 1000.0
+    baseline_ms = 200.0
+    print(
+        json.dumps(
+            {
+                "metric": "schedule_10k_pods_500_types_p50",
+                "value": round(p50_ms, 2),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / p50_ms, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,26 @@ class Pod:
     is_daemonset: bool = False
     phase: str = "Pending"
 
+    # fields that feed constraint_signature(); reassigning any of them
+    # invalidates the memo (in-place mutation of the dict/list values is
+    # still undetectable — replace, don't mutate)
+    _SIG_FIELDS = frozenset(
+        {
+            "labels",
+            "namespace",
+            "node_selector",
+            "required_affinity",
+            "tolerations",
+            "topology_spread",
+            "pod_affinity",
+        }
+    )
+
+    def __setattr__(self, name, value):
+        if name in Pod._SIG_FIELDS:
+            self.__dict__.pop("_sig", None)
+        object.__setattr__(self, name, value)
+
     def __post_init__(self):
         if not self.name:
             self.name = f"pod-{next(_pod_seq)}"
@@ -150,8 +170,17 @@ class Pod:
     def constraint_signature(self) -> Tuple:
         """Hashable signature of everything that affects where this pod can
         go.  Pods with equal signatures are interchangeable to the solver
-        (they may still differ in resource requests)."""
-        return (
+        (they may still differ in resource requests).
+
+        Memoized: computed once per pod (the tensor solver calls this for
+        every pod on every solve).  Reassigning a constraint field clears
+        the memo (see __setattr__); mutating a constraint dict/list IN
+        PLACE after the first solve is not detected — replace the value
+        instead."""
+        cached = self.__dict__.get("_sig")
+        if cached is not None:
+            return cached
+        self.__dict__["_sig"] = sig = (
             tuple(sorted(self.node_selector.items())),
             tuple(sorted(map(repr, self.required_affinity))),
             tuple(sorted(self.tolerations, key=repr)),
@@ -160,6 +189,7 @@ class Pod:
             tuple(sorted(self.labels.items())),
             self.namespace,
         )
+        return sig
 
 
 # ---------------------------------------------------------------------------

@@ -433,6 +433,10 @@ _SIZE_NAMES = {
 }
 
 
+def _size_name(cpu: int) -> str:
+    return _SIZE_NAMES.get(cpu, f"{cpu}cpu")
+
+
 def generate_catalog(
     families: Sequence[str] = tuple(_FAMILY_SPECS),
     generations: Sequence[int] = (1, 2, 3),
@@ -457,14 +461,14 @@ def generate_catalog(
                 is_tpu = fam == "tpu"
                 out.append(
                     MachineShape(
-                        name=f"{fam}{gen}.{_SIZE_NAMES[cpu]}",
+                        name=f"{fam}{gen}.{_size_name(cpu)}",
                         cpu=float(cpu),
                         memory=cpu * mem_per_cpu * 2**30,
                         arch=arch,
                         category=category,
                         family=f"{fam}{gen}",
                         generation=gen,
-                        size=_SIZE_NAMES[cpu],
+                        size=_size_name(cpu),
                         gpu_count=0 if is_tpu or not accel_count else accel_count,
                         gpu_name="gpu-a" if accel_count and not is_tpu else "",
                         tpu_chips=accel_count if is_tpu else 0,

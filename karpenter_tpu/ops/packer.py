@@ -169,15 +169,12 @@ def node_slot_bound(prob: CompiledProblem) -> int:
     return n_existing + max(constrained, min(n_pods, max(256, constrained)))
 
 
-def run_pack(
-    prob: CompiledProblem, k_slots: int = 0, objective: str = "nodes"
-) -> PackResult:
-    """Pad a compiled problem to bucket shapes and run the jitted kernel.
+def pad_problem(prob: CompiledProblem, k_slots: int = 0) -> Tuple[tuple, int]:
+    """Pad a compiled problem to power-of-two bucket shapes.
 
-    Returns device arrays; the caller (scheduling/solver.py) decodes them
-    back into nodes and placements.  If the solve overflows ``k_slots``
-    (leftover pods while feasible configs remained), the caller should retry
-    with a doubled bucket.
+    Returns the positional argument tuple for `pack_kernel` plus the padded
+    slot count Kp (the kernel's static shape).  Bucketing means XLA compiles
+    once per (G, C, K) bucket and replays for every solve that fits.
     """
     G, C = prob.feas.shape
     R = prob.req.shape[1] if prob.req.size else len(prob.axes)
@@ -212,8 +209,22 @@ def run_pack(
     sig0 = np.zeros((Sp, Kp), np.int32)
     sig0[: prob.sig_used0.shape[0], :E] = prob.sig_used0
 
-    return pack_kernel(
+    args = (
         req, cnt, maxper, slot, feas, alloc, price, openable,
-        used0, cfg0, npods0, jnp.int32(E), sig0, k_slots=Kp,
-        objective=objective,
+        used0, cfg0, npods0, jnp.int32(E), sig0,
     )
+    return args, Kp
+
+
+def run_pack(
+    prob: CompiledProblem, k_slots: int = 0, objective: str = "nodes"
+) -> PackResult:
+    """Pad a compiled problem to bucket shapes and run the jitted kernel.
+
+    Returns device arrays; the caller (scheduling/solver.py) decodes them
+    back into nodes and placements.  If the solve overflows ``k_slots``
+    (leftover pods while feasible configs remained), the caller should retry
+    with a doubled bucket.
+    """
+    args, Kp = pad_problem(prob, k_slots)
+    return pack_kernel(*args, k_slots=Kp, objective=objective)

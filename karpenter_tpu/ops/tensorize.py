@@ -19,6 +19,11 @@ problem once per solve:
   and capacity-type admission, offering availability (ICE cache already
   masked upstream by the instance-type provider).
 
+The launchable half of the config axis is identical across solves for a
+given (pools, instance-types) snapshot, so it is prebuilt once as a
+`Catalog` and reused — the analogue of the reference's seqnum-keyed
+instance-type cache (instancetype.go:97-104).
+
 The resulting `CompiledProblem` is pure numpy; `ops/packer.py` moves it to
 device and runs the packing scan under jit.
 
@@ -76,7 +81,7 @@ def _vec(r: Resources, axes: Sequence[str]) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Compiled problem
+# Catalog: the launchable config axis, reusable across solves
 # ---------------------------------------------------------------------------
 
 
@@ -90,6 +95,126 @@ class ConfigMeta:
     capacity_type: str
     price: float
     existing: Optional[StateNode] = None  # set for existing-node rows
+
+
+@dataclass
+class _PoolRows:
+    """Per-pool config structure for vectorized feasibility assembly."""
+
+    rows: np.ndarray  # [n] int32 — config row indices
+    uniq_types: List[InstanceType]
+    t_of: np.ndarray  # [n] int32 — row -> uniq_types index
+    z_of: np.ndarray  # [n] int32
+    ct_of: np.ndarray  # [n] int32
+    zones: List[str]
+    capacity_types: List[str]
+
+
+@dataclass
+class Catalog:
+    """Prebuilt launchable config rows + tensors for one inventory snapshot."""
+
+    axes: Tuple[str, ...]
+    pools: List[NodePool]  # live, weight-sorted
+    configs: List[ConfigMeta]
+    alloc: np.ndarray  # [Cn, R] float32 (minus pool daemonset overhead)
+    price: np.ndarray  # [Cn] float32
+    pool_rank_of: np.ndarray  # [Cn] int32 — weight-order rank of each row
+    pool_rows: Dict[str, _PoolRows]
+    pool_overhead: Dict[str, Resources]
+    zones: List[str]
+
+
+def build_catalog(
+    pools: Sequence[NodePool],
+    instance_types: Dict[str, List[InstanceType]],
+    daemonsets: Sequence[Pod] = (),
+    axes: Tuple[str, ...] = tuple(L.WELL_KNOWN_RESOURCES),
+) -> Catalog:
+    pools = sorted((p for p in pools if not p.deleted), key=lambda p: -p.weight)
+    configs: List[ConfigMeta] = []
+    pool_overhead: Dict[str, Resources] = {}
+    pool_rank: List[int] = []
+    for rank, pool in enumerate(pools):
+        treqs = pool.template_requirements()
+        pool_overhead[pool.name] = _daemon_overhead(pool, treqs, daemonsets)
+        for it in instance_types.get(pool.name, []):
+            for off in it.offerings.available():
+                configs.append(
+                    ConfigMeta(
+                        pool=pool,
+                        instance_type=it,
+                        zone=off.zone,
+                        capacity_type=off.capacity_type,
+                        price=off.price,
+                    )
+                )
+                pool_rank.append(rank)
+
+    alloc_rows = []
+    for cfg in configs:
+        alloc = (
+            cfg.instance_type.allocatable() - pool_overhead[cfg.pool.name]
+        ).clamp_nonnegative()
+        alloc_rows.append(_vec(alloc, axes))
+    alloc_mat = (
+        np.stack(alloc_rows) if alloc_rows else np.zeros((0, len(axes)), np.float32)
+    )
+
+    pool_rows: Dict[str, _PoolRows] = {}
+    rows_by_pool: Dict[str, List[int]] = {}
+    for c, cfg in enumerate(configs):
+        rows_by_pool.setdefault(cfg.pool.name, []).append(c)
+    for pname, rows in rows_by_pool.items():
+        uniq_types: List[InstanceType] = []
+        tindex: Dict[str, int] = {}
+        zones_u: List[str] = []
+        zindex: Dict[str, int] = {}
+        cts_u: List[str] = []
+        ctindex: Dict[str, int] = {}
+        t_of = np.empty(len(rows), np.int32)
+        z_of = np.empty(len(rows), np.int32)
+        ct_of = np.empty(len(rows), np.int32)
+        for i, c in enumerate(rows):
+            cfg = configs[c]
+            if cfg.instance_type.name not in tindex:
+                tindex[cfg.instance_type.name] = len(uniq_types)
+                uniq_types.append(cfg.instance_type)
+            if cfg.zone not in zindex:
+                zindex[cfg.zone] = len(zones_u)
+                zones_u.append(cfg.zone)
+            if cfg.capacity_type not in ctindex:
+                ctindex[cfg.capacity_type] = len(cts_u)
+                cts_u.append(cfg.capacity_type)
+            t_of[i] = tindex[cfg.instance_type.name]
+            z_of[i] = zindex[cfg.zone]
+            ct_of[i] = ctindex[cfg.capacity_type]
+        pool_rows[pname] = _PoolRows(
+            rows=np.array(rows, np.int32),
+            uniq_types=uniq_types,
+            t_of=t_of,
+            z_of=z_of,
+            ct_of=ct_of,
+            zones=zones_u,
+            capacity_types=cts_u,
+        )
+
+    return Catalog(
+        axes=axes,
+        pools=list(pools),
+        configs=configs,
+        alloc=alloc_mat,
+        price=np.array([c.price for c in configs], dtype=np.float32),
+        pool_rank_of=np.array(pool_rank, dtype=np.int32),
+        pool_rows=pool_rows,
+        pool_overhead=pool_overhead,
+        zones=sorted({c.zone for c in configs}),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Compiled problem
+# ---------------------------------------------------------------------------
 
 
 @dataclass
@@ -229,63 +354,62 @@ def compile_problem(
     instance_types: Dict[str, List[InstanceType]],
     existing: Sequence[StateNode] = (),
     daemonsets: Sequence[Pod] = (),
+    catalog: Optional[Catalog] = None,
 ) -> CompiledProblem:
-    """Compile one scheduling problem to tensors."""
+    """Compile one scheduling problem to tensors.
+
+    Pass a prebuilt ``catalog`` (from `build_catalog`) to skip re-deriving
+    the launchable config rows — valid as long as the (pools,
+    instance-types, daemonsets) snapshot is unchanged and the pods
+    introduce no new extended-resource axes.
+    """
     pods = list(pods)
     axes = _axes_for(pods)
     reason = _unsupported_reason(pods)
-    pools = sorted((p for p in pools if not p.deleted), key=lambda p: -p.weight)
+    if catalog is None or catalog.axes != axes:
+        catalog = build_catalog(pools, instance_types, daemonsets, axes)
+    pools = catalog.pools
+    R = len(axes)
 
-    # ------------------------------------------------------------- configs
-    configs: List[ConfigMeta] = []
-    pool_overhead: Dict[str, Resources] = {}
-    for pool in pools:
-        treqs = pool.template_requirements()
-        pool_overhead[pool.name] = _daemon_overhead(pool, treqs, daemonsets)
-        for it in instance_types.get(pool.name, []):
-            for off in it.offerings.available():
-                configs.append(
-                    ConfigMeta(
-                        pool=pool,
-                        instance_type=it,
-                        zone=off.zone,
-                        capacity_type=off.capacity_type,
-                        price=off.price,
-                    )
-                )
-    first_existing = len(configs)
+    # ----------------------------------------------- existing-node rows
     live = [
         sn
         for sn in existing
         if not sn.marked_for_deletion()
         and not (sn.node is not None and sn.node.cordoned)
     ]
-    for sn in live:
-        configs.append(
-            ConfigMeta(
-                pool=None,
-                instance_type=None,
-                zone=sn.zone,
-                capacity_type=sn.capacity_type,
-                price=0.0,
-                existing=sn,
-            )
+    first_existing = len(catalog.configs)
+    configs = list(catalog.configs) + [
+        ConfigMeta(
+            pool=None,
+            instance_type=None,
+            zone=sn.zone,
+            capacity_type=sn.capacity_type,
+            price=0.0,
+            existing=sn,
         )
+        for sn in live
+    ]
+    C = len(configs)
+    if live:
+        alloc = np.concatenate(
+            [catalog.alloc, np.stack([_vec(sn.allocatable, axes) for sn in live])]
+        )
+        price = np.concatenate([catalog.price, np.zeros(len(live), np.float32)])
+    else:
+        alloc = catalog.alloc
+        price = catalog.price
+    openable = np.zeros(C, bool)
+    openable[:first_existing] = True
 
     # ------------------------------------------------------------- classes
-    # signatures first (feasibility is per signature), then resource classes
-    zones_by_sig: Dict[Tuple, List[str]] = {}
-    all_zones = sorted(
-        {c.zone for c in configs if c.zone}
-        | {sn.zone for sn in live if sn.zone}
-    )
+    all_zones = sorted(set(catalog.zones) | {sn.zone for sn in live if sn.zone})
     groups: Dict[Tuple, List[Pod]] = {}
     for p in pods:
         groups.setdefault((p.constraint_signature(), p.requests), []).append(p)
 
     classes: List[ClassMeta] = []
     track_slots: Dict[Tuple, int] = {}
-    spread_keys_seen: Dict[Tuple, List[Pod]] = {}
     for (sig, requests), members in groups.items():
         rep = members[0]
         maxper = _max_per_node(rep)
@@ -354,47 +478,14 @@ def compile_problem(
         )
 
     classes.sort(key=class_key)
+    G = len(classes)
 
     # --------------------------------------------------------- feasibility
     # Vectorized assembly: exact Requirements-algebra checks run once per
     # (signature, pool) over the TYPE axis (and once per zone / capacity
-    # type), then broadcast onto the full config axis with numpy — the
+    # type), then broadcast onto the full config axis with numpy — a
     # per-config Python loop would dominate the 200ms solve budget.
-    G, C, R = len(classes), len(configs), len(axes)
     feas = np.zeros((G, C), dtype=bool)
-    # config structure, grouped by pool
-    rows_by_pool: Dict[str, List[int]] = {}
-    for c, cfg in enumerate(configs):
-        if cfg.existing is None:
-            rows_by_pool.setdefault(cfg.pool.name, []).append(c)
-    pool_rows: Dict[str, Tuple[np.ndarray, List[InstanceType], np.ndarray, np.ndarray, List[str], List[str]]] = {}
-    for pname, rows in rows_by_pool.items():
-        uniq_types: List[InstanceType] = []
-        tindex: Dict[str, int] = {}
-        zones_u: List[str] = []
-        zindex: Dict[str, int] = {}
-        cts_u: List[str] = []
-        ctindex: Dict[str, int] = {}
-        t_of = np.empty(len(rows), np.int32)
-        z_of = np.empty(len(rows), np.int32)
-        ct_of = np.empty(len(rows), np.int32)
-        for i, c in enumerate(rows):
-            cfg = configs[c]
-            if cfg.instance_type.name not in tindex:
-                tindex[cfg.instance_type.name] = len(uniq_types)
-                uniq_types.append(cfg.instance_type)
-            if cfg.zone not in zindex:
-                zindex[cfg.zone] = len(zones_u)
-                zones_u.append(cfg.zone)
-            if cfg.capacity_type not in ctindex:
-                ctindex[cfg.capacity_type] = len(cts_u)
-                cts_u.append(cfg.capacity_type)
-            t_of[i] = tindex[cfg.instance_type.name]
-            z_of[i] = zindex[cfg.zone]
-            ct_of[i] = ctindex[cfg.capacity_type]
-        pool_rows[pname] = (np.array(rows), uniq_types, t_of, z_of, ct_of, zones_u, cts_u)
-
-    # classes grouped by (signature, zone_pin): identical feasibility rows
     classes_by_sig: Dict[Tuple, List[int]] = {}
     for g, cm in enumerate(classes):
         classes_by_sig.setdefault((cm.signature, cm.zone_pin), []).append(g)
@@ -407,29 +498,35 @@ def compile_problem(
             sched = Requirements(iter(sched))
             sched.add(Requirement(L.LABEL_ZONE, Op.IN, [zone_pin]))
         row = np.zeros(C, dtype=bool)
-        for pname, (rows, uniq_types, t_of, z_of, ct_of, zones_u, cts_u) in pool_rows.items():
+        for pname, pr in catalog.pool_rows.items():
             merged = _merge_pool(rep, sched, pools_by_name[pname])
             if merged is None:
                 continue
             type_ok = np.array(
                 [
                     it.requirements.compatible(merged, allow_undefined=True)
-                    for it in uniq_types
+                    for it in pr.uniq_types
                 ],
                 dtype=bool,
             )
             zr = merged.get(L.LABEL_ZONE)
             zone_ok = np.array(
-                [zr is None or zr.has(z) for z in zones_u], dtype=bool
+                [zr is None or zr.has(z) for z in pr.zones], dtype=bool
             )
             cr = merged.get(L.LABEL_CAPACITY_TYPE)
             ct_ok = np.array(
-                [cr is None or cr.has(ct) for ct in cts_u], dtype=bool
+                [cr is None or cr.has(ct) for ct in pr.capacity_types], dtype=bool
             )
-            row[rows] = type_ok[t_of] & zone_ok[z_of] & ct_ok[ct_of]
+            row[pr.rows] = type_ok[pr.t_of] & zone_ok[pr.z_of] & ct_ok[pr.ct_of]
         for e, sn in enumerate(live):
             row[first_existing + e] = _fits_existing(rep, sched, sn)
         feas[g_idx] = row
+
+    req_mat = (
+        np.stack([_vec(cm.requests, axes) for cm in classes])
+        if classes
+        else np.zeros((0, R), np.float32)
+    )
 
     # pool weight priority (reference designs/provisioner-priority.md): the
     # oracle tries pools highest-weight-first and commits to the first that
@@ -437,22 +534,11 @@ def compile_problem(
     # feasibility to its highest-weight admitting pool (label-compatible AND
     # resource-fitting at least one config).
     if len(pools) > 1:
-        req_mat = (
-            np.stack([_vec(cm.requests, axes) for cm in classes])
-            if classes
-            else np.zeros((0, R), np.float32)
-        )
-        alloc_mat = _alloc_matrix(configs, pool_overhead, axes)
-        pool_of = np.array(
-            [
-                pools.index(cfg.pool) if cfg.pool is not None else -1
-                for cfg in configs
-            ],
-            dtype=np.int32,
-        )
+        pool_of = np.full(C, -1, np.int32)
+        pool_of[:first_existing] = catalog.pool_rank_of
         for g in range(G):
-            fits = (req_mat[g][None, :] <= alloc_mat + 1e-6).all(axis=1)
-            for rank, pool in enumerate(pools):
+            fits = (req_mat[g][None, :] <= alloc + 1e-6).all(axis=1)
+            for rank in range(len(pools)):
                 sel = (pool_of == rank) & feas[g] & fits
                 if sel.any():
                     feas[g] &= (pool_of == rank) | (pool_of == -1)
@@ -469,23 +555,21 @@ def compile_problem(
                 if s is not None:
                     sig_used0[s, e] += 1
 
-    prob = CompiledProblem(
+    return CompiledProblem(
         axes=axes,
         classes=classes,
         configs=configs,
-        req=np.stack([_vec(cm.requests, axes) for cm in classes])
-        if classes
-        else np.zeros((0, R), np.float32),
+        req=req_mat,
         cnt=np.array([len(cm.pods) for cm in classes], dtype=np.int32),
         maxper=np.array(
             [min(cm.max_per_node, BIG) for cm in classes], dtype=np.int32
         ),
         slot=np.array([cm.track_slot for cm in classes], dtype=np.int32),
-        alloc=_alloc_matrix(configs, pool_overhead, axes),
-        price=np.array([c.price for c in configs], dtype=np.float32),
-        openable=np.array([c.existing is None for c in configs], dtype=bool),
+        alloc=alloc,
+        price=price,
+        openable=openable,
         feas=feas,
-        pool_daemon_overhead=pool_overhead,
+        pool_daemon_overhead=catalog.pool_overhead,
         used0=np.stack([_vec(sn.used, axes) for sn in live])
         if live
         else np.zeros((0, R), np.float32),
@@ -495,7 +579,6 @@ def compile_problem(
         n_track_slots=S,
         unsupported_reason=reason,
     )
-    return prob
 
 
 def _balanced_split(n: int, existing_counts: Dict[str, int]) -> Dict[str, int]:
@@ -528,22 +611,3 @@ def _fits_existing(rep: Pod, sched: Requirements, sn: StateNode) -> bool:
         return False
     node_reqs = Requirements.from_labels(sn.labels)
     return node_reqs.compatible(sched)
-
-
-def _alloc_matrix(
-    configs: Sequence[ConfigMeta],
-    pool_overhead: Dict[str, Resources],
-    axes: Sequence[str],
-) -> np.ndarray:
-    rows = []
-    for cfg in configs:
-        if cfg.existing is not None:
-            rows.append(_vec(cfg.existing.allocatable, axes))
-        else:
-            alloc = (
-                cfg.instance_type.allocatable() - pool_overhead[cfg.pool.name]
-            ).clamp_nonnegative()
-            rows.append(_vec(alloc, axes))
-    if not rows:
-        return np.zeros((0, len(axes)), np.float32)
-    return np.stack(rows)

@@ -1,0 +1,9 @@
+"""Mesh sharding for the solver (data = node slots, model = config catalog)."""
+
+from karpenter_tpu.parallel.mesh import (
+    assemble_feasibility,
+    make_mesh,
+    sharded_solve_step,
+)
+
+__all__ = ["assemble_feasibility", "make_mesh", "sharded_solve_step"]

@@ -1,0 +1,115 @@
+"""Device-mesh sharding for the scheduling solver.
+
+The reference scales its solve by batching windows and per-provisioner
+serialization in one Go process (SURVEY.md §5: no distributed backend).
+The TPU build instead shards the solve over a `jax.sharding.Mesh` and lets
+XLA insert the collectives:
+
+- axis **"data"**: the node-slot axis K — each device owns a shard of the
+  open-bin state (residual usage, config commitments, per-signature
+  counters).  The first-fit prefix allocation is a cumsum along K, which
+  XLA SPMD lowers to an ICI collective prefix.
+- axis **"model"**: the config axis C — the instance-type x zone x
+  capacity-type catalog is partitioned like a sharded embedding table; the
+  per-class argmin over C becomes an all-reduce.
+- the **class axis G is the sequential dimension** (the `lax.scan` time
+  axis) — the analogue of microbatched pipeline steps; it cannot be
+  sharded, and doesn't need to be: per-step work is O(K·R + C·R).
+
+The same mesh recipe runs on one chip (trivial mesh), an ICI-connected
+slice, or CPU with `--xla_force_host_platform_device_count` for tests and
+the driver's multi-chip dry run.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from karpenter_tpu.ops.packer import PackResult, pack_kernel
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 2D (data, model) mesh over the first `n_devices` devices.
+
+    Even device counts split (n/2, 2) so both axes are exercised; odd
+    counts degrade to (n, 1).
+    """
+    devices = jax.devices()
+    n = n_devices if n_devices is not None else len(devices)
+    devices = devices[:n]
+    if n >= 2 and n % 2 == 0:
+        shape = (n // 2, 2)
+    else:
+        shape = (n, 1)
+    return Mesh(np.array(devices).reshape(shape), (DATA_AXIS, MODEL_AXIS))
+
+
+def assemble_feasibility(
+    type_ok: jax.Array,  # [S, T] bool — signature x type admission
+    zone_ok: jax.Array,  # [S, Z] bool
+    ct_ok: jax.Array,  # [S, CT] bool
+    sig_of: jax.Array,  # [G] int32 — class -> signature
+    t_of: jax.Array,  # [C] int32 — config -> type index
+    z_of: jax.Array,  # [C] int32
+    ct_of: jax.Array,  # [C] int32
+) -> jax.Array:
+    """Expand factorized admission vectors into the dense [G, C] mask.
+
+    This is the device-side counterpart of the numpy assembly in
+    ops/tensorize.py — the O(G·C) part of constraint compilation, sharded
+    G over "data" and C over "model" so each device materializes only its
+    tile of the mask.
+    """
+    g_rows = type_ok[sig_of]  # [G, T]
+    z_rows = zone_ok[sig_of]  # [G, Z]
+    ct_rows = ct_ok[sig_of]  # [G, CT]
+    return g_rows[:, t_of] & z_rows[:, z_of] & ct_rows[:, ct_of]
+
+
+def sharded_solve_step(mesh: Mesh, k_slots: int):
+    """Build the jitted, mesh-sharded full solve step.
+
+    Returns ``step(type_ok, zone_ok, ct_ok, sig_of, t_of, z_of, ct_of,
+    req, cnt, maxper, slot, alloc, price, openable, used0, cfg0, npods0,
+    next0, sig0) -> PackResult`` — feasibility expansion followed by the
+    packing scan, compiled once over the mesh with the shardings described
+    in the module docstring.
+    """
+    repl = NamedSharding(mesh, P())
+    on_c = NamedSharding(mesh, P(MODEL_AXIS))
+    on_c2 = NamedSharding(mesh, P(MODEL_AXIS, None))
+    on_k = NamedSharding(mesh, P(DATA_AXIS))
+    on_k2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    on_g = NamedSharding(mesh, P(DATA_AXIS))
+    on_sk = NamedSharding(mesh, P(None, DATA_AXIS))
+
+    def step(
+        type_ok, zone_ok, ct_ok, sig_of, t_of, z_of, ct_of,
+        req, cnt, maxper, slot, alloc, price, openable,
+        used0, cfg0, npods0, next0, sig0,
+    ) -> PackResult:
+        feas = assemble_feasibility(
+            type_ok, zone_ok, ct_ok, sig_of, t_of, z_of, ct_of
+        )
+        return pack_kernel(
+            req, cnt, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, next0, sig0, k_slots=k_slots,
+        )
+
+    return jax.jit(
+        step,
+        in_shardings=(
+            repl, repl, repl, on_g, on_c, on_c, on_c,  # admission + maps
+            repl, repl, repl, repl,  # class tensors (scan xs)
+            on_c2, on_c, on_c,  # catalog: alloc, price, openable
+            on_k2, on_k, on_k, repl, on_sk,  # bin state
+        ),
+    )

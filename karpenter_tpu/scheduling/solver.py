@@ -24,7 +24,12 @@ from karpenter_tpu.api import labels as L
 from karpenter_tpu.api.requirements import Op
 from karpenter_tpu.api.resources import Resources
 from karpenter_tpu.ops.packer import run_pack
-from karpenter_tpu.ops.tensorize import CompiledProblem, ConfigMeta, compile_problem
+from karpenter_tpu.ops.tensorize import (
+    CompiledProblem,
+    ConfigMeta,
+    build_catalog,
+    compile_problem,
+)
 from karpenter_tpu.scheduling.scheduler import (
     Scheduler,
     SchedulingResult,
@@ -52,29 +57,62 @@ class TensorScheduler:
         self.zones = list(zones)
         self.objective = objective
         self.last_path = ""  # "tensor" | "oracle" (observability)
+        # Prebuilt config-axis tensors — the analogue of the reference's
+        # seqnum-keyed instance-type cache (instancetype.go:97-104).
+        # Invalidation is identity-based: the instance-type provider returns
+        # a NEW list object whenever inventory or the ICE cache changes, so
+        # the cache key captures the object identities of every input.
+        self._catalog_key: tuple = ()
+        self._catalog = None
 
     # ------------------------------------------------------------------ solve
     def solve(self, pods: Iterable[Pod]) -> SchedulingResult:
+        import jax
+
         pods = list(pods)
+        from karpenter_tpu.ops.tensorize import _axes_for
+
+        axes = _axes_for(pods)
+        key = (
+            axes,
+            tuple(id(p) for p in self.pools),
+            tuple(sorted((k, id(v)) for k, v in self.instance_types.items())),
+            tuple(id(d) for d in self.daemonsets),
+        )
+        if key != self._catalog_key:
+            self._catalog = build_catalog(
+                self.pools, self.instance_types, self.daemonsets, axes
+            )
+            self._catalog_key = key
+        catalog = self._catalog
         prob = compile_problem(
             pods,
             self.pools,
             self.instance_types,
             existing=self.existing,
             daemonsets=self.daemonsets,
+            catalog=catalog,
         )
         if not prob.supported:
             return self._oracle(pods)
         self.last_path = "tensor"
         result = run_pack(prob, objective=self.objective)
+        # one transfer for everything decode needs (the device link may be
+        # high-latency; per-array fetches would pay the round trip each)
+        take, leftover, node_cfg = jax.device_get(
+            (result.take, result.leftover, result.node_cfg)
+        )
         # grow the slot bucket if the solve ran out of node slots while
         # feasible configs remained
-        k = int(result.node_cfg.shape[0])
+        k = int(node_cfg.shape[0])
         max_k = len(prob.used0) + prob.total_pods()
-        while self._overflowed(prob, result) and k < max_k:
+        while self._overflowed(prob, leftover) and k < max_k:
             k *= 2
             result = run_pack(prob, k_slots=k, objective=self.objective)
-        return self._decode(prob, result)
+            take, leftover, node_cfg = jax.device_get(
+                (result.take, result.leftover, result.node_cfg)
+            )
+        return self._decode(prob, take, node_cfg)
 
     def _oracle(self, pods: List[Pod]) -> SchedulingResult:
         self.last_path = "oracle"
@@ -88,11 +126,10 @@ class TensorScheduler:
 
     # ------------------------------------------------------------- internals
     @staticmethod
-    def _overflowed(prob: CompiledProblem, result) -> bool:
+    def _overflowed(prob: CompiledProblem, leftover: np.ndarray) -> bool:
         """Leftover pods whose class has an openable config that would truly
         HOLD them (label-feasible AND resource-fitting) mean the solve ran
         out of node slots — only then is a bigger-K retry worthwhile."""
-        leftover = np.asarray(result.leftover)
         G = len(prob.classes)
         if not leftover[:G].any():
             return False
@@ -102,10 +139,9 @@ class TensorScheduler:
         placeable = (prob.feas & prob.openable[None, :] & fits).any(axis=1)
         return bool((leftover[:G] > 0)[placeable].any())
 
-    def _decode(self, prob: CompiledProblem, result) -> SchedulingResult:
-        take = np.asarray(result.take)  # [Gp, Kp]
-        leftover = np.asarray(result.leftover)
-        node_cfg = np.asarray(result.node_cfg)  # [Kp]
+    def _decode(
+        self, prob: CompiledProblem, take: np.ndarray, node_cfg: np.ndarray
+    ) -> SchedulingResult:
         out = SchedulingResult()
 
         # slot -> decoded node (lazily created so empty slots cost nothing)
@@ -134,8 +170,8 @@ class TensorScheduler:
                 else:
                     vn = vnode_for(int(k))
                     vn.pods.extend(batch)
-                    for p in batch:
-                        vn.used = vn.used + p.requests
+                    # one scaled add per (class, node) instead of per pod
+                    vn.used = vn.used + cm.requests.scaled(len(batch))
             for p in cm.pods[cursor:]:
                 out.unschedulable[p.key()] = self._why_unschedulable(prob, g)
         return out

@@ -7,7 +7,31 @@ control, and `reset()` between specs (environment.go:150-176).
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence
+
+
+def pin_cpu_platform(n_devices: int = 8) -> None:
+    """Force JAX onto `n_devices` virtual CPU devices.
+
+    Must be called before the JAX backend initializes.  Setting the
+    JAX_PLATFORMS env var alone is NOT enough on this image: the axon TPU
+    plugin re-registers itself regardless, so the platform is also pinned
+    via jax.config.  Used by tests/conftest.py and the driver-facing
+    `__graft_entry__.dryrun_multichip`.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; rely on existing devices
 
 from karpenter_tpu.api import NodeClass, NodePool, Settings
 from karpenter_tpu.api.objects import SelectorTerm

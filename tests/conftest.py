@@ -2,11 +2,6 @@
 sharding/pjit path is exercised without TPU hardware (the driver separately
 dry-runs the multichip path; bench.py runs on the real chip)."""
 
-import os
+from karpenter_tpu.testing import pin_cpu_platform
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+pin_cpu_platform(8)

@@ -1,0 +1,110 @@
+"""Mesh-sharded solve must produce identical results to the single-device
+kernel (conftest pins an 8-device virtual CPU platform)."""
+
+import jax
+import numpy as np
+import pytest
+
+from karpenter_tpu.ops.packer import pack_kernel
+from karpenter_tpu.parallel.mesh import (
+    assemble_feasibility,
+    make_mesh,
+    sharded_solve_step,
+)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    S, T, Z, CT, R = 4, 8, 2, 2, 6
+    C = T * Z * CT * 2  # 64, divisible by the model axis
+    G, K = 16, 32
+    rng = np.random.RandomState(42)
+    return dict(
+        type_ok=rng.rand(S, T) < 0.8,
+        zone_ok=np.ones((S, Z), bool),
+        ct_ok=np.ones((S, CT), bool),
+        sig_of=(np.arange(G) % S).astype(np.int32),
+        t_of=(np.arange(C) % T).astype(np.int32),
+        z_of=((np.arange(C) // T) % Z).astype(np.int32),
+        ct_of=((np.arange(C) // (T * Z)) % CT).astype(np.int32),
+        req=(np.abs(rng.rand(G, R)) + 0.1).astype(np.float32),
+        cnt=np.full(G, 5, np.int32),
+        maxper=np.full(G, 2**20, np.int32),
+        slot=np.zeros(G, np.int32),
+        alloc=(np.abs(rng.rand(C, R)) * 16 + 8).astype(np.float32),
+        price=(rng.rand(C) + 0.5).astype(np.float32),
+        openable=np.ones(C, bool),
+        used0=np.zeros((K, R), np.float32),
+        cfg0=np.full(K, -1, np.int32),
+        npods0=np.zeros(K, np.int32),
+        next0=np.int32(0),
+        sig0=np.zeros((5, K), np.int32),
+    )
+
+
+def test_mesh_shape():
+    mesh = make_mesh(8)
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("data", "model")
+
+
+def test_sharded_matches_single_device(problem):
+    p = problem
+    K = p["used0"].shape[0]
+    feas = np.asarray(
+        assemble_feasibility(
+            p["type_ok"], p["zone_ok"], p["ct_ok"],
+            p["sig_of"], p["t_of"], p["z_of"], p["ct_of"],
+        )
+    )
+    single = pack_kernel(
+        p["req"], p["cnt"], p["maxper"], p["slot"], feas,
+        p["alloc"], p["price"], p["openable"],
+        p["used0"], p["cfg0"], p["npods0"], p["next0"], p["sig0"],
+        k_slots=K,
+    )
+    mesh = make_mesh(8)
+    step = sharded_solve_step(mesh, k_slots=K)
+    sharded = step(
+        p["type_ok"], p["zone_ok"], p["ct_ok"],
+        p["sig_of"], p["t_of"], p["z_of"], p["ct_of"],
+        p["req"], p["cnt"], p["maxper"], p["slot"],
+        p["alloc"], p["price"], p["openable"],
+        p["used0"], p["cfg0"], p["npods0"], p["next0"], p["sig0"],
+    )
+    jax.block_until_ready(sharded)
+    np.testing.assert_array_equal(np.asarray(single.take), np.asarray(sharded.take))
+    np.testing.assert_array_equal(
+        np.asarray(single.node_cfg), np.asarray(sharded.node_cfg)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.leftover), np.asarray(sharded.leftover)
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.node_used), np.asarray(sharded.node_used), rtol=1e-5
+    )
+
+
+def test_all_pods_placed_or_leftover(problem):
+    p = problem
+    K = p["used0"].shape[0]
+    feas = np.asarray(
+        assemble_feasibility(
+            p["type_ok"], p["zone_ok"], p["ct_ok"],
+            p["sig_of"], p["t_of"], p["z_of"], p["ct_of"],
+        )
+    )
+    out = pack_kernel(
+        p["req"], p["cnt"], p["maxper"], p["slot"], feas,
+        p["alloc"], p["price"], p["openable"],
+        p["used0"], p["cfg0"], p["npods0"], p["next0"], p["sig0"],
+        k_slots=K,
+    )
+    total = int(np.asarray(out.take).sum()) + int(np.asarray(out.leftover).sum())
+    assert total == int(p["cnt"].sum())
+    # no node slot overcommitted on any resource axis
+    cfg = np.asarray(out.node_cfg)
+    used = np.asarray(out.node_used)
+    for k in range(K):
+        if cfg[k] >= 0:
+            assert (used[k] <= p["alloc"][cfg[k]] + 1e-3).all()
