@@ -21,6 +21,7 @@ from karpenter_tpu.api.objects import (  # noqa: F401
     Disruption,
     NodePool,
     NodeClaim,
+    NodeClaimCondition,
     NodeClass,
 )
 from karpenter_tpu.api.settings import Settings  # noqa: F401

@@ -1,0 +1,5 @@
+"""Request coalescing (reference pkg/batcher)."""
+
+from karpenter_tpu.batcher.core import Batcher, BatchStats
+
+__all__ = ["Batcher", "BatchStats"]

@@ -1,0 +1,145 @@
+"""Request coalescing (reference pkg/batcher/batcher.go:63-120).
+
+Concurrent identical cloud calls merge into one: requests hash into
+buckets; a bucket flushes when `idle_s` passes with no new arrivals, when
+`max_s` elapses since the first request, or when `max_items` accumulate.
+One worker thread per bucket executes the merged call and fans results
+back out to the waiting callers.
+
+Window defaults mirror the reference: CreateFleet 35ms idle / 1s max /
+1000 items (createfleet.go:35-37), DescribeInstances and
+TerminateInstances 100ms / 1s / 500 (describeinstances.go:38-40,
+terminateinstances.go:38-40).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Hashable, List, Sequence, Tuple
+
+CREATE_FLEET_WINDOWS = (0.035, 1.0, 1000)
+DESCRIBE_WINDOWS = (0.1, 1.0, 500)
+TERMINATE_WINDOWS = (0.1, 1.0, 500)
+
+
+@dataclass
+class BatchStats:
+    """Observability counters (reference batcher/metrics.go emits batch
+    size / window-duration metrics)."""
+
+    batches: int = 0
+    items: int = 0
+    sizes: List[int] = field(default_factory=list)
+
+    def record(self, size: int) -> None:
+        self.batches += 1
+        self.items += size
+        self.sizes.append(size)
+
+
+class Batcher:
+    """Generic request batcher.
+
+    ``executor(requests) -> list[result]`` receives the merged bucket (in
+    arrival order) and returns one result per request (or raises — the
+    exception fans out to every waiter).  ``hasher(request)`` routes
+    requests that cannot be merged into separate buckets (e.g.
+    DescribeInstances calls with different filters,
+    describeinstances.go:44-55).
+    """
+
+    def __init__(
+        self,
+        executor: Callable[[Sequence[Any]], Sequence[Any]],
+        idle_s: float = 0.035,
+        max_s: float = 1.0,
+        max_items: int = 1000,
+        hasher: Callable[[Any], Hashable] = lambda _req: 0,
+        name: str = "batcher",
+    ):
+        self.executor = executor
+        self.idle_s = idle_s
+        self.max_s = max_s
+        self.max_items = max_items
+        self.hasher = hasher
+        self.name = name
+        self.stats = BatchStats()
+        self._lock = threading.Lock()
+        self._buckets: Dict[Hashable, _Bucket] = {}
+
+    def submit(self, request: Any) -> Future:
+        """Queue a request; the returned Future resolves to its result."""
+        key = self.hasher(request)
+        fut: Future = Future()
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket is None or bucket.closed:
+                bucket = _Bucket(self, key)
+                self._buckets[key] = bucket
+                bucket.add(request, fut)
+                bucket.start()
+            else:
+                bucket.add(request, fut)
+        return fut
+
+    def call(self, request: Any) -> Any:
+        """Submit and wait (convenience for synchronous callers)."""
+        return self.submit(request).result()
+
+    def _detach(self, key: Hashable, bucket: "_Bucket") -> None:
+        with self._lock:
+            if self._buckets.get(key) is bucket:
+                del self._buckets[key]
+
+
+class _Bucket:
+    def __init__(self, parent: Batcher, key: Hashable):
+        self.parent = parent
+        self.key = key
+        self.items: List[Tuple[Any, Future]] = []
+        self.closed = False
+        self._cv = threading.Condition()
+        self._first_at = time.monotonic()
+        self._last_at = self._first_at
+
+    def add(self, request: Any, fut: Future) -> None:
+        with self._cv:
+            self.items.append((request, fut))
+            self._last_at = time.monotonic()
+            if len(self.items) >= self.parent.max_items:
+                self.closed = True
+            self._cv.notify()
+
+    def start(self) -> None:
+        threading.Thread(target=self._run, daemon=True, name=self.parent.name).start()
+
+    def _run(self) -> None:
+        idle, max_s = self.parent.idle_s, self.parent.max_s
+        with self._cv:
+            while not self.closed:
+                now = time.monotonic()
+                deadline = min(self._last_at + idle, self._first_at + max_s)
+                if now >= deadline:
+                    self.closed = True
+                    break
+                self._cv.wait(timeout=deadline - now)
+        self.parent._detach(self.key, self)
+        requests = [r for r, _ in self.items]
+        futures = [f for _, f in self.items]
+        self.parent.stats.record(len(requests))
+        try:
+            results = self.parent.executor(requests)
+            if len(results) != len(requests):
+                raise RuntimeError(
+                    f"{self.parent.name}: executor returned {len(results)} "
+                    f"results for {len(requests)} requests"
+                )
+            for fut, res in zip(futures, results):
+                fut.set_result(res)
+        except Exception as exc:  # fan the failure out to every caller
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(exc)

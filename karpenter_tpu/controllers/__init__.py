@@ -1,0 +1,22 @@
+"""Control loops (reference pkg/controllers + the karpenter-core loops
+re-created per SURVEY.md §2b)."""
+
+from karpenter_tpu.controllers.disruption import DisruptionController
+from karpenter_tpu.controllers.garbagecollection import GarbageCollectionController
+from karpenter_tpu.controllers.interruption import InterruptionController
+from karpenter_tpu.controllers.lifecycle import LifecycleController
+from karpenter_tpu.controllers.nodeclass import NodeClassController
+from karpenter_tpu.controllers.provisioning import Provisioner
+from karpenter_tpu.controllers.tagging import TaggingController
+from karpenter_tpu.controllers.termination import TerminationController
+
+__all__ = [
+    "DisruptionController",
+    "GarbageCollectionController",
+    "InterruptionController",
+    "LifecycleController",
+    "NodeClassController",
+    "Provisioner",
+    "TaggingController",
+    "TerminationController",
+]

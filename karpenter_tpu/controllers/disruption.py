@@ -1,0 +1,329 @@
+"""Deprovisioning controller: expiration -> drift -> emptiness -> consolidation.
+
+Re-derivation of karpenter-core's deprovisioning loop (reference website
+v0.31 concepts/deprovisioning.md:14-24 ordering; designs/consolidation.md):
+
+- **expiration**: nodes older than pool.disruption.expire_after are
+  replaced (pods reschedule via the provisioner).
+- **drift**: the CloudProvider's drift reasons (feature-gated).
+- **emptiness**: pools with consolidationPolicy=WhenEmpty delete nodes
+  holding no reschedulable pods after consolidate_after quiet time.
+- **consolidation** (WhenUnderutilized): candidates ranked by disruption
+  cost — fewest pods, soonest-expiring, lowest priority
+  (designs/consolidation.md:23-40) — validated by a scheduling SIMULATION:
+  a candidate may be deleted when its pods fit on the remaining nodes, or
+  replaced when they fit with one strictly-cheaper new node.  Multi-node
+  consolidation deletes a whole candidate subset with a single (optional)
+  replacement.  Spot nodes are delete-only (deprovisioning.md:83-110).
+- **budgets**: pool.disruption.budgets caps concurrent disruptions per
+  pool ("10%" or an absolute count).
+
+Every mechanism funnels into the termination controller's graceful
+cordon-and-drain; replacements launch through the provisioner's normal
+path once the evicted pods go pending.  Blockers (do-not-evict pods,
+already-disrupting nodes, pods without controllers) follow
+designs/consolidation.md:46-53.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.api import NodeClaim, NodePool, Pod
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.cloud.provider import CloudProvider
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.metrics.registry import REGISTRY, Registry
+from karpenter_tpu.scheduling.solver import TensorScheduler
+from karpenter_tpu.state.cluster import Cluster, StateNode
+from karpenter_tpu.state.kube import KubeStore
+from karpenter_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+# how many top-ranked candidates multi-node consolidation considers per
+# pass (the reference bounds its subset search the same way)
+MULTI_NODE_CANDIDATES = 10
+
+
+@dataclass
+class Candidate:
+    claim: NodeClaim
+    state: StateNode
+    pool: NodePool
+    reschedulable: List[Pod]
+    price: float
+
+    def disruption_cost(self) -> Tuple:
+        """Rank: fewest pods first, then lowest pod priority, then price
+        (designs/consolidation.md:23-40)."""
+        prio = max((p.priority for p in self.reschedulable), default=0)
+        cost = sum(p.deletion_cost() for p in self.reschedulable)
+        return (len(self.reschedulable), prio, cost, -self.price)
+
+
+class DisruptionController:
+    def __init__(
+        self,
+        kube: KubeStore,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        termination: TerminationController,
+        clock: Clock,
+        feature_gate_drift: bool = True,
+        registry: Registry = REGISTRY,
+    ):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.termination = termination
+        self.clock = clock
+        self.feature_gate_drift = feature_gate_drift
+        self.registry = registry
+        self._last_non_empty: Dict[str, float] = {}  # claim -> last busy ts
+        self._budgets: Dict[str, int] = {}  # per-pool allowance, per pass
+
+    # ------------------------------------------------------------- reconcile
+    def reconcile(self) -> None:
+        """One pass in the reference's mechanism order; at most one
+        disruption action per pass per mechanism keeps the cluster
+        observable between steps (the reference serializes the same way)."""
+        with self.registry.time(
+            "karpenter_deprovisioning_evaluation_duration_seconds"
+        ):
+            self._budgets = self._remaining_budgets()
+            candidates = self._candidates()
+            if self._expire(candidates):
+                return
+            if self.feature_gate_drift and self._drift(candidates):
+                return
+            if self._emptiness(candidates):
+                return
+            self._consolidate(candidates)
+
+    # ------------------------------------------------------------ candidates
+    def _candidates(self) -> List[Candidate]:
+        out = []
+        for sn in self.cluster.snapshot():
+            claim = sn.claim
+            if claim is None or claim.deleted_at is not None:
+                continue
+            if not claim.initialized:
+                continue  # only initialized nodes are disruptable
+            pool = self.kube.node_pools.get(sn.pool_name)
+            if pool is None or pool.deleted:
+                continue
+            if self._budgets.get(pool.name, 1) <= 0:
+                continue
+            reschedulable = [p for p in sn.pods if not p.is_daemonset]
+            out.append(
+                Candidate(
+                    claim=claim,
+                    state=sn,
+                    pool=pool,
+                    reschedulable=reschedulable,
+                    price=claim.price,
+                )
+            )
+        return out
+
+    def _remaining_budgets(self) -> Dict[str, int]:
+        """Per-pool disruption allowance this pass
+        (pool.disruption.budgets: "10%" of nodes or an absolute count;
+        active disruptions consume the budget)."""
+        counts: Dict[str, int] = {}
+        disrupting: Dict[str, int] = {}
+        for sn in self.cluster.snapshot():
+            pool = sn.pool_name
+            if not pool:
+                continue
+            counts[pool] = counts.get(pool, 0) + 1
+            if sn.marked_for_deletion():
+                disrupting[pool] = disrupting.get(pool, 0) + 1
+        out: Dict[str, int] = {}
+        for name, pool in self.kube.node_pools.items():
+            total = counts.get(name, 0)
+            allowed = total  # default: unbounded
+            for b in pool.disruption.budgets:
+                if b.endswith("%"):
+                    allowed = min(
+                        allowed, math.ceil(total * float(b[:-1]) / 100.0)
+                    )
+                else:
+                    allowed = min(allowed, int(b))
+            out[name] = allowed - disrupting.get(name, 0)
+        return out
+
+    # ------------------------------------------------------------ mechanisms
+    def _expire(self, candidates: Sequence[Candidate]) -> bool:
+        for c in candidates:
+            ttl = c.pool.disruption.expire_after
+            if ttl is None:
+                continue
+            if self.clock.now() - c.claim.created_at >= ttl:
+                if self._disrupt(c, "expired"):
+                    return True
+        return False
+
+    def _drift(self, candidates: Sequence[Candidate]) -> bool:
+        for c in candidates:
+            reason = self.cloud_provider.is_drifted(c.claim)
+            if reason:
+                c.claim.set_condition("Drifted")
+                if self._disrupt(c, f"drifted/{reason}"):
+                    return True
+        return False
+
+    def _emptiness(self, candidates: Sequence[Candidate]) -> bool:
+        """WhenEmpty pools: delete nodes quiet for consolidate_after
+        (deprovisioning.md emptiness)."""
+        now = self.clock.now()
+        acted = False
+        for c in candidates:
+            if c.pool.disruption.consolidation_policy != "WhenEmpty":
+                continue
+            if c.reschedulable:
+                self._last_non_empty[c.claim.name] = now
+                continue
+            quiet_since = self._last_non_empty.get(
+                c.claim.name, c.claim.created_at
+            )
+            wait = c.pool.disruption.consolidate_after or 0.0
+            if now - quiet_since >= wait:
+                c.claim.set_condition("Empty")
+                if self._disrupt(c, "emptiness"):
+                    acted = True  # empty nodes delete in parallel, per budget
+        return acted
+
+    # --------------------------------------------------------- consolidation
+    def _consolidate(self, candidates: Sequence[Candidate]) -> bool:
+        pool_candidates = [
+            c
+            for c in candidates
+            if c.pool.disruption.consolidation_policy == "WhenUnderutilized"
+            and self._consolidatable(c)
+        ]
+        pool_candidates.sort(key=lambda c: c.disruption_cost())
+        if not pool_candidates:
+            return False
+        # multi-node first (bigger wins), then single-node scan
+        if self._consolidate_multi(pool_candidates):
+            return True
+        for c in pool_candidates:
+            if self._consolidate_single(c):
+                return True
+        return False
+
+    def _consolidatable(self, c: Candidate) -> bool:
+        """Blockers per designs/consolidation.md:46-53; the
+        do-not-consolidate annotation exempts a node from consolidation
+        only (expiration/drift/emptiness still apply)."""
+        if c.claim.annotations.get(L.ANNOTATION_DO_NOT_CONSOLIDATE) == "true":
+            return False
+        if any(p.do_not_evict() for p in c.reschedulable):
+            return False
+        if any(not p.has_controller for p in c.reschedulable):
+            return False
+        wait = c.pool.disruption.consolidate_after
+        if wait:
+            age = self.clock.now() - c.claim.created_at
+            if age < wait:
+                return False
+        return True
+
+    def _consolidate_single(self, c: Candidate) -> bool:
+        fits, replacement_price = self._simulate([c])
+        if not fits:
+            return False
+        if replacement_price == 0.0:
+            return self._disrupt(c, "consolidation/delete")
+        # replacement must be strictly cheaper; spot nodes are delete-only
+        # (deprovisioning.md:83-110)
+        if c.claim.capacity_type == L.CAPACITY_TYPE_SPOT:
+            return False
+        if replacement_price < c.price:
+            return self._disrupt(c, "consolidation/replace")
+        return False
+
+    def _consolidate_multi(self, ranked: Sequence[Candidate]) -> bool:
+        """Largest prefix of the cost-ranked candidates whose pods fit on
+        the remaining nodes plus at most one cheaper replacement
+        (designs/consolidation.md mechanisms:5-21)."""
+        best: Optional[List[Candidate]] = None
+        pool = list(ranked[:MULTI_NODE_CANDIDATES])
+        for size in range(len(pool), 1, -1):
+            subset = pool[:size]
+            fits, replacement_price = self._simulate(subset)
+            if not fits:
+                continue
+            combined = sum(c.price for c in subset)
+            if any(
+                c.claim.capacity_type == L.CAPACITY_TYPE_SPOT for c in subset
+            ) and replacement_price > 0:
+                continue
+            if replacement_price < combined:
+                best = subset
+                break
+        if best is None:
+            return False
+        acted = False
+        for c in best:
+            if self._disrupt(c, "consolidation/multi"):
+                acted = True
+        return acted
+
+    def _simulate(
+        self, removed: Sequence[Candidate]
+    ) -> Tuple[bool, float]:
+        """Scheduling simulation: do the removed nodes' pods fit on the
+        remaining capacity plus at most ONE new (cheaper) node?
+
+        Returns (fits, replacement_price) — replacement_price 0.0 means
+        pure deletion suffices.  Reuses the tensor solver with the
+        candidate nodes excluded from the snapshot (the same kernel the
+        provisioner uses; SURVEY §7 step 7)."""
+        removed_names = {c.state.name for c in removed}
+        remaining = [
+            sn
+            for sn in self.cluster.snapshot()
+            if sn.name not in removed_names and not sn.marked_for_deletion()
+        ]
+        pods = [p for c in removed for p in c.reschedulable]
+        if not pods:
+            return True, 0.0
+        pools = [p for p in self.kube.node_pools.values() if not p.deleted]
+        inventory = {
+            pool.name: self.cloud_provider.get_instance_types(pool)
+            for pool in pools
+        }
+        scheduler = TensorScheduler(
+            pools,
+            inventory,
+            existing=remaining,
+            daemonsets=self.kube.daemonset_pods(),
+            objective="cost",
+        )
+        result = scheduler.solve(pods)
+        if result.unschedulable:
+            return False, 0.0
+        if len(result.new_nodes) == 0:
+            return True, 0.0
+        if len(result.new_nodes) > 1:
+            return False, 0.0
+        return True, result.new_nodes[0].cheapest_price()
+
+    # ---------------------------------------------------------------- action
+    def _disrupt(self, c: Candidate, reason: str) -> bool:
+        """Disrupt within the pool's remaining budget for this pass."""
+        if self._budgets.get(c.pool.name, 1) <= 0:
+            return False
+        self._budgets[c.pool.name] = self._budgets.get(c.pool.name, 1) - 1
+        self.registry.inc(
+            "karpenter_deprovisioning_actions",
+            {"mechanism": reason.split("/")[0], "nodepool": c.pool.name},
+        )
+        self.termination.mark_for_deletion(c.claim, reason=reason)
+        return True

@@ -1,0 +1,120 @@
+"""Interruption controller (reference pkg/controllers/interruption).
+
+Polls the cloud event queue (the SQS analogue fed by the platform's event
+bus, designs/interruption-handling.md) and reacts to four message kinds via
+a parser registry (reference messages/*, controller.go:82-139):
+
+- spot interruption   -> mark the offering unavailable in the ICE cache
+                         (controller.go:228-235) + cordon-and-drain
+- rebalance recommendation -> cordon-and-drain (proactive)
+- scheduled change (health event) -> cordon-and-drain
+- state change (stopping/terminated) -> cordon-and-drain
+
+Draining happens by marking the NodeClaim for deletion; the termination
+controller does the graceful cordon/evict/terminate (controller.go:247-259).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from karpenter_tpu.api import NodeClaim
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+from karpenter_tpu.cloud.fake.backend import FakeCloud, QueueMessage
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.metrics.registry import REGISTRY, Registry
+from karpenter_tpu.state.kube import KubeStore
+
+log = logging.getLogger(__name__)
+
+KIND_SPOT_INTERRUPTION = "spot_interruption"
+KIND_REBALANCE = "rebalance_recommendation"
+KIND_SCHEDULED_CHANGE = "scheduled_change"
+KIND_STATE_CHANGE = "state_change"
+
+
+@dataclass
+class ParsedMessage:
+    kind: str
+    instance_id: str
+    detail: str = ""
+
+
+def _parse(body: dict) -> Optional[ParsedMessage]:
+    """Parser registry analogue (reference messages/parser.go): tolerant of
+    unknown kinds — they are dropped with a metric, not an error."""
+    kind = body.get("kind")
+    instance_id = body.get("instance_id", "")
+    if kind in (
+        KIND_SPOT_INTERRUPTION,
+        KIND_REBALANCE,
+        KIND_SCHEDULED_CHANGE,
+    ):
+        return ParsedMessage(kind, instance_id, body.get("detail", ""))
+    if kind == KIND_STATE_CHANGE:
+        state = body.get("state", "")
+        if state in ("stopping", "stopped", "shutting-down", "terminated"):
+            return ParsedMessage(kind, instance_id, state)
+        return None
+    return None
+
+
+class InterruptionController:
+    def __init__(
+        self,
+        kube: KubeStore,
+        cloud: FakeCloud,
+        termination: TerminationController,
+        unavailable: UnavailableOfferings,
+        registry: Registry = REGISTRY,
+    ):
+        self.kube = kube
+        self.cloud = cloud
+        self.termination = termination
+        self.unavailable = unavailable
+        self.registry = registry
+
+    def reconcile(self) -> None:
+        messages = self.cloud.receive_messages(max_messages=10)
+        if not messages:
+            return
+        claims_by_instance: Dict[str, NodeClaim] = {
+            c.provider_id: c
+            for c in self.kube.node_claims.values()
+            if c.provider_id
+        }
+        for msg in messages:
+            self._handle(msg, claims_by_instance)
+            self.cloud.delete_message(msg)
+
+    def _handle(self, msg: QueueMessage, claims: Dict[str, NodeClaim]) -> None:
+        parsed = _parse(msg.body)
+        if parsed is None:
+            self.registry.inc(
+                "karpenter_interruption_message_parse_failed",
+            )
+            return
+        self.registry.inc(
+            "karpenter_interruption_received_messages",
+            {"message_type": parsed.kind},
+        )
+        claim = claims.get(parsed.instance_id)
+        if claim is None:
+            return  # not ours (or already gone)
+        if parsed.kind == KIND_SPOT_INTERRUPTION:
+            # remember the reclaimed pool so the next solves avoid it
+            # (reference controller.go:228-235)
+            if claim.instance_type_name and claim.zone:
+                self.unavailable.mark_unavailable(
+                    L.CAPACITY_TYPE_SPOT,
+                    claim.instance_type_name,
+                    claim.zone,
+                    reason="spot-interrupted",
+                )
+        self.kube.record_event(
+            "NodeClaim", "Interruption", claim.name, parsed.kind
+        )
+        self.termination.mark_for_deletion(claim, reason=parsed.kind)

@@ -1,0 +1,243 @@
+"""Provisioning controller: pending pods -> NodeClaims -> launched machines.
+
+Re-derivation of karpenter-core's provisioner (reference SURVEY.md §3.2):
+
+- **pod batching window**: a batch opens when the first pending pod
+  appears and closes after `batch_idle_duration` (1s) of quiet or
+  `batch_max_duration` (10s) total (website v0.31 settings.md:43-47).
+- **solve**: one scheduling pass over the batch via the tensor solver
+  (oracle fallback inside), against existing + in-flight nodes, daemonset
+  overhead, and the per-pool instance-type inventory from the
+  CloudProvider.
+- **launch**: each new virtual node becomes a NodeClaim; pool limits are
+  enforced before launch (reference designs/limits.md); claims launch
+  concurrently so the CreateFleet batcher can coalesce them; pods are
+  nominated onto their node so the next solve doesn't double-provision
+  (state.Cluster podNominations).
+- **capacity-error feedback**: a claim that fails with insufficient
+  capacity is discarded — the ICE cache already masks the failed pools, so
+  the pods re-enter the next batch and resolve onto different offerings.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.api import (
+    NodeClaim,
+    NodePool,
+    Pod,
+    Requirements,
+    Resources,
+    Settings,
+)
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.cloud.provider import CloudProvider
+from karpenter_tpu.errors import is_insufficient_capacity
+from karpenter_tpu.metrics.registry import REGISTRY, Registry
+from karpenter_tpu.scheduling.scheduler import SchedulingResult, VirtualNode
+from karpenter_tpu.scheduling.solver import TensorScheduler
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.kube import KubeStore
+from karpenter_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+
+class PodBatcher:
+    """The 1s-idle / 10s-max pending-pod window (settings.md:43-47)."""
+
+    def __init__(self, clock: Clock, idle_s: float, max_s: float):
+        self.clock = clock
+        self.idle_s = idle_s
+        self.max_s = max_s
+        self._first: Optional[float] = None
+        self._last: Optional[float] = None
+        self._seen: set = set()
+
+    def observe(self, pods: Sequence[Pod]) -> None:
+        now = self.clock.now()
+        new = {p.key() for p in pods} - self._seen
+        if not pods:
+            return
+        if self._first is None:
+            self._first = now
+            self._last = now
+            self._seen = {p.key() for p in pods}
+        elif new:
+            self._last = now
+            self._seen |= new
+
+    def ready(self) -> bool:
+        if self._first is None:
+            return False
+        now = self.clock.now()
+        return (now - self._last) >= self.idle_s or (
+            now - self._first
+        ) >= self.max_s
+
+    def reset(self) -> None:
+        self._first = self._last = None
+        self._seen = set()
+
+
+class Provisioner:
+    def __init__(
+        self,
+        kube: KubeStore,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        clock: Clock,
+        settings: Optional[Settings] = None,
+        registry: Registry = REGISTRY,
+    ):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self.settings = settings or Settings()
+        self.registry = registry
+        self.batcher = PodBatcher(
+            clock,
+            self.settings.batch_idle_duration,
+            self.settings.batch_max_duration,
+        )
+
+    # -------------------------------------------------------------- reconcile
+    def reconcile(self) -> List[NodeClaim]:
+        """One controller tick: observe pending pods, provision when the
+        batch window closes.  Returns the claims launched this tick."""
+        pending = self._provisionable_pods()
+        self.batcher.observe(pending)
+        if not pending or not self.batcher.ready():
+            return []
+        self.batcher.reset()
+        return self.provision(pending)
+
+    def _provisionable_pods(self) -> List[Pod]:
+        """Pending pods not already nominated onto an in-flight node."""
+        out = []
+        for p in self.kube.pending_pods():
+            if p.is_daemonset:
+                continue
+            if self.cluster.nominated_node(p.key()) is not None:
+                continue
+            out.append(p)
+        return out
+
+    # -------------------------------------------------------------- provision
+    def provision(self, pods: Sequence[Pod]) -> List[NodeClaim]:
+        """One scheduling solve + launches for a closed pod batch."""
+        pools = [p for p in self.kube.node_pools.values() if not p.deleted]
+        if not pools or not pods:
+            return []
+        inventory: Dict[str, list] = {}
+        for pool in pools:
+            try:
+                inventory[pool.name] = self.cloud_provider.get_instance_types(pool)
+            except Exception as exc:
+                log.warning("inventory for pool %s failed: %s", pool.name, exc)
+                inventory[pool.name] = []
+        snapshot = self.cluster.snapshot()
+        scheduler = TensorScheduler(
+            pools,
+            inventory,
+            existing=snapshot,
+            daemonsets=self.kube.daemonset_pods(),
+        )
+        with self.registry.time("karpenter_provisioner_scheduling_duration_seconds"):
+            result = scheduler.solve(pods)
+        self.registry.inc(
+            "karpenter_provisioner_scheduling_simulation_count",
+            {"path": scheduler.last_path},
+        )
+        for pod_key, reason in result.unschedulable.items():
+            self.kube.record_event("Pod", "FailedScheduling", pod_key, reason)
+        # nominate pods placed on existing nodes (the kube-scheduler binds)
+        for pod_key, node_name in result.existing_placements.items():
+            self.cluster.nominate(pod_key, node_name)
+        return self._launch(result)
+
+    def _launch(self, result: SchedulingResult) -> List[NodeClaim]:
+        claims: List[tuple] = []  # (claim, vnode)
+        usage: Dict[str, Resources] = {}
+        for vn in result.new_nodes:
+            pool = vn.pool
+            claim = self._claim_from_vnode(vn)
+            # pool limits (reference designs/limits.md): projected usage
+            # including in-flight claims must stay inside pool.limits
+            if pool.limits and not pool.limits.is_zero():
+                current = usage.get(pool.name)
+                if current is None:
+                    current = self.cluster.pool_usage(pool.name)
+                projected = current + self._claim_capacity_estimate(vn)
+                if projected.exceeds(pool.limits):
+                    self.kube.record_event(
+                        "NodePool", "LimitExceeded", pool.name,
+                        f"cannot launch {claim.name}",
+                    )
+                    continue
+                usage[pool.name] = projected
+            claims.append((claim, vn))
+
+        launched: List[NodeClaim] = []
+        if not claims:
+            return launched
+        with ThreadPoolExecutor(max_workers=min(32, len(claims))) as pool_exec:
+            futures = [
+                (claim, vn, pool_exec.submit(self.cloud_provider.create, claim))
+                for claim, vn in claims
+            ]
+            for claim, vn, fut in futures:
+                try:
+                    fut.result()
+                except Exception as exc:
+                    if is_insufficient_capacity(exc):
+                        # ICE cache already masks the pools; pods retry next
+                        # batch (reference cloudprovider.go:101 semantics)
+                        self.registry.inc("karpenter_nodeclaims_launch_failed",
+                                          {"reason": "insufficient_capacity"})
+                        self.kube.record_event(
+                            "NodeClaim", "InsufficientCapacity", claim.name,
+                            str(exc),
+                        )
+                        continue
+                    raise
+                self.kube.put_node_claim(claim)
+                self.registry.inc(
+                    "karpenter_nodeclaims_launched", {"nodepool": claim.pool_name}
+                )
+                for pod in vn.pods:
+                    self.cluster.nominate(pod.key(), claim.name)
+                launched.append(claim)
+        return launched
+
+    # ------------------------------------------------------------- claim gen
+    def _claim_from_vnode(self, vn: VirtualNode) -> NodeClaim:
+        pool = vn.pool
+        reqs = Requirements(iter(vn.requirements))
+        # constrain to the vnode's feasible types, price-ascending, top-60
+        # truncation happens in the instance provider
+        from karpenter_tpu.api.requirements import Op, Requirement
+
+        type_names = [t.name for t in vn.final_instance_types()]
+        if type_names:
+            reqs.add(Requirement(L.LABEL_INSTANCE_TYPE, Op.IN, type_names))
+        return NodeClaim(
+            pool_name=pool.name,
+            node_class_ref=pool.node_class_ref,
+            requirements=reqs,
+            requests=vn.used,
+            taints=list(pool.taints),
+            startup_taints=list(pool.startup_taints),
+            labels={**pool.labels, L.LABEL_NODEPOOL: pool.name},
+            annotations=dict(pool.annotations),
+            kubelet_max_pods=pool.kubelet_max_pods,
+        )
+
+    @staticmethod
+    def _claim_capacity_estimate(vn: VirtualNode) -> Resources:
+        it = next(iter(vn.final_instance_types()), None)
+        return it.capacity if it is not None else vn.used

@@ -1,0 +1,5 @@
+"""Metrics registry (reference: Prometheus metric set, website v0.31 metrics.md)."""
+
+from karpenter_tpu.metrics.registry import REGISTRY, Registry
+
+__all__ = ["REGISTRY", "Registry"]

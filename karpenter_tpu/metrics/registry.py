@@ -1,0 +1,103 @@
+"""In-process metrics registry (reference: controller-runtime Prometheus
+registry; metric names mirror website v0.31 concepts/metrics.md).
+
+Counters, gauges, and histograms keyed by (name, sorted labels).  The
+registry is inspectable in tests and exportable as a Prometheus-style text
+dump — the reference's ~50 published metrics map onto these names, e.g.
+`karpenter_provisioner_scheduling_duration_seconds`,
+`karpenter_nodeclaims_launched`, `karpenter_interruption_received_messages`,
+`karpenter_cloudprovider_duration_seconds`, batcher batch size/time.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+
+def _key(labels: Optional[Mapping[str, str]]) -> Tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, Dict[Tuple, float]] = defaultdict(
+            lambda: defaultdict(float)
+        )
+        self.gauges: Dict[str, Dict[Tuple, float]] = defaultdict(dict)
+        self.histograms: Dict[str, Dict[Tuple, List[float]]] = defaultdict(
+            lambda: defaultdict(list)
+        )
+
+    # ------------------------------------------------------------- recording
+    def inc(self, name: str, labels: Optional[Mapping[str, str]] = None, by: float = 1.0):
+        with self._lock:
+            self.counters[name][_key(labels)] += by
+
+    def set(self, name: str, value: float, labels: Optional[Mapping[str, str]] = None):
+        with self._lock:
+            self.gauges[name][_key(labels)] = value
+
+    def observe(self, name: str, value: float, labels: Optional[Mapping[str, str]] = None):
+        with self._lock:
+            self.histograms[name][_key(labels)].append(value)
+
+    class _Timer:
+        def __init__(self, registry: "Registry", name: str, labels):
+            self.registry, self.name, self.labels = registry, name, labels
+
+        def __enter__(self):
+            import time
+
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            import time
+
+            self.registry.observe(
+                self.name, time.perf_counter() - self._t0, self.labels
+            )
+            return False
+
+    def time(self, name: str, labels: Optional[Mapping[str, str]] = None) -> "_Timer":
+        return Registry._Timer(self, name, labels)
+
+    # ------------------------------------------------------------- reading
+    def counter(self, name: str, labels: Optional[Mapping[str, str]] = None) -> float:
+        return self.counters.get(name, {}).get(_key(labels), 0.0)
+
+    def gauge(self, name: str, labels: Optional[Mapping[str, str]] = None) -> Optional[float]:
+        return self.gauges.get(name, {}).get(_key(labels))
+
+    def histogram(self, name: str, labels: Optional[Mapping[str, str]] = None) -> List[float]:
+        return list(self.histograms.get(name, {}).get(_key(labels), ()))
+
+    def dump(self) -> str:
+        """Prometheus-text-style dump (for the /metrics analogue)."""
+        lines: List[str] = []
+        with self._lock:
+            for name, series in sorted(self.counters.items()):
+                for labels, v in sorted(series.items()):
+                    lines.append(f"{name}{_fmt(labels)} {v:g}")
+            for name, series in sorted(self.gauges.items()):
+                for labels, v in sorted(series.items()):
+                    lines.append(f"{name}{_fmt(labels)} {v:g}")
+            for name, series in sorted(self.histograms.items()):
+                for labels, vs in sorted(series.items()):
+                    lines.append(f"{name}_count{_fmt(labels)} {len(vs)}")
+                    lines.append(f"{name}_sum{_fmt(labels)} {sum(vs):g}")
+        return "\n".join(lines)
+
+
+def _fmt(labels: Tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+# process-global default registry (controllers accept an override)
+REGISTRY = Registry()

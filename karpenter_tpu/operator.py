@@ -1,0 +1,165 @@
+"""Operator: the dependency-injection root (reference pkg/operator
+operator.go:83-204 + cmd/controller/main.go:33-70).
+
+Builds caches and providers in dependency order
+(pricing -> subnet -> securitygroup -> version -> instanceprofile -> image
+-> resolver -> launchtemplate -> instancetype -> instance, reference
+operator.go:126-165), composes the CloudProvider facade, and registers the
+control loops.  `reconcile_once` drives every controller one tick — the
+deterministic, clock-stepped analogue of the controller-manager's
+goroutines; `run` loops it for real deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_tpu.api import Settings
+from karpenter_tpu.cloud.fake.backend import FakeCloud
+from karpenter_tpu.cloud.provider import CloudProvider, ProviderBundle
+from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+from karpenter_tpu.controllers.disruption import DisruptionController
+from karpenter_tpu.controllers.garbagecollection import GarbageCollectionController
+from karpenter_tpu.controllers.interruption import InterruptionController
+from karpenter_tpu.controllers.lifecycle import LifecycleController
+from karpenter_tpu.controllers.nodeclass import NodeClassController
+from karpenter_tpu.controllers.provisioning import Provisioner
+from karpenter_tpu.controllers.tagging import TaggingController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.metrics.registry import REGISTRY, Registry
+from karpenter_tpu.providers.image import ImageProvider, Resolver
+from karpenter_tpu.providers.instance import InstanceProvider
+from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
+from karpenter_tpu.providers.instancetype import InstanceTypeProvider
+from karpenter_tpu.providers.launchtemplate import LaunchTemplateProvider
+from karpenter_tpu.providers.pricing import PRICING_UPDATE_PERIOD, PricingProvider
+from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
+from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.providers.version import VersionProvider
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.kube import KubeStore
+from karpenter_tpu.utils.clock import Clock
+
+
+class Operator:
+    def __init__(
+        self,
+        cloud: FakeCloud,
+        kube: KubeStore,
+        settings: Optional[Settings] = None,
+        clock: Optional[Clock] = None,
+        registry: Registry = REGISTRY,
+        batch_windows: Optional[dict] = None,
+    ):
+        self.cloud = cloud
+        self.kube = kube
+        self.settings = settings or Settings()
+        self.settings.validate()
+        self.clock = clock or cloud.clock
+        self.registry = registry
+        self.cluster = Cluster(kube)
+
+        # ---- caches + providers, dependency order (operator.go:126-165)
+        self.unavailable = UnavailableOfferings(self.clock)
+        self.pricing = PricingProvider(cloud)
+        self.pricing.update_on_demand()
+        self.pricing.update_spot()
+        self.subnets = SubnetProvider(cloud, self.clock)
+        self.security_groups = SecurityGroupProvider(cloud, self.clock)
+        self.version = VersionProvider(cloud, self.clock)
+        self.instance_profiles = InstanceProfileProvider(
+            cloud, self.clock, self.settings.cluster_name
+        )
+        self.images = ImageProvider(cloud, self.clock)
+        self.resolver = Resolver(self.images)
+        self.launch_templates = LaunchTemplateProvider(
+            cloud,
+            self.resolver,
+            self.security_groups,
+            self.clock,
+            cluster_name=self.settings.cluster_name,
+            cluster_endpoint=self.settings.cluster_endpoint,
+        )
+        self.instance_types = InstanceTypeProvider(
+            cloud, self.pricing, self.subnets, self.unavailable,
+            self.settings, self.clock,
+        )
+        self.instances = InstanceProvider(
+            cloud, self.subnets, self.launch_templates, self.unavailable,
+            tags=self.settings.tags, batch_windows=batch_windows,
+        )
+        self.cloud_provider = CloudProvider(
+            cloud,
+            kube,
+            ProviderBundle(
+                instance_types=self.instance_types,
+                instances=self.instances,
+                images=self.images,
+                subnets=self.subnets,
+                security_groups=self.security_groups,
+            ),
+        )
+
+        # ---- controllers (conditional registration mirrors
+        # pkg/controllers/controllers.go:44-66)
+        self.provisioner = Provisioner(
+            kube, self.cluster, self.cloud_provider, self.clock,
+            self.settings, registry,
+        )
+        self.termination = TerminationController(
+            kube, self.cloud_provider, self.clock, registry
+        )
+        self.lifecycle = LifecycleController(
+            kube, self.cloud_provider, self.clock, registry
+        )
+        self.garbage_collection = GarbageCollectionController(
+            kube, self.cloud_provider, self.clock, registry
+        )
+        self.tagging = TaggingController(kube, cloud)
+        self.node_class_controller = NodeClassController(
+            kube, self.subnets, self.security_groups, self.images,
+            self.instance_profiles,
+        )
+        self.disruption = DisruptionController(
+            kube, self.cluster, self.cloud_provider, self.termination,
+            self.clock, feature_gate_drift=self.settings.feature_gate_drift,
+            registry=registry,
+        )
+        self.interruption: Optional[InterruptionController] = None
+        if self.settings.interruption_queue_name:
+            self.interruption = InterruptionController(
+                kube, cloud, self.termination, self.unavailable, registry
+            )
+        self._pricing_updated_at = self.clock.now()
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------ loop
+    def reconcile_once(self) -> None:
+        """One tick of every control loop, in a stable order: status
+        resolution, provisioning, lifecycle, events, disruption, cleanup."""
+        self.node_class_controller.reconcile()
+        self.provisioner.reconcile()
+        self.lifecycle.reconcile()
+        if self.interruption is not None:
+            self.interruption.reconcile()
+        self.disruption.reconcile()
+        self.termination.reconcile()
+        self.garbage_collection.reconcile()
+        self.tagging.reconcile()
+        # 12h pricing refresh (reference pricing/controller.go:39-41)
+        if self.clock.now() - self._pricing_updated_at >= PRICING_UPDATE_PERIOD:
+            if not self.settings.isolated_vpc:
+                self.pricing.update_on_demand()
+                self.pricing.update_spot()
+            self._pricing_updated_at = self.clock.now()
+
+    def run(self, interval_s: float = 1.0) -> None:
+        """Blocking controller-manager loop for real deployments."""
+        while not self._stop.is_set():
+            self.reconcile_once()
+            self.clock.sleep(interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -38,9 +38,6 @@ import numpy as np
 
 from karpenter_tpu.ops.tensorize import CompiledProblem
 
-_INT_BIG = jnp.int32(2**30)
-
-
 class PackResult(NamedTuple):
     """Device outputs of one packing solve."""
 

@@ -1,0 +1,160 @@
+"""Image family provider + resolver (reference pkg/providers/amifamily).
+
+Families (reference al2.go / bottlerocket.go / ubuntu.go / windows.go /
+custom.go) map here to "standard" / "accelerated" / "custom": each family
+supplies a default-image query (the SSM-parameter analogue,
+ami.go:65-79), boot user-data generation (bootstrap/bootstrap.go:124), and
+block-device defaults.
+
+`Resolver.resolve` reproduces resolver.go:118-177: discover candidate
+images (selector terms or family default), map each instance type to the
+newest compatible image by requirements (ami.go:94-105), then group types
+again by (image, max_pods) so each group becomes one launch-template spec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.api import InstanceType, NodeClass, NodePool, Requirements
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import BlockDeviceMapping
+from karpenter_tpu.api.requirements import Op, Requirement
+from karpenter_tpu.cache.ttl import DEFAULT_TTL, TTLCache
+from karpenter_tpu.cloud.fake.backend import FakeCloud, FakeImage
+from karpenter_tpu.utils.clock import Clock
+
+IMAGE_FAMILIES = ("standard", "accelerated", "custom")
+
+
+def _image_requirements(im: FakeImage) -> Requirements:
+    return Requirements([Requirement(L.LABEL_ARCH, Op.IN, [im.arch])])
+
+
+@dataclass
+class ImageCandidate:
+    image: FakeImage
+    requirements: Requirements
+
+
+@dataclass
+class LaunchSpec:
+    """One (image, max_pods) group -> one launch template
+    (reference resolver.go:118-177 Resolve output)."""
+
+    image_id: str
+    instance_types: List[InstanceType]
+    max_pods: Optional[int]
+    user_data: str
+    block_device_mappings: List[BlockDeviceMapping] = field(default_factory=list)
+
+
+class ImageProvider:
+    """Image discovery with a TTL cache (reference ami.go:118-235)."""
+
+    def __init__(self, cloud: FakeCloud, clock: Clock):
+        self.cloud = cloud
+        self._cache = TTLCache(clock, DEFAULT_TTL)
+
+    def list(self, node_class: NodeClass) -> List[ImageCandidate]:
+        """Candidate images for a node class, newest-first.
+
+        Selector terms take precedence; otherwise the family default (the
+        SSM-parameter analogue) per architecture.
+        """
+        key = (
+            tuple(node_class.image_selector_terms),
+            node_class.image_family,
+        )
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        if node_class.image_selector_terms:
+            images = self.cloud.describe_images(node_class.image_selector_terms)
+        else:
+            family = (
+                node_class.image_family
+                if node_class.image_family in IMAGE_FAMILIES
+                else "standard"
+            )
+            images = []
+            for arch in ("amd64", "arm64"):
+                im = self.cloud.latest_image(family, arch)
+                if im is not None:
+                    images.append(im)
+        images = sorted(images, key=lambda im: -im.created_at)
+        out = [ImageCandidate(im, _image_requirements(im)) for im in images]
+        self._cache.set(key, out)
+        return out
+
+    def invalidate(self) -> None:
+        self._cache.flush()
+
+
+def generate_user_data(
+    node_class: NodeClass, pool: NodePool, cluster_name: str, cluster_endpoint: str
+) -> str:
+    """Boot configuration for a node (reference
+    bootstrap/eksbootstrap.go): cluster identity, pool taints/labels, and
+    any custom user data appended."""
+    lines = [
+        "#!/usr/bin/env bash",
+        f"bootstrap --cluster {cluster_name} --endpoint {cluster_endpoint}",
+        f"--node-pool {pool.name}",
+    ]
+    for t in pool.taints + pool.startup_taints:
+        lines.append(f"--register-taint {t.key}={t.value}:{t.effect}")
+    if node_class.user_data:
+        lines.append(node_class.user_data)
+    return "\n".join(lines)
+
+
+class Resolver:
+    """(NodeClass, NodePool, instance types) -> launch specs
+    (reference resolver.go:44-110)."""
+
+    def __init__(self, image_provider: ImageProvider):
+        self.images = image_provider
+
+    def resolve(
+        self,
+        node_class: NodeClass,
+        pool: NodePool,
+        instance_types: Sequence[InstanceType],
+        cluster_name: str = "",
+        cluster_endpoint: str = "",
+    ) -> List[LaunchSpec]:
+        candidates = self.images.list(node_class)
+        if not candidates:
+            return []
+        # newest compatible image per instance type (ami.go:94-105)
+        by_image: Dict[str, List[InstanceType]] = {}
+        for it in instance_types:
+            for cand in candidates:  # newest-first
+                if it.requirements.intersects(cand.requirements):
+                    by_image.setdefault(cand.image.id, []).append(it)
+                    break
+        user_data = generate_user_data(
+            node_class, pool, cluster_name, cluster_endpoint
+        )
+        bdms = list(node_class.block_device_mappings) or [BlockDeviceMapping()]
+        specs: List[LaunchSpec] = []
+        for image_id, types in by_image.items():
+            # group again by max-pods so kubelet config is uniform per
+            # template (resolver.go:118-177)
+            by_max_pods: Dict[Optional[int], List[InstanceType]] = {}
+            for it in types:
+                mp = pool.kubelet_max_pods
+                by_max_pods.setdefault(mp, []).append(it)
+            for mp, group in by_max_pods.items():
+                specs.append(
+                    LaunchSpec(
+                        image_id=image_id,
+                        instance_types=group,
+                        max_pods=mp,
+                        user_data=user_data,
+                        block_device_mappings=bdms,
+                    )
+                )
+        return specs

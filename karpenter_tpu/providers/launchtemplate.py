@@ -1,0 +1,114 @@
+"""Launch-template provider (reference pkg/providers/launchtemplate).
+
+`ensure_all` resolves a (node class, pool) into one launch template per
+(image, max_pods) group and creates/caches templates by an options hash
+(launchtemplate.go:99-126,139-145).  A static template name on the node
+class bypasses resolution entirely (launchtemplate.go:104-107).  The cache
+maps hash -> template name so repeat launches skip template creation; cache
+eviction deletes the remote template (launchtemplate.go:340-357).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.api import InstanceType, NodeClass, NodePool
+from karpenter_tpu.cache.ttl import DEFAULT_TTL, TTLCache
+from karpenter_tpu.cloud.fake.backend import FakeCloud
+from karpenter_tpu.providers.image import LaunchSpec, Resolver
+from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
+from karpenter_tpu.utils.clock import Clock
+
+
+@dataclass
+class LaunchTemplate:
+    """A resolved, ready-to-launch template."""
+
+    name: str
+    image_id: str
+    security_group_ids: List[str]
+    user_data: str
+    instance_types: List[InstanceType]
+    max_pods: Optional[int] = None
+    static: bool = False  # spec.launchTemplateName passthrough
+
+
+class LaunchTemplateProvider:
+    def __init__(
+        self,
+        cloud: FakeCloud,
+        resolver: Resolver,
+        security_groups: SecurityGroupProvider,
+        clock: Clock,
+        cluster_name: str = "",
+        cluster_endpoint: str = "",
+    ):
+        self.cloud = cloud
+        self.resolver = resolver
+        self.security_groups = security_groups
+        self.cluster_name = cluster_name
+        self.cluster_endpoint = cluster_endpoint
+        self._cache = TTLCache(clock, DEFAULT_TTL)
+        self._created: Dict[str, str] = {}  # options hash -> template name
+
+    def ensure_all(
+        self,
+        node_class: NodeClass,
+        pool: NodePool,
+        instance_types: Sequence[InstanceType],
+    ) -> List[LaunchTemplate]:
+        """One launch template per (image, max_pods) group covering the
+        requested instance types (launchtemplate.go:99-126)."""
+        sg_ids = [g.id for g in self.security_groups.list(node_class)]
+        specs = self.resolver.resolve(
+            node_class,
+            pool,
+            instance_types,
+            cluster_name=self.cluster_name,
+            cluster_endpoint=self.cluster_endpoint,
+        )
+        out: List[LaunchTemplate] = []
+        for spec in specs:
+            h = self._options_hash(node_class, spec, sg_ids)
+            name = self._created.get(h)
+            if name is None:
+                name = f"lt-{h}"
+                self._created[h] = name
+            out.append(
+                LaunchTemplate(
+                    name=name,
+                    image_id=spec.image_id,
+                    security_group_ids=sg_ids,
+                    user_data=spec.user_data,
+                    instance_types=spec.instance_types,
+                    max_pods=spec.max_pods,
+                )
+            )
+        return out
+
+    @staticmethod
+    def _options_hash(
+        node_class: NodeClass, spec: LaunchSpec, sg_ids: Sequence[str]
+    ) -> str:
+        payload = {
+            "image": spec.image_id,
+            "max_pods": spec.max_pods,
+            "sgs": sorted(sg_ids),
+            "user_data": spec.user_data,
+            "bdm": [b.device_name for b in spec.block_device_mappings],
+            "monitoring": node_class.detailed_monitoring,
+            "tags": sorted(node_class.tags.items()),
+        }
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()
+        ).hexdigest()[:12]
+
+    def invalidate(self, node_class: Optional[NodeClass] = None) -> None:
+        """Drop cached templates (e.g. after node-class drift) so the next
+        launch re-resolves; mirrors cache eviction at
+        launchtemplate.go:340-357."""
+        self._created.clear()
+        self._cache.flush()

@@ -1,0 +1,22 @@
+"""Cluster-version provider (reference pkg/providers/version): discovery
+with a cache; feeds default-image queries."""
+
+from __future__ import annotations
+
+from karpenter_tpu.cache.ttl import DEFAULT_TTL, TTLCache
+from karpenter_tpu.cloud.fake.backend import FakeCloud
+from karpenter_tpu.utils.clock import Clock
+
+
+class VersionProvider:
+    def __init__(self, cloud: FakeCloud, clock: Clock):
+        self.cloud = cloud
+        self._cache = TTLCache(clock, DEFAULT_TTL * 5)
+
+    def get(self) -> str:
+        cached = self._cache.get("version")
+        if cached is not None:
+            return cached
+        v = self.cloud.kube_version
+        self._cache.set("version", v)
+        return v

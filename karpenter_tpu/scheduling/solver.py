@@ -99,8 +99,8 @@ class TensorScheduler:
         result = run_pack(prob, objective=self.objective)
         # one transfer for everything decode needs (the device link may be
         # high-latency; per-array fetches would pay the round trip each)
-        take, leftover, node_cfg = jax.device_get(
-            (result.take, result.leftover, result.node_cfg)
+        take, leftover, node_cfg, node_used = jax.device_get(
+            (result.take, result.leftover, result.node_cfg, result.node_used)
         )
         # grow the slot bucket if the solve ran out of node slots while
         # feasible configs remained
@@ -109,10 +109,10 @@ class TensorScheduler:
         while self._overflowed(prob, leftover) and k < max_k:
             k *= 2
             result = run_pack(prob, k_slots=k, objective=self.objective)
-            take, leftover, node_cfg = jax.device_get(
-                (result.take, result.leftover, result.node_cfg)
+            take, leftover, node_cfg, node_used = jax.device_get(
+                (result.take, result.leftover, result.node_cfg, result.node_used)
             )
-        return self._decode(prob, take, node_cfg)
+        return self._decode(prob, take, node_cfg, node_used)
 
     def _oracle(self, pods: List[Pod]) -> SchedulingResult:
         self.last_path = "oracle"
@@ -140,12 +140,17 @@ class TensorScheduler:
         return bool((leftover[:G] > 0)[placeable].any())
 
     def _decode(
-        self, prob: CompiledProblem, take: np.ndarray, node_cfg: np.ndarray
+        self,
+        prob: CompiledProblem,
+        take: np.ndarray,
+        node_cfg: np.ndarray,
+        node_used: np.ndarray,
     ) -> SchedulingResult:
         out = SchedulingResult()
 
         # slot -> decoded node (lazily created so empty slots cost nothing)
         vnodes: Dict[int, VirtualNode] = {}
+        slot_classes: Dict[int, List[int]] = {}
 
         def vnode_for(k: int) -> VirtualNode:
             vn = vnodes.get(k)
@@ -172,9 +177,51 @@ class TensorScheduler:
                     vn.pods.extend(batch)
                     # one scaled add per (class, node) instead of per pod
                     vn.used = vn.used + cm.requests.scaled(len(batch))
+                    slot_classes.setdefault(int(k), []).append(g)
             for p in cm.pods[cursor:]:
                 out.unschedulable[p.key()] = self._why_unschedulable(prob, g)
+
+        self._add_alternate_types(prob, node_cfg, node_used, vnodes, slot_classes)
         return out
+
+    @staticmethod
+    def _add_alternate_types(
+        prob: CompiledProblem,
+        node_cfg: np.ndarray,
+        node_used: np.ndarray,
+        vnodes: Dict[int, VirtualNode],
+        slot_classes: Dict[int, List[int]],
+    ) -> None:
+        """Launch flexibility: widen each decoded node's feasible-type list
+        to every config that (a) every class on the node admits, (b) holds
+        the node's total usage, and (c) shares the committed pool, zone and
+        capacity type — so the instance provider can hand CreateFleet up to
+        60 price-ordered fallbacks (reference instance.go:54,391-408)
+        instead of a single pinned type."""
+        C = len(prob.configs)
+        for k, vn in vnodes.items():
+            committed = prob.configs[node_cfg[k]]
+            mask = prob.openable.copy()
+            for g in slot_classes.get(k, ()):
+                mask &= prob.feas[g]
+            mask &= (node_used[k][None, :] <= prob.alloc + 1e-6).all(axis=1)
+            seen = {committed.instance_type.name}
+            alts = []
+            for c in np.nonzero(mask[:C])[0]:
+                cfg = prob.configs[c]
+                if (
+                    cfg.zone != committed.zone
+                    or cfg.capacity_type != committed.capacity_type
+                    or cfg.pool is not committed.pool
+                ):
+                    continue
+                name = cfg.instance_type.name
+                if name in seen:
+                    continue
+                seen.add(name)
+                alts.append((cfg.price, cfg.instance_type))
+            alts.sort(key=lambda pair: pair[0])
+            vn.feasible_types = [committed.instance_type] + [it for _, it in alts]
 
     @staticmethod
     def _why_unschedulable(prob: CompiledProblem, g: int) -> str:
@@ -193,7 +240,8 @@ def _make_vnode(cfg: ConfigMeta, daemon_overhead: Resources) -> VirtualNode:
     the accounting matches)."""
     it = cfg.instance_type
     reqs = cfg.pool.template_requirements()
-    reqs.add(Requirement(L.LABEL_INSTANCE_TYPE, Op.IN, [it.name]))
+    # zone/capacity-type commit for topology + pricing; the TYPE choice
+    # stays open via feasible_types so launches keep fallback flexibility
     reqs.add(Requirement(L.LABEL_ZONE, Op.IN, [cfg.zone]))
     reqs.add(Requirement(L.LABEL_CAPACITY_TYPE, Op.IN, [cfg.capacity_type]))
     return VirtualNode(

@@ -18,6 +18,38 @@ from karpenter_tpu.api import NodeClaim, NodeClass, NodePool, Pod, Resources, Ta
 
 
 @dataclass
+class PodDisruptionBudget:
+    """v1.PodDisruptionBudget projection: the termination controller's
+    evictions respect these (reference: core termination controller is
+    PDB-aware, designs/termination.md)."""
+
+    name: str
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+    namespace: str = "default"
+
+    def selects(self, pod: Pod) -> bool:
+        if pod.namespace != self.namespace:
+            return False
+        return all(pod.labels.get(k) == v for k, v in self.label_selector.items())
+
+    def disruptions_allowed(self, all_matching: List[Pod]) -> int:
+        """How many matching pods may be evicted right now, given the FULL
+        matching set (any phase).  Pods already unavailable — evicted and
+        not yet rescheduled — consume the budget, exactly like the PDB
+        status accounting in Kubernetes."""
+        matching = [p for p in all_matching if self.selects(p)]
+        healthy = sum(1 for p in matching if p.phase == "Running")
+        unavailable = len(matching) - healthy
+        if self.max_unavailable is not None:
+            return max(0, self.max_unavailable - unavailable)
+        if self.min_available is not None:
+            return max(0, healthy - self.min_available)
+        return healthy
+
+
+@dataclass
 class Node:
     """A registered cluster node (the v1.Node analogue)."""
 
@@ -44,6 +76,7 @@ class KubeStore:
         self.node_claims: Dict[str, NodeClaim] = {}
         self.node_pools: Dict[str, NodePool] = {}
         self.node_classes: Dict[str, NodeClass] = {}
+        self.pdbs: Dict[str, PodDisruptionBudget] = {}
         self.events: List[tuple] = []  # (kind, reason, obj_name, message)
         self._watchers: List[Callable[[str, str, object], None]] = []
         self._seq = itertools.count(1)
@@ -134,6 +167,19 @@ class KubeStore:
 
     def get_node_class(self, name: str) -> Optional[NodeClass]:
         return self.node_classes.get(name)
+
+    def put_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        self.pdbs[pdb.name] = pdb
+        return pdb
+
+    def daemonset_pods(self) -> List[Pod]:
+        """Template daemonset pods (one per daemonset) used for per-node
+        overhead during scheduling."""
+        seen = {}
+        for p in self.pods.values():
+            if p.is_daemonset:
+                seen.setdefault(p.constraint_signature(), p)
+        return list(seen.values())
 
     # -- events --------------------------------------------------------------
     def record_event(self, kind: str, reason: str, obj_name: str, message: str = ""):

@@ -1,14 +1,34 @@
-"""Test environment: real providers over the fake cloud with fresh caches.
+"""Test environment: the real operator over the fake cloud.
 
-Mirrors reference pkg/test/environment.go:72-148 — the suites construct real
-provider/controller objects wired to fakes, plus a fake clock for TTL/expiry
-control, and `reset()` between specs (environment.go:150-176).
+Mirrors reference pkg/test/environment.go:72-148 — suites construct real
+provider/controller objects wired to fakes, plus a fake clock for
+TTL/expiry control, and `reset()` between specs (environment.go:150-176).
+`FakeKubelet` plays the role of kubelet + kube-scheduler: it registers
+Nodes for launched instances and binds nominated pods, the same division
+of labor the reference gets from envtest (nodes are just API objects;
+kubelet never runs — SURVEY.md §4).
 """
 
 from __future__ import annotations
 
 import os
 from typing import List, Optional, Sequence
+
+from karpenter_tpu.api import NodeClass, NodePool, Settings
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import SelectorTerm
+from karpenter_tpu.cloud.fake.backend import FakeCloud, MachineShape, generate_catalog
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.state.kube import KubeStore, Node
+from karpenter_tpu.utils.clock import FakeClock
+
+# tests shrink the batching windows so coalescing still happens but specs
+# don't wait out the production 35-100ms idle windows
+FAST_BATCH_WINDOWS = {
+    "create_fleet": (0.002, 0.05, 1000),
+    "describe": (0.002, 0.05, 500),
+    "terminate": (0.002, 0.05, 500),
+}
 
 
 def pin_cpu_platform(n_devices: int = 8) -> None:
@@ -33,16 +53,61 @@ def pin_cpu_platform(n_devices: int = 8) -> None:
     except Exception:
         pass  # backend already initialized; rely on existing devices
 
-from karpenter_tpu.api import NodeClass, NodePool, Settings
-from karpenter_tpu.api.objects import SelectorTerm
-from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
-from karpenter_tpu.cloud.fake.backend import FakeCloud, MachineShape, generate_catalog
-from karpenter_tpu.providers.instancetype import InstanceTypeProvider
-from karpenter_tpu.providers.pricing import PricingProvider
-from karpenter_tpu.providers.subnet import SubnetProvider
-from karpenter_tpu.state.cluster import Cluster
-from karpenter_tpu.state.kube import KubeStore
-from karpenter_tpu.utils.clock import FakeClock
+
+class FakeKubelet:
+    """Registers Nodes for launched instances and binds nominated pods —
+    the cluster-side machinery the controller does not own."""
+
+    def __init__(self, env: "Environment", startup_delay: float = 0.0):
+        self.env = env
+        self.startup_delay = startup_delay
+
+    def step(self) -> None:
+        self._register_nodes()
+        self._bind_nominated_pods()
+
+    def _register_nodes(self) -> None:
+        kube = self.env.kube
+        now = self.env.clock.now()
+        for claim in list(kube.node_claims.values()):
+            if not claim.provider_id or claim.deleted_at is not None:
+                continue
+            inst = self.env.cloud.instances.get(claim.provider_id)
+            if inst is None or inst.state != "running":
+                continue
+            if kube.node_by_provider_id(claim.provider_id) is not None:
+                continue
+            if now - claim.created_at < self.startup_delay:
+                continue
+            labels = dict(claim.labels)
+            labels[L.LABEL_HOSTNAME] = claim.name
+            kube.put_node(
+                Node(
+                    name=claim.name,
+                    provider_id=claim.provider_id,
+                    labels=labels,
+                    taints=list(claim.taints),  # startup taints already gone
+                    capacity=claim.capacity,
+                    allocatable=claim.allocatable,
+                    ready=True,
+                    created_at=now,
+                )
+            )
+
+    def _bind_nominated_pods(self) -> None:
+        kube = self.env.kube
+        cluster = self.env.cluster
+        for pod in list(kube.pods.values()):
+            if pod.node_name or pod.phase != "Pending":
+                continue
+            target = cluster.nominated_node(pod.key())
+            if target is None:
+                continue
+            node = kube.nodes.get(target)
+            if node is None or not node.ready or node.cordoned:
+                continue
+            kube.bind_pod(pod.key(), node.name)
+            cluster.clear_nomination(pod.key())
 
 
 class Environment:
@@ -51,30 +116,65 @@ class Environment:
         shapes: Optional[Sequence[MachineShape]] = None,
         zones: Sequence[str] = ("zone-a", "zone-b", "zone-c"),
         settings: Optional[Settings] = None,
+        node_startup_delay: float = 0.0,
     ):
         self._shapes = list(shapes) if shapes is not None else generate_catalog()
         self._zones = tuple(zones)
+        self._node_startup_delay = node_startup_delay
         self.clock = FakeClock()
         self.settings = settings or Settings(cluster_name="test")
+        self._build()
+
+    def _build(self) -> None:
+        from karpenter_tpu.metrics.registry import Registry
+
         self.cloud = FakeCloud(
             self.clock, shapes=self._shapes, zones=self._zones
         ).with_default_topology()
         self.kube = KubeStore()
-        self.cluster = Cluster(self.kube)
-        self.unavailable = UnavailableOfferings(self.clock)
-        self.pricing = PricingProvider(self.cloud)
-        # startup refresh (the reference operator primes pricing on boot)
-        self.pricing.update_on_demand()
-        self.pricing.update_spot()
-        self.subnets = SubnetProvider(self.cloud, self.clock)
-        self.instance_types = InstanceTypeProvider(
+        self.registry = Registry()  # per-spec metrics; reset() starts fresh
+        self.operator = Operator(
             self.cloud,
-            self.pricing,
-            self.subnets,
-            self.unavailable,
-            self.settings,
-            self.clock,
+            self.kube,
+            settings=self.settings,
+            clock=self.clock,
+            registry=self.registry,
+            batch_windows=FAST_BATCH_WINDOWS,
         )
+        self.kubelet = FakeKubelet(self, startup_delay=self._node_startup_delay)
+        # provider aliases (suites address them directly, like the
+        # reference's test env exposes every provider)
+        op = self.operator
+        self.cluster = op.cluster
+        self.unavailable = op.unavailable
+        self.pricing = op.pricing
+        self.subnets = op.subnets
+        self.security_groups = op.security_groups
+        self.images = op.images
+        self.version = op.version
+        self.instance_profiles = op.instance_profiles
+        self.launch_templates = op.launch_templates
+        self.instance_types = op.instance_types
+        self.instances = op.instances
+        self.cloud_provider = op.cloud_provider
+
+    # ------------------------------------------------------------- stepping
+    def step(self, seconds: float = 1.0, reconciles: int = 1) -> None:
+        """Advance the fake clock and run kubelet + every controller."""
+        self.clock.step(seconds)
+        for _ in range(reconciles):
+            self.kubelet.step()
+            self.operator.reconcile_once()
+            self.kubelet.step()
+
+    def settle(self, max_rounds: int = 30, seconds: float = 2.0) -> None:
+        """Step until no pending pods remain (or rounds run out), plus one
+        trailing tick so status controllers observe the settled state."""
+        for _ in range(max_rounds):
+            if not self.kube.pending_pods():
+                break
+            self.step(seconds)
+        self.step(seconds)
 
     # ------------------------------------------------------------- defaults
     def default_node_class(self) -> NodeClass:
@@ -95,21 +195,4 @@ class Environment:
         """Fresh kube state, fresh cloud (instances/capacity/IP spend gone),
         fresh caches — mirrors reference environment.go:150-176 which resets
         the fake EC2 API between specs."""
-        self.kube = KubeStore()
-        self.cluster = Cluster(self.kube)
-        self.cloud = FakeCloud(
-            self.clock, shapes=self._shapes, zones=self._zones
-        ).with_default_topology()
-        self.unavailable.flush()
-        self.pricing = PricingProvider(self.cloud)
-        self.pricing.update_on_demand()
-        self.pricing.update_spot()
-        self.subnets = SubnetProvider(self.cloud, self.clock)
-        self.instance_types = InstanceTypeProvider(
-            self.cloud,
-            self.pricing,
-            self.subnets,
-            self.unavailable,
-            self.settings,
-            self.clock,
-        )
+        self._build()

@@ -1,0 +1,249 @@
+"""CloudProvider facade + launch-path provider tests.
+
+Mirrors the reference suite shape (pkg/cloudprovider/suite_test.go,
+pkg/providers/instance/suite_test.go): real providers over the fake cloud,
+scriptable capacity errors, drift scenarios.
+"""
+
+import pytest
+
+from karpenter_tpu.api import (
+    NodeClaim,
+    Requirement,
+    Requirements,
+    Resources,
+)
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.cloud.provider import (
+    DRIFT_IMAGE,
+    DRIFT_NODECLASS,
+    DRIFT_SECURITY_GROUP,
+)
+from karpenter_tpu.errors import (
+    InsufficientCapacityAggregateError,
+    NodeClaimNotFoundError,
+)
+from karpenter_tpu.providers.instance import MAX_INSTANCE_TYPES
+from karpenter_tpu.testing import Environment
+
+
+@pytest.fixture()
+def env():
+    return Environment()
+
+
+@pytest.fixture()
+def setup(env):
+    pool = env.default_node_pool()
+    nc = env.default_node_class()
+    return pool, nc
+
+
+def make_claim(pool, requirements=(), requests=None, **kw):
+    reqs = Requirements(list(requirements))
+    return NodeClaim(
+        pool_name=pool.name,
+        node_class_ref=pool.node_class_ref,
+        requirements=reqs,
+        requests=requests or Resources(cpu=1, memory="1Gi"),
+        **kw,
+    )
+
+
+class TestCreate:
+    def test_launches_and_projects_status(self, env, setup):
+        pool, nc = setup
+        claim = make_claim(pool)
+        out = env.cloud_provider.create(claim)
+        assert out.launched
+        assert out.provider_id.startswith("i-")
+        assert out.instance_type_name
+        assert out.zone in env.cloud.zones
+        assert out.capacity.cpu > 0
+        assert out.allocatable.cpu < out.capacity.cpu  # overhead subtracted
+        assert out.labels[L.LABEL_INSTANCE_TYPE] == out.instance_type_name
+        assert L.ANNOTATION_NODECLASS_HASH in out.annotations
+        inst = env.cloud.instances[out.provider_id]
+        assert inst.tags["karpenter.sh/nodeclaim"] == claim.name
+
+    def test_cheapest_type_launched(self, env, setup):
+        pool, nc = setup
+        claim = make_claim(pool)
+        out = env.cloud_provider.create(claim)
+        # spot is cheapest in the fake; price must be the spot price
+        assert out.capacity_type == L.CAPACITY_TYPE_SPOT
+        assert out.price == pytest.approx(
+            env.cloud.spot_price(out.instance_type_name, out.zone)
+        )
+
+    def test_on_demand_when_required(self, env, setup):
+        pool, nc = setup
+        claim = make_claim(
+            pool,
+            [Requirement(L.LABEL_CAPACITY_TYPE, Op.IN, [L.CAPACITY_TYPE_ON_DEMAND])],
+        )
+        out = env.cloud_provider.create(claim)
+        assert out.capacity_type == L.CAPACITY_TYPE_ON_DEMAND
+
+    def test_zone_requirement_respected(self, env, setup):
+        pool, nc = setup
+        claim = make_claim(pool, [Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"])])
+        out = env.cloud_provider.create(claim)
+        assert out.zone == "zone-b"
+
+    def test_accelerator_types_filtered_unless_requested(self, env, setup):
+        pool, nc = setup
+        out = env.cloud_provider.create(make_claim(pool))
+        shape = env.cloud.shapes[out.instance_type_name]
+        assert shape.gpu_count == 0 and shape.tpu_chips == 0
+
+    def test_accelerator_launch_when_requested(self, env, setup):
+        pool, nc = setup
+        claim = make_claim(
+            pool, requests=Resources({L.RESOURCE_TPU: 2, "cpu": 1, "memory": 2**30})
+        )
+        out = env.cloud_provider.create(claim)
+        assert env.cloud.shapes[out.instance_type_name].tpu_chips >= 2
+
+    def test_ice_feedback_marks_unavailable_and_falls_back(self, env, setup):
+        pool, nc = setup
+        # every zone of the cheapest spot pool is capacity-constrained
+        claim = make_claim(pool)
+        # find what it would launch, then mark those pools insufficient
+        probe = env.cloud_provider.create(make_claim(pool))
+        cheapest = probe.instance_type_name
+        for z in env.cloud.zones:
+            env.cloud.mark_insufficient(cheapest, z, L.CAPACITY_TYPE_SPOT)
+        out = env.cloud_provider.create(claim)
+        # fleet fell back to the next override; failed pools are ICE-cached
+        assert out.provider_id
+        assert out.instance_type_name != cheapest or out.capacity_type != (
+            L.CAPACITY_TYPE_SPOT
+        )
+        assert env.unavailable.is_unavailable(
+            L.CAPACITY_TYPE_SPOT, cheapest, out.zone
+        ) or any(
+            env.unavailable.is_unavailable(L.CAPACITY_TYPE_SPOT, cheapest, z)
+            for z in env.cloud.zones
+        )
+
+    def test_all_pools_insufficient_raises(self, env, setup):
+        pool, nc = setup
+        claim = make_claim(
+            pool,
+            [Requirement(L.LABEL_INSTANCE_TYPE, Op.IN, ["std1.large"])],
+        )
+        for z in env.cloud.zones:
+            for ct in (L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND):
+                env.cloud.mark_insufficient("std1.large", z, ct)
+        with pytest.raises(InsufficientCapacityAggregateError):
+            env.cloud_provider.create(claim)
+
+    def test_fleet_requests_coalesce(self, env, setup):
+        pool, nc = setup
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=8) as pool_exec:
+            claims = [make_claim(pool) for _ in range(8)]
+            outs = list(pool_exec.map(env.cloud_provider.create, claims))
+        assert all(o.provider_id for o in outs)
+        assert len({o.provider_id for o in outs}) == 8
+        # identical configs merged into fewer CreateFleet calls
+        assert env.cloud.recorder.count("CreateFleet") < 8
+
+
+class TestGetListDelete:
+    def test_get_roundtrip(self, env, setup):
+        pool, nc = setup
+        out = env.cloud_provider.create(make_claim(pool))
+        got = env.cloud_provider.get(out.provider_id)
+        assert got.provider_id == out.provider_id
+        assert got.instance_type_name == out.instance_type_name
+        assert got.pool_name == pool.name
+
+    def test_list_only_managed(self, env, setup):
+        pool, nc = setup
+        env.cloud_provider.create(make_claim(pool))
+        # an unmanaged instance (no managed-by tag) must not be listed
+        from karpenter_tpu.cloud.fake.backend import FakeInstance
+
+        env.cloud.instances["i-foreign"] = FakeInstance(
+            id="i-foreign", instance_type="std1.large", zone="zone-a",
+            capacity_type="on-demand",
+        )
+        listed = env.cloud_provider.list()
+        assert {c.provider_id for c in listed} != set()
+        assert "i-foreign" not in {c.provider_id for c in listed}
+
+    def test_delete_terminates(self, env, setup):
+        pool, nc = setup
+        out = env.cloud_provider.create(make_claim(pool))
+        env.cloud_provider.delete(out)
+        assert env.cloud.instances[out.provider_id].state == "terminated"
+        with pytest.raises(NodeClaimNotFoundError):
+            env.cloud_provider.get(out.provider_id)
+
+    def test_delete_twice_raises_not_found(self, env, setup):
+        pool, nc = setup
+        out = env.cloud_provider.create(make_claim(pool))
+        env.cloud_provider.delete(out)
+        with pytest.raises(NodeClaimNotFoundError):
+            env.cloud_provider.delete(out)
+
+
+class TestDrift:
+    def _launched(self, env, pool):
+        claim = make_claim(pool)
+        out = env.cloud_provider.create(claim)
+        return out
+
+    def test_no_drift_when_unchanged(self, env, setup):
+        pool, nc = setup
+        claim = self._launched(env, pool)
+        assert env.cloud_provider.is_drifted(claim) == ""
+
+    def test_nodeclass_hash_drift(self, env, setup):
+        pool, nc = setup
+        claim = self._launched(env, pool)
+        nc.user_data = "echo changed"
+        assert env.cloud_provider.is_drifted(claim) == DRIFT_NODECLASS
+
+    def test_image_drift(self, env, setup):
+        pool, nc = setup
+        claim = self._launched(env, pool)
+        # a newer image supersedes; the old image id is no longer resolved
+        from karpenter_tpu.cloud.fake.backend import FakeImage
+
+        for im in list(env.cloud.images.values()):
+            im.deprecated = True
+        env.cloud.add_image(
+            FakeImage(
+                id="image-new", family="standard", arch="amd64",
+                created_at=env.clock.now() + 10,
+            )
+        )
+        env.images.invalidate()
+        assert env.cloud_provider.is_drifted(claim) == DRIFT_IMAGE
+
+    def test_security_group_drift(self, env, setup):
+        pool, nc = setup
+        claim = self._launched(env, pool)
+        from karpenter_tpu.cloud.fake.backend import FakeSecurityGroup
+
+        env.cloud.add_security_group(
+            FakeSecurityGroup(id="sg-extra", name="extra")
+        )
+        env.security_groups.invalidate()
+        assert env.cloud_provider.is_drifted(claim) == DRIFT_SECURITY_GROUP
+
+
+class TestGetInstanceTypes:
+    def test_inventory_feed(self, env, setup):
+        pool, nc = setup
+        types = env.cloud_provider.get_instance_types(pool)
+        assert len(types) > 100
+        assert all(t.offerings for t in types)
+
+    def test_price_cap_constant(self):
+        assert MAX_INSTANCE_TYPES == 60
