@@ -24,6 +24,7 @@ from karpenter_tpu.controllers.disruption import DisruptionController
 from karpenter_tpu.controllers.garbagecollection import GarbageCollectionController
 from karpenter_tpu.controllers.interruption import InterruptionController
 from karpenter_tpu.controllers.lifecycle import LifecycleController
+from karpenter_tpu.controllers.link import LinkController
 from karpenter_tpu.controllers.nodeclass import NodeClassController
 from karpenter_tpu.controllers.provisioning import Provisioner
 from karpenter_tpu.controllers.tagging import TaggingController
@@ -118,6 +119,7 @@ class Operator:
             kube, self.cloud_provider, self.clock, registry
         )
         self.tagging = TaggingController(kube, cloud)
+        self.link = LinkController(kube, self.cloud_provider, registry)
         self.node_class_controller = NodeClassController(
             kube, self.subnets, self.security_groups, self.images,
             self.instance_profiles,
@@ -146,6 +148,7 @@ class Operator:
             self.interruption.reconcile()
         self.disruption.reconcile()
         self.termination.reconcile()
+        self.link.reconcile()  # adopt before GC lists, so no race to reap
         self.garbage_collection.reconcile()
         self.tagging.reconcile()
         # 12h pricing refresh (reference pricing/controller.go:39-41)

@@ -49,6 +49,7 @@ class TensorScheduler:
         daemonsets: Sequence[Pod] = (),
         zones: Sequence[str] = (),
         objective: str = "nodes",
+        pack_fn=run_pack,
     ):
         self.pools = list(pools)
         self.instance_types = instance_types
@@ -56,6 +57,9 @@ class TensorScheduler:
         self.daemonsets = list(daemonsets)
         self.zones = list(zones)
         self.objective = objective
+        # the device half of the solve: local run_pack by default, or a
+        # sidecar's RemoteSolver.pack_problem (service/client.py)
+        self.pack_fn = pack_fn
         self.last_path = ""  # "tensor" | "oracle" (observability)
         # Prebuilt config-axis tensors — the analogue of the reference's
         # seqnum-keyed instance-type cache (instancetype.go:97-104).
@@ -96,7 +100,7 @@ class TensorScheduler:
         if not prob.supported:
             return self._oracle(pods)
         self.last_path = "tensor"
-        result = run_pack(prob, objective=self.objective)
+        result = self.pack_fn(prob, objective=self.objective)
         # one transfer for everything decode needs (the device link may be
         # high-latency; per-array fetches would pay the round trip each)
         take, leftover, node_cfg, node_used = jax.device_get(
@@ -108,7 +112,7 @@ class TensorScheduler:
         max_k = len(prob.used0) + prob.total_pods()
         while self._overflowed(prob, leftover) and k < max_k:
             k *= 2
-            result = run_pack(prob, k_slots=k, objective=self.objective)
+            result = self.pack_fn(prob, k_slots=k, objective=self.objective)
             take, leftover, node_cfg, node_used = jax.device_get(
                 (result.take, result.leftover, result.node_cfg, result.node_used)
             )

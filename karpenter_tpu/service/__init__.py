@@ -1,0 +1,7 @@
+"""Solver sidecar: the distributed boundary between the controller half
+and the accelerator half (SURVEY.md §5 north-star)."""
+
+from karpenter_tpu.service.client import RemoteSolver, SolverUnavailableError
+from karpenter_tpu.service.server import SolverServer
+
+__all__ = ["RemoteSolver", "SolverServer", "SolverUnavailableError"]

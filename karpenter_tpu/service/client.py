@@ -1,0 +1,106 @@
+"""Solver sidecar client: `pack` over the wire, drop-in for run_pack.
+
+`RemoteSolver.pack_problem(prob, ...)` matches `ops.packer.run_pack`'s
+signature/result shape, so `TensorScheduler(pack_fn=remote.pack_problem)`
+moves the device half of every solve into the sidecar without touching
+the controller code.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.ops.packer import pad_problem
+from karpenter_tpu.ops.tensorize import CompiledProblem
+from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
+from karpenter_tpu.service.server import PACK_ARG_ORDER, PACK_RESULT_FIELDS
+
+
+class RemotePackResult(NamedTuple):
+    take: np.ndarray
+    leftover: np.ndarray
+    node_cfg: np.ndarray
+    node_pods: np.ndarray
+    node_used: np.ndarray
+
+
+class SolverUnavailableError(ConnectionError):
+    pass
+
+
+class RemoteSolver:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 7421,
+        connect_timeout: float = 10.0,
+        request_timeout: float = 300.0,
+    ):
+        # request_timeout must cover a cold solve: the sidecar's first pack
+        # at a new bucket shape jit-compiles (~20-40s on a TPU backend)
+        self.host = host
+        self.port = port
+        self.connect_timeout = connect_timeout
+        self.request_timeout = request_timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- transport
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.connect_timeout
+                )
+                self._sock.settimeout(self.request_timeout)
+            except OSError as exc:
+                raise SolverUnavailableError(
+                    f"solver sidecar at {self.host}:{self.port}: {exc}"
+                ) from exc
+        return self._sock
+
+    def _call(self, meta: dict, arrays: dict) -> Tuple[dict, dict]:
+        with self._lock:  # one in-flight request per connection
+            sock = self._connect()
+            try:
+                send_frame(sock, encode(meta, arrays))
+                header, out = decode(recv_frame(sock))
+            except (ConnectionError, OSError) as exc:
+                self.close()
+                raise SolverUnavailableError(str(exc)) from exc
+        if header.get("status") != "ok":
+            raise RuntimeError(f"solver error: {header.get('error')}")
+        return header, out
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    # --------------------------------------------------------------- methods
+    def ping(self) -> bool:
+        self._call({"method": "ping"}, {})
+        return True
+
+    def info(self) -> dict:
+        header, _ = self._call({"method": "info"}, {})
+        return {k: v for k, v in header.items() if k != "status"}
+
+    def pack_problem(
+        self, prob: CompiledProblem, k_slots: int = 0, objective: str = "nodes"
+    ) -> RemotePackResult:
+        """run_pack over the wire: pad locally, solve in the sidecar."""
+        args, kp = pad_problem(prob, k_slots)
+        arrays = {
+            name: np.asarray(val) for name, val in zip(PACK_ARG_ORDER, args)
+        }
+        _, out = self._call(
+            {"method": "pack", "k_slots": kp, "objective": objective}, arrays
+        )
+        return RemotePackResult(*(out[f] for f in PACK_RESULT_FIELDS))

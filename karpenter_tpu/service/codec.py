@@ -1,0 +1,87 @@
+"""Wire codec for the solver service: JSON header + raw array blob.
+
+Frame layout (all integers big-endian):
+
+    [4B total header length][JSON header][binary blob]
+
+The JSON header carries the method/status, scalar params, and an array
+manifest ``[{name, dtype, shape, offset, nbytes}]`` indexing into the
+blob.  Arrays travel as raw C-order bytes — no pickling (the sidecar must
+never execute peer-controlled payloads), no base64 inflation.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Dict, Tuple
+
+import numpy as np
+
+MAX_FRAME = 1 << 30  # 1 GiB sanity bound
+
+
+def encode(meta: dict, arrays: Dict[str, np.ndarray]) -> bytes:
+    manifest = []
+    blob_parts = []
+    offset = 0
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)
+        shape = list(arr.shape)  # before ascontiguousarray (it promotes 0-d)
+        raw = np.ascontiguousarray(arr).tobytes()
+        manifest.append(
+            {
+                "name": name,
+                "dtype": arr.dtype.str,
+                "shape": shape,
+                "offset": offset,
+                "nbytes": len(raw),
+            }
+        )
+        blob_parts.append(raw)
+        offset += len(raw)
+    header = dict(meta)
+    header["arrays"] = manifest
+    hbytes = json.dumps(header).encode()
+    return struct.pack(">I", len(hbytes)) + hbytes + b"".join(blob_parts)
+
+
+def decode(payload: bytes) -> Tuple[dict, Dict[str, np.ndarray]]:
+    (hlen,) = struct.unpack(">I", payload[:4])
+    header = json.loads(payload[4 : 4 + hlen].decode())
+    blob = payload[4 + hlen :]
+    arrays: Dict[str, np.ndarray] = {}
+    for m in header.pop("arrays", []):
+        raw = blob[m["offset"] : m["offset"] + m["nbytes"]]
+        arrays[m["name"]] = np.frombuffer(raw, dtype=np.dtype(m["dtype"])).reshape(
+            m["shape"]
+        )
+    return header, arrays
+
+
+# ------------------------------------------------------------ socket I/O
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    size_raw = _recv_exact(sock, 8)
+    (size,) = struct.unpack(">Q", size_raw)
+    if size > MAX_FRAME:
+        raise ValueError(f"frame too large: {size}")
+    return _recv_exact(sock, size)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)

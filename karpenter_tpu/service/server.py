@@ -1,0 +1,142 @@
+"""Solver sidecar server: owns the accelerator, serves packing solves.
+
+The reference runs one leader-elected controller process (SURVEY.md §5 —
+no distributed backend).  The TPU build splits at the natural boundary:
+the controller half (pure Python: providers, reconcilers, constraint
+compilation) can live anywhere; the solver half owns the JAX devices and
+serves `pack` over a length-prefixed socket protocol (service/codec.py).
+One sidecar serves many controllers; the kernel is stateless per solve so
+requests parallelize freely across its thread pool.
+
+Methods:
+- ``ping``                      liveness
+- ``info``                      device inventory (platform, device count)
+- ``pack``  arrays + {k_slots, objective} -> PackResult arrays
+"""
+
+from __future__ import annotations
+
+import logging
+import socketserver
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
+
+log = logging.getLogger(__name__)
+
+PACK_ARG_ORDER = (
+    "req", "cnt", "maxper", "slot", "feas", "alloc", "price", "openable",
+    "used0", "cfg0", "npods0", "next0", "sig0",
+)
+PACK_RESULT_FIELDS = ("take", "leftover", "node_cfg", "node_pods", "node_used")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        while True:
+            try:
+                payload = recv_frame(self.request)
+            except (ConnectionError, OSError):
+                return
+            try:
+                response = self.server.dispatch(payload)  # type: ignore[attr-defined]
+            except Exception as exc:  # report, keep serving
+                log.exception("solver request failed")
+                response = encode({"status": "error", "error": str(exc)}, {})
+            try:
+                send_frame(self.request, response)
+            except (ConnectionError, OSError):
+                return
+
+
+class SolverServer(socketserver.ThreadingTCPServer):
+    """Serve solves on (host, port); port 0 picks a free port."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- dispatch
+    def dispatch(self, payload: bytes) -> bytes:
+        header, arrays = decode(payload)
+        method = header.get("method")
+        if method == "ping":
+            return encode({"status": "ok"}, {})
+        if method == "info":
+            import jax
+
+            devices = jax.devices()
+            return encode(
+                {
+                    "status": "ok",
+                    "platform": devices[0].platform if devices else "none",
+                    "device_count": len(devices),
+                },
+                {},
+            )
+        if method == "pack":
+            return self._pack(header, arrays)
+        return encode({"status": "error", "error": f"unknown method {method}"}, {})
+
+    def _pack(self, header: dict, arrays: dict) -> bytes:
+        import jax
+
+        from karpenter_tpu.ops.packer import pack_kernel
+
+        missing = [n for n in PACK_ARG_ORDER if n not in arrays]
+        if missing:
+            return encode(
+                {"status": "error", "error": f"missing arrays: {missing}"}, {}
+            )
+        args = [arrays[n] for n in PACK_ARG_ORDER]
+        # next0 travels as a 0-d array; the kernel wants a scalar
+        args[11] = np.int32(args[11])
+        result = pack_kernel(
+            *args,
+            k_slots=int(header["k_slots"]),
+            objective=header.get("objective", "nodes"),
+        )
+        out = jax.device_get(result)
+        return encode(
+            {"status": "ok"},
+            {name: np.asarray(val) for name, val in zip(PACK_RESULT_FIELDS, out)},
+        )
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server_address  # type: ignore[return-value]
+
+    def start_background(self) -> "SolverServer":
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="solver-server"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import argparse
+
+    parser = argparse.ArgumentParser(description="karpenter-tpu solver sidecar")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7421)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    server = SolverServer(args.host, args.port)
+    log.info("solver sidecar listening on %s:%d", *server.address)
+    server.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -156,11 +156,19 @@ class KubeStore:
 
     # -- pools / classes -----------------------------------------------------
     def put_node_pool(self, pool: NodePool) -> NodePool:
+        """Admission: validation runs before the write (the webhook
+        analogue, api/validation.py)."""
+        from karpenter_tpu.api.validation import validate_node_pool
+
+        validate_node_pool(pool)
         self.node_pools[pool.name] = pool
         self._notify("NodePool", "put", pool)
         return pool
 
     def put_node_class(self, nc: NodeClass) -> NodeClass:
+        from karpenter_tpu.api.validation import validate_node_class
+
+        validate_node_class(nc)
         self.node_classes[nc.name] = nc
         self._notify("NodeClass", "put", nc)
         return nc

@@ -1,0 +1,95 @@
+"""Scale suite: the reference's scale-test configs run in-process.
+
+Reference test/suites/scale/provisioning_test.go:92-173 runs these against
+a live EKS cluster with a 30-minute SpecTimeout; the fake-cloud equivalents
+complete in seconds, which is the point — the solve itself is the
+bottleneck the TPU kernel removes.
+"""
+
+import pytest
+
+from karpenter_tpu.api import Disruption, Pod, Requirement, Requirements, Resources
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import PodAffinityTerm
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.testing import Environment
+
+
+class TestScaleProvisioning:
+    def test_node_dense_500_nodes(self):
+        """500 pods, one per node via hostname anti-affinity
+        (reference provisioning_test.go:92-135)."""
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool()
+        sel = (("app", "dense"),)
+        for _ in range(500):
+            env.kube.put_pod(
+                Pod(
+                    labels={"app": "dense"},
+                    requests=Resources(cpu=0.5, memory="256Mi"),
+                    pod_affinity=[
+                        PodAffinityTerm(
+                            topology_key=L.LABEL_HOSTNAME,
+                            label_selector=sel,
+                            anti=True,
+                        )
+                    ],
+                )
+            )
+        env.settle(max_rounds=10)
+        assert not env.kube.pending_pods()
+        assert len(env.kube.node_claims) == 500
+        assert len(env.kube.nodes) == 500
+        # anti-affinity honored: exactly one app=dense pod per node
+        per_node = {}
+        for p in env.kube.pods.values():
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        assert max(per_node.values()) == 1
+
+    def test_pod_dense_6600_pods_60_nodes(self):
+        """6,600 pods at 110 pods/node -> exactly 60 nodes
+        (reference provisioning_test.go:136-173)."""
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool(kubelet_max_pods=110)
+        for _ in range(6600):
+            env.kube.put_pod(Pod(requests=Resources(cpu=0.1, memory="128Mi")))
+        env.settle(max_rounds=10)
+        assert not env.kube.pending_pods()
+        assert len(env.kube.node_claims) == 60
+        for c in env.kube.node_claims.values():
+            assert c.registered and c.initialized
+
+
+class TestScaleDeprovisioning:
+    def test_multi_mechanism_scale_down(self):
+        """Consolidation shrinks a mostly-empty fleet
+        (reference deprovisioning_test.go:349-691 family, in miniature)."""
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool(
+            requirements=Requirements(
+                [Requirement(L.LABEL_INSTANCE_CPU, Op.LT, ["17"])]
+            ),
+            disruption=Disruption(consolidation_policy="WhenUnderutilized"),
+        )
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(120)]
+        for p in pods:
+            env.kube.put_pod(p)
+        env.settle(max_rounds=10)
+        before = len(env.kube.node_claims)
+        assert before >= 8
+        for p in pods[10:]:
+            env.kube.delete_pod(p.key())
+        for _ in range(12):
+            env.step(2.0)
+        env.settle()
+        after = len(env.kube.node_claims)
+        assert after <= max(2, before // 3)
+        assert not env.kube.pending_pods()
+        # no instance leaks: running instances == live claims
+        running = [
+            i for i in env.cloud.instances.values() if i.state == "running"
+        ]
+        assert len(running) == after

@@ -1,0 +1,94 @@
+"""Solver sidecar tests: codec roundtrip, server dispatch, remote solve
+parity with the local kernel."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.ops.packer import run_pack
+from karpenter_tpu.ops.tensorize import compile_problem
+from karpenter_tpu.scheduling import TensorScheduler
+from karpenter_tpu.service import RemoteSolver, SolverServer, SolverUnavailableError
+from karpenter_tpu.service.codec import decode, encode
+from karpenter_tpu.testing import Environment
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = SolverServer(port=0).start_background()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = RemoteSolver(*server.address)
+    yield c
+    c.close()
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        meta = {"method": "pack", "k_slots": 64}
+        arrays = {
+            "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.array([True, False, True]),
+            "c": np.int32(7).reshape(()),
+        }
+        header, out = decode(encode(meta, arrays))
+        assert header["method"] == "pack" and header["k_slots"] == 64
+        np.testing.assert_array_equal(out["a"], arrays["a"])
+        np.testing.assert_array_equal(out["b"], arrays["b"])
+        assert out["c"].shape == () and out["c"] == 7
+
+
+class TestServer:
+    def test_ping_info(self, client):
+        assert client.ping()
+        info = client.info()
+        assert info["device_count"] >= 1
+
+    def test_unknown_method_errors(self, server):
+        import socket
+
+        from karpenter_tpu.service.codec import recv_frame, send_frame
+
+        with socket.create_connection(server.address) as sock:
+            send_frame(sock, encode({"method": "nope"}, {}))
+            header, _ = decode(recv_frame(sock))
+        assert header["status"] == "error"
+
+    def test_unavailable_raises(self):
+        c = RemoteSolver("127.0.0.1", 1)  # nothing listens there
+        with pytest.raises(SolverUnavailableError):
+            c.ping()
+
+
+class TestRemoteSolve:
+    def test_remote_pack_matches_local(self, client):
+        env = Environment()
+        pool = env.default_node_pool()
+        env.default_node_class()
+        types = env.instance_types.list(pool, env.kube.get_node_class("default"))
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(64)]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        local = run_pack(prob)
+        remote = client.pack_problem(prob)
+        np.testing.assert_array_equal(np.asarray(local.take), remote.take)
+        np.testing.assert_array_equal(np.asarray(local.node_cfg), remote.node_cfg)
+        np.testing.assert_array_equal(np.asarray(local.leftover), remote.leftover)
+
+    def test_scheduler_with_remote_backend(self, client):
+        env = Environment()
+        pool = env.default_node_pool()
+        env.default_node_class()
+        types = env.instance_types.list(pool, env.kube.get_node_class("default"))
+        pods = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(100)]
+        local_result = TensorScheduler([pool], {pool.name: types}).solve(pods)
+        remote_ts = TensorScheduler(
+            [pool], {pool.name: types}, pack_fn=client.pack_problem
+        )
+        remote_result = remote_ts.solve(pods)
+        assert remote_ts.last_path == "tensor"
+        assert remote_result.node_count() == local_result.node_count()
+        assert sum(len(n.pods) for n in remote_result.new_nodes) == 100

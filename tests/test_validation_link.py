@@ -1,0 +1,166 @@
+"""Admission validation (webhook analogue) + link-controller adoption."""
+
+import pytest
+
+from karpenter_tpu.api import Disruption, NodeClass, NodePool, Requirement, Requirements, Taint
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import SelectorTerm
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.api.validation import (
+    ValidationError,
+    default_node_pool,
+    validate_node_class,
+    validate_node_pool,
+)
+from karpenter_tpu.testing import Environment
+
+
+class TestValidation:
+    def test_valid_pool_passes(self):
+        validate_node_pool(NodePool(name="p", node_class_ref="d"))
+
+    def test_missing_node_class_ref(self):
+        with pytest.raises(ValidationError, match="nodeClassRef"):
+            validate_node_pool(NodePool(name="p"))
+
+    def test_restricted_requirement_key(self):
+        pool = NodePool(
+            name="p",
+            node_class_ref="d",
+            requirements=Requirements(
+                [Requirement(L.LABEL_HOSTNAME, Op.IN, ["n1"])]
+            ),
+        )
+        with pytest.raises(ValidationError, match="restricted"):
+            validate_node_pool(pool)
+
+    def test_invalid_taint_effect(self):
+        pool = NodePool(
+            name="p", node_class_ref="d",
+            taints=[Taint(key="k", effect="Sideways")],
+        )
+        with pytest.raises(ValidationError, match="taint effect"):
+            validate_node_pool(pool)
+
+    def test_invalid_budget(self):
+        pool = NodePool(
+            name="p", node_class_ref="d",
+            disruption=Disruption(budgets=["150%"]),
+        )
+        with pytest.raises(ValidationError, match="percentage"):
+            validate_node_pool(pool)
+
+    def test_bad_consolidation_policy(self):
+        pool = NodePool(
+            name="p", node_class_ref="d",
+            disruption=Disruption(consolidation_policy="Sometimes"),
+        )
+        with pytest.raises(ValidationError, match="consolidationPolicy"):
+            validate_node_pool(pool)
+
+    def test_legacy_defaults(self):
+        pool = default_node_pool(
+            NodePool(name="p", node_class_ref="d"), legacy_defaults=True
+        )
+        assert pool.requirements.get(L.LABEL_OS).has("linux")
+        assert pool.requirements.get(L.LABEL_ARCH).has("amd64")
+        assert pool.requirements.get(L.LABEL_CAPACITY_TYPE).has(
+            L.CAPACITY_TYPE_ON_DEMAND
+        )
+
+    def test_v1beta1_no_defaults(self):
+        pool = default_node_pool(NodePool(name="p", node_class_ref="d"))
+        assert pool.requirements.get(L.LABEL_CAPACITY_TYPE) is None
+
+    def test_custom_family_needs_selectors(self):
+        with pytest.raises(ValidationError, match="custom"):
+            validate_node_class(NodeClass(name="c", image_family="custom"))
+
+    def test_selector_term_id_exclusive(self):
+        nc = NodeClass(
+            name="c",
+            subnet_selector_terms=[SelectorTerm.of(id="subnet-1", Name="x")],
+        )
+        with pytest.raises(ValidationError, match="mix id"):
+            validate_node_class(nc)
+
+    def test_admission_enforced_on_put(self):
+        env = Environment()
+        with pytest.raises(ValidationError):
+            env.kube.put_node_pool(NodePool(name="bad"))
+        assert "bad" not in env.kube.node_pools
+
+
+class TestLink:
+    def test_adopts_tagged_orphan_instance(self):
+        env = Environment()
+        env.default_node_class()
+        pool = env.default_node_pool()
+        # an instance launched out-of-band with our pool tags (e.g. a
+        # previous controller generation) and no claim
+        instances, _ = env.cloud.create_fleet(
+            overrides=[
+                {"instance_type": "std1.large", "zone": "zone-a",
+                 "subnet_id": "subnet-0"}
+            ],
+            capacity_type=L.CAPACITY_TYPE_ON_DEMAND,
+            tags={
+                L.ANNOTATION_MANAGED_BY: "karpenter-tpu",
+                "karpenter.sh/nodepool": pool.name,
+                "Name": "orphan-node",
+            },
+        )
+        env.step(40.0)  # past the GC grace period: link must win the race
+        claims = list(env.kube.node_claims.values())
+        assert len(claims) == 1
+        claim = claims[0]
+        assert claim.provider_id == instances[0].id
+        assert claim.pool_name == pool.name
+        assert claim.capacity.cpu > 0  # hydrated from the catalog
+        # NOT garbage collected
+        assert env.cloud.instances[instances[0].id].state == "running"
+
+    def test_duplicate_name_tags_adopt_distinct_claims(self):
+        """Two instances sharing a Name tag must both get claims — a
+        collision would leave one unclaimed and GC would reap it."""
+        env = Environment()
+        env.default_node_class()
+        pool = env.default_node_pool()
+        instances, _ = env.cloud.create_fleet(
+            overrides=[
+                {"instance_type": "std1.large", "zone": "zone-a",
+                 "subnet_id": "subnet-0"},
+                {"instance_type": "std1.large", "zone": "zone-b",
+                 "subnet_id": "subnet-1"},
+            ],
+            capacity_type=L.CAPACITY_TYPE_ON_DEMAND,
+            count=2,
+            tags={
+                L.ANNOTATION_MANAGED_BY: "karpenter-tpu",
+                "karpenter.sh/nodepool": pool.name,
+                "Name": "shared-name",
+            },
+        )
+        assert len(instances) == 2
+        env.step(40.0)
+        claimed_ids = {
+            c.provider_id for c in env.kube.node_claims.values()
+        }
+        assert claimed_ids == {i.id for i in instances}
+        for i in instances:
+            assert env.cloud.instances[i.id].state == "running"
+
+    def test_untagged_instance_still_reaped(self):
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool()
+        instances, _ = env.cloud.create_fleet(
+            overrides=[
+                {"instance_type": "std1.large", "zone": "zone-a",
+                 "subnet_id": "subnet-0"}
+            ],
+            capacity_type=L.CAPACITY_TYPE_ON_DEMAND,
+            tags={L.ANNOTATION_MANAGED_BY: "karpenter-tpu"},  # no pool tag
+        )
+        env.step(40.0)
+        assert env.cloud.instances[instances[0].id].state == "terminated"
