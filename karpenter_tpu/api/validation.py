@@ -51,6 +51,11 @@ def validate_node_pool(pool: NodePool) -> None:
     for r in pool.requirements:
         if r.key in RESTRICTED_REQUIREMENT_KEYS:
             errs.append(f"requirement on restricted label {r.key}")
+    # template_requirements() folds labels into requirements, so the same
+    # restriction must cover spec.labels (the reference webhook does both)
+    for key in pool.labels:
+        if key in RESTRICTED_REQUIREMENT_KEYS:
+            errs.append(f"label on restricted key {key}")
     for t in pool.taints + pool.startup_taints:
         if t.effect not in VALID_TAINT_EFFECTS:
             errs.append(f"invalid taint effect {t.effect!r}")

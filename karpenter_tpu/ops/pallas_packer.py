@@ -1,0 +1,395 @@
+"""Pallas TPU packing kernel: the whole FFD scan fused into one kernel.
+
+The `lax.scan` kernel (ops/packer.py) materializes its carry through XLA
+loop machinery every class step.  This kernel keeps ALL solver state
+resident in VMEM scratch across a sequential grid over classes — residual
+capacities, config commitments, per-signature admissions, per-signature
+placement counters — so each step is pure VPU/MXU work with zero HBM
+round-trips for state.
+
+TPU-shaped reformulations (the axon Mosaic lowering has no cumsum and no
+vector gather, and silently miscompiles take_along_axis):
+
+- **first-fit prefix allocation** = exclusive prefix-sum over the flat
+  (KR, 128) slot grid, computed as two triangular-mask matmuls on the MXU
+  at ``Precision.HIGHEST`` (exact for integer counts < 2^24).
+- **per-slot feasibility without gather**: ``feas[g, cfg[k]]`` would need
+  a vector gather.  Instead the kernel carries ``sig_ok[s, k]`` — does a
+  pod of signature s fit slot k's committed config — seeded from the
+  signature x config admission table when a slot opens (a masked
+  broadcast, not a gather) and read back per class by a dynamic row index.
+  This caps the supported signature count at S_MAX; wider problems use
+  the scan kernel (scheduling/solver.py dispatches).
+- **argmin over configs** = min + first-match-index via masked flat iota;
+  the chosen config's column (allocatable vector, admission column) is
+  extracted with one-hot masked reductions — again no gather.
+
+Semantics match `pack_kernel` exactly; tests/test_pallas.py asserts
+bit-equality of placements on shared problems.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_tpu.ops.packer import PackResult, _bucket, node_slot_bound
+from karpenter_tpu.ops.tensorize import CompiledProblem
+
+S_MAX = 32  # max distinct (signature, zone-pin) rows the VMEM state holds
+T_MAX = 8  # max tracked anti-affinity counter rows
+R_FIX = 8  # fixed resource-axis width (padded)
+LANES = 128
+BIGF = float(2**30)
+BIGI = 2**30
+
+
+def _flat_iota(rows: int) -> jax.Array:
+    return (
+        jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
+        + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    )
+
+
+def _exclusive_prefix(x: jax.Array) -> jax.Array:
+    """Exclusive prefix-sum in flat row-major order over (rows, 128).
+
+    Two triangular matmuls on the MXU: intra-row prefix + row offsets.
+    HIGHEST precision keeps integer-valued f32 exact (counts < 2^24).
+    """
+    rows = x.shape[0]
+    li = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0)
+    lj = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+    upper = (li < lj).astype(jnp.float32)
+    intra = jax.lax.dot_general(
+        x, upper, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    rowsum = jnp.sum(x, axis=1, keepdims=True)
+    ri = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0)
+    rj = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1)
+    lower = (rj < ri).astype(jnp.float32)
+    roff = jax.lax.dot_general(
+        lower, rowsum, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    return intra + roff
+
+
+def _pack_step(
+    # scalar-prefetch args (SMEM, full arrays indexed by program id)
+    cnt_ref, maxper_ref, slot_ref, sig_ref, reqf_ref, next0_ref,
+    # per-class blocks
+    feas_ref,
+    # resident tables
+    sigfeas_ref, alloc_ref, price_ref, open_ref,
+    # initial state
+    rem0_ref, cfg0_ref, npods0_ref, sigok0_ref, trk0_ref,
+    # outputs
+    take_ref, cfg_out_ref, npods_out_ref, rem_out_ref,
+    # scratch state
+    rem_s, cfg_s, npods_s, sigok_s, trk_s, nxt_s,
+    *, objective: str, n_steps: int,
+):
+    g = pl.program_id(0)
+
+    @pl.when(g == 0)
+    def _init():
+        rem_s[:] = rem0_ref[:]
+        cfg_s[:] = cfg0_ref[:]
+        npods_s[:] = npods0_ref[:]
+        sigok_s[:] = sigok0_ref[:]
+        trk_s[:] = trk0_ref[:]
+        nxt_s[0] = next0_ref[0]
+
+    kr = rem_s.shape[1]
+    cr = alloc_ref.shape[1]
+    n = cnt_ref[g].astype(jnp.float32)
+    maxper = maxper_ref[g].astype(jnp.float32)
+    tslot = slot_ref[g]
+    srow = sig_ref[g]
+    req = [reqf_ref[g * R_FIX + r] for r in range(R_FIX)]
+
+    # ---- fill open slots (first-fit in slot order) ----------------------
+    ok = sigok_s[pl.ds(srow, 1)][0]  # (KR, 128)
+    cap = jnp.full((kr, LANES), BIGF)
+    for r in range(R_FIX):
+        per_r = jnp.floor(rem_s[r] / jnp.maximum(req[r], 1e-9) + 1e-4)
+        cap = jnp.where(req[r] > 0, jnp.minimum(cap, per_r), cap)
+    trk_row = trk_s[pl.ds(tslot, 1)][0].astype(jnp.float32)
+    cap = jnp.minimum(cap, jnp.maximum(maxper - trk_row, 0.0))
+    cap = jnp.where(ok > 0, jnp.maximum(cap, 0.0), 0.0)
+    prefix = _exclusive_prefix(cap)
+    take1 = jnp.clip(n - prefix, 0.0, cap)
+    n2 = n - jnp.sum(take1)
+
+    # ---- open new slots on the best config ------------------------------
+    feas_g = feas_ref[0]  # (CR, 128)
+    capc = jnp.full((cr, LANES), BIGF)
+    for r in range(R_FIX):
+        per_r = jnp.floor(alloc_ref[r] / jnp.maximum(req[r], 1e-9) + 1e-4)
+        capc = jnp.where(req[r] > 0, jnp.minimum(capc, per_r), capc)
+    capc = jnp.minimum(jnp.maximum(capc, 0.0), maxper)
+    okc = (feas_g > 0) & (open_ref[:] > 0) & (capc > 0)
+    if objective == "cost":
+        score = jnp.where(okc, price_ref[:] / jnp.maximum(capc, 1.0), BIGF)
+    else:
+        score = jnp.where(okc, -capc + price_ref[:], BIGF)
+    smin = jnp.min(score)
+    feasible_new = smin < BIGF * 0.5
+    ciota = _flat_iota(cr)
+    c_star = jnp.min(jnp.where(score == smin, ciota, BIGI))
+    sel = (ciota == c_star).astype(jnp.float32)
+    per = jnp.sum(sel * capc)
+    per_safe = jnp.maximum(per, 1.0)
+    need = jnp.where(feasible_new, jnp.ceil(n2 / per_safe), 0.0)
+    nxt = nxt_s[0]
+    slots_left = (kr * LANES - nxt).astype(jnp.float32)
+    opened = jnp.minimum(need, jnp.maximum(slots_left, 0.0))
+    kiota = _flat_iota(kr)
+    wmask = (kiota >= nxt) & (kiota < nxt + opened.astype(jnp.int32))
+    offset = (kiota - nxt).astype(jnp.float32) * per_safe
+    take2 = jnp.where(wmask, jnp.clip(n2 - offset, 0.0, per_safe), 0.0)
+    take = take1 + take2
+
+    # ---- state updates --------------------------------------------------
+    for r in range(R_FIX):
+        alloc_star_r = jnp.sum(sel * alloc_ref[r])
+        rem_s[r] = jnp.where(wmask, alloc_star_r, rem_s[r]) - take * req[r]
+    cfg_s[:] = jnp.where(wmask, c_star, cfg_s[:])
+    take_i = take.astype(jnp.int32)
+    npods_s[:] = npods_s[:] + take_i
+    trk_s[pl.ds(tslot, 1)] = trk_s[pl.ds(tslot, 1)] + take_i[None]
+    n_sig = sigok_s.shape[0]
+    for s in range(n_sig):
+        sig_col_s = jnp.sum(sel * sigfeas_ref[s])
+        sigok_s[s] = jnp.where(wmask, sig_col_s, sigok_s[s])
+    nxt_s[0] = nxt + opened.astype(jnp.int32)
+
+    take_ref[0] = take_i
+
+    @pl.when(g == n_steps - 1)
+    def _finalize():
+        cfg_out_ref[:] = cfg_s[:]
+        npods_out_ref[:] = npods_s[:]
+        rem_out_ref[:] = rem_s[:]
+
+
+# deferred import so module import never initializes a backend
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+
+@functools.partial(
+    jax.jit, static_argnames=("g_steps", "kr", "cr", "s8", "t8", "objective", "interpret")
+)
+def _pallas_pack(
+    req, cnt, maxper, slot, sig, feas, sigfeas, alloc_t, price_n, openable,
+    rem0, cfg0, npods0, sigok0, trk0, next0,
+    *, g_steps: int, kr: int, cr: int, s8: int, t8: int, objective: str,
+    interpret: bool,
+):
+    kernel = functools.partial(
+        _pack_step, objective=objective, n_steps=g_steps
+    )
+    full = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,  # cnt, maxper, slot, sig, req_flat, next0
+        grid=(g_steps,),
+        in_specs=[
+            pl.BlockSpec((1, cr, LANES), lambda g, *_: (g, 0, 0)),  # feas
+            full(),  # sigfeas
+            full(),  # alloc_t
+            full(),  # price_n
+            full(),  # openable
+            full(),  # rem0
+            full(),  # cfg0
+            full(),  # npods0
+            full(),  # sigok0
+            full(),  # trk0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, kr, LANES), lambda g, *_: (g, 0, 0)),  # take
+            pl.BlockSpec((kr, LANES), lambda g, *_: (0, 0)),  # cfg_out
+            pl.BlockSpec((kr, LANES), lambda g, *_: (0, 0)),  # npods_out
+            pl.BlockSpec((R_FIX, kr, LANES), lambda g, *_: (0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R_FIX, kr, LANES), jnp.float32),  # rem
+            pltpu.VMEM((kr, LANES), jnp.int32),  # cfg
+            pltpu.VMEM((kr, LANES), jnp.int32),  # npods
+            pltpu.VMEM((s8, kr, LANES), jnp.float32),  # sig_ok
+            pltpu.VMEM((t8, kr, LANES), jnp.int32),  # trk counts
+            pltpu.SMEM((1,), jnp.int32),  # next slot
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((g_steps, kr, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((kr, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((kr, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((R_FIX, kr, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        cnt, maxper, slot, sig, req.reshape(-1), next0,
+        feas, sigfeas, alloc_t, price_n, openable,
+        rem0, cfg0, npods0, sigok0, trk0,
+    )
+    return out
+
+
+def supports(prob: CompiledProblem) -> bool:
+    """Whether the VMEM-resident formulation fits this problem."""
+    return (
+        prob.supported
+        and len(prob.axes) <= R_FIX
+        and _n_signatures(prob) <= S_MAX
+        and prob.n_track_slots <= T_MAX
+    )
+
+
+def _n_signatures(prob: CompiledProblem) -> int:
+    return len({(cm.signature, cm.zone_pin) for cm in prob.classes}) or 1
+
+
+def run_pack_pallas(
+    prob: CompiledProblem, k_slots: int = 0, objective: str = "nodes",
+    interpret: bool | None = None,
+) -> PackResult:
+    """Drop-in for run_pack via the fused Pallas kernel.
+
+    ``interpret`` defaults to True off-TPU (tests on the virtual CPU mesh
+    run the same kernel through the Pallas interpreter).
+    """
+    if not supports(prob):
+        raise ValueError(
+            "problem exceeds the Pallas formulation "
+            f"(signatures={_n_signatures(prob)}>{S_MAX} or axes>{R_FIX}); "
+            "use ops.packer.run_pack"
+        )
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    G, C = prob.feas.shape
+    R = prob.req.shape[1] if prob.req.size else len(prob.axes)
+    if k_slots <= 0:
+        k_slots = node_slot_bound(prob)
+    Gp = _bucket(max(G, 1))
+    Cp = max(_bucket(max(C, 1)), 8 * LANES)
+    Kp = max(_bucket(max(k_slots, 1)), 8 * LANES)
+    kr, cr = Kp // LANES, Cp // LANES
+    E = len(prob.used0)
+
+    # signature rows: map each class to its (signature, zone_pin) row
+    sig_keys = {}
+    sig_of = np.zeros(Gp, np.int32)
+    for gidx, cm in enumerate(prob.classes):
+        key = (cm.signature, cm.zone_pin)
+        sig_of[gidx] = sig_keys.setdefault(key, len(sig_keys))
+    s8 = max(_bucket(max(len(sig_keys), 1), floor=8), 8)
+    t8 = max(_bucket(max(prob.n_track_slots, 1), floor=8), 8)
+
+    req = np.zeros((Gp, R_FIX), np.float32)
+    req[:G, :R] = prob.req
+    cnt = np.zeros(Gp, np.int32)
+    cnt[:G] = prob.cnt
+    maxper = np.zeros(Gp, np.int32)
+    maxper[:G] = np.minimum(prob.maxper, 2**20)
+    slot = np.zeros(Gp, np.int32)
+    slot[:G] = prob.slot
+    feas = np.zeros((Gp, cr, LANES), np.float32)
+    feas.reshape(Gp, -1)[:G, :C] = prob.feas.astype(np.float32)
+    # signature x config admission (class rows of one signature are equal)
+    sigfeas = np.zeros((s8, cr, LANES), np.float32)
+    for gidx in range(G):
+        sigfeas[sig_of[gidx]].reshape(-1)[:C] = prob.feas[gidx]
+    alloc_t = np.zeros((R_FIX, cr, LANES), np.float32)
+    alloc_t.reshape(R_FIX, -1)[:R, :C] = prob.alloc.T
+    finite = prob.price[np.isfinite(prob.price)]
+    ceil = float(finite.max()) + 1.0 if finite.size else 1.0
+    price_n = np.full((cr, LANES), BIGF, np.float32)
+    price_n.reshape(-1)[:C] = np.where(
+        np.isfinite(prob.price), prob.price / ceil, np.float32(BIGF)
+    )
+    openable = np.zeros((cr, LANES), np.float32)
+    openable.reshape(-1)[:C] = prob.openable.astype(np.float32)
+
+    rem0 = np.zeros((R_FIX, kr, LANES), np.float32)
+    cfg0 = np.full((kr, LANES), -1, np.int32)
+    npods0 = np.zeros((kr, LANES), np.int32)
+    sigok0 = np.zeros((s8, kr, LANES), np.float32)
+    trk0 = np.zeros((t8, kr, LANES), np.int32)
+    if E:
+        # existing nodes: remaining capacity + per-signature admission
+        rem_e = (prob.alloc[prob.cfg0] - prob.used0).astype(np.float32)  # [E,R]
+        rem0.reshape(R_FIX, -1)[:R, :E] = rem_e.T
+        cfg0.reshape(-1)[:E] = prob.cfg0
+        npods0.reshape(-1)[:E] = prob.npods0
+        for key, srow in sig_keys.items():
+            gidx = next(
+                i
+                for i, cm in enumerate(prob.classes)
+                if (cm.signature, cm.zone_pin) == key
+            )
+            sigok0[srow].reshape(-1)[:E] = prob.feas[
+                gidx, len(prob.configs) - E :
+            ].astype(np.float32)
+        trk0.reshape(t8, -1)[: prob.sig_used0.shape[0], :E] = prob.sig_used0
+
+    out = _pallas_pack(
+        req, cnt, maxper, slot, sig_of, feas, sigfeas, alloc_t, price_n,
+        openable, rem0, cfg0, npods0, sigok0, trk0,
+        np.array([E], np.int32),
+        g_steps=Gp, kr=kr, cr=cr, s8=s8, t8=t8, objective=objective,
+        interpret=interpret,
+    )
+    # one transfer for all outputs (the device link may be high-latency)
+    take, cfg_out, npods_out, rem_out = jax.device_get(out)
+    take_flat = np.asarray(take).reshape(Gp, Kp)
+    leftover = cnt - take_flat.sum(axis=1).astype(np.int32)
+    node_cfg = np.asarray(cfg_out).reshape(Kp)
+    node_pods = np.asarray(npods_out).reshape(Kp)
+    rem_np = np.asarray(rem_out).reshape(R_FIX, Kp).T[:, :R]  # [Kp, R]
+    # node_used = alloc[cfg] - remaining (zero for unopened slots)
+    alloc_by_cfg = np.zeros((Kp, R), np.float32)
+    opened_mask = node_cfg >= 0
+    alloc_by_cfg[opened_mask] = prob.alloc[node_cfg[opened_mask]]
+    node_used = np.where(opened_mask[:, None], alloc_by_cfg - rem_np, 0.0)
+    return PackResult(
+        take=take_flat,
+        leftover=leftover,
+        node_cfg=node_cfg,
+        node_pods=node_pods,
+        node_used=node_used.astype(np.float32),
+    )
+
+
+# below this count the fused kernel's fixed launch cost outweighs its
+# per-step win over the scan kernel (measured on TPU v5e: ~20ms fixed,
+# ~7us/step vs the scan's ~29us/step)
+PALLAS_MIN_CLASSES = 256
+
+
+def auto_pack(
+    prob: CompiledProblem, k_slots: int = 0, objective: str = "nodes"
+) -> PackResult:
+    """Backend dispatch: the fused Pallas kernel for large heterogeneous
+    batches on real TPUs, the lax.scan kernel otherwise."""
+    if (
+        len(prob.classes) >= PALLAS_MIN_CLASSES
+        and supports(prob)
+        and jax.devices()[0].platform == "tpu"
+    ):
+        return run_pack_pallas(prob, k_slots, objective)
+    from karpenter_tpu.ops.packer import run_pack
+
+    return run_pack(prob, k_slots, objective)

@@ -23,7 +23,7 @@ from karpenter_tpu.api import InstanceType, NodePool, Pod, Requirement
 from karpenter_tpu.api import labels as L
 from karpenter_tpu.api.requirements import Op
 from karpenter_tpu.api.resources import Resources
-from karpenter_tpu.ops.packer import run_pack
+from karpenter_tpu.ops.pallas_packer import auto_pack
 from karpenter_tpu.ops.tensorize import (
     CompiledProblem,
     ConfigMeta,
@@ -49,7 +49,7 @@ class TensorScheduler:
         daemonsets: Sequence[Pod] = (),
         zones: Sequence[str] = (),
         objective: str = "nodes",
-        pack_fn=run_pack,
+        pack_fn=auto_pack,
     ):
         self.pools = list(pools)
         self.instance_types = instance_types

@@ -32,6 +32,7 @@ PACK_ARG_ORDER = (
     "used0", "cfg0", "npods0", "next0", "sig0",
 )
 PACK_RESULT_FIELDS = ("take", "leftover", "node_cfg", "node_pods", "node_used")
+_NEXT0_IDX = PACK_ARG_ORDER.index("next0")
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -40,6 +41,9 @@ class _Handler(socketserver.BaseRequestHandler):
             try:
                 payload = recv_frame(self.request)
             except (ConnectionError, OSError):
+                return
+            except ValueError as exc:  # garbage/oversized frame: close clean
+                log.warning("dropping malformed frame: %s", exc)
                 return
             try:
                 response = self.server.dispatch(payload)  # type: ignore[attr-defined]
@@ -96,7 +100,7 @@ class SolverServer(socketserver.ThreadingTCPServer):
             )
         args = [arrays[n] for n in PACK_ARG_ORDER]
         # next0 travels as a 0-d array; the kernel wants a scalar
-        args[11] = np.int32(args[11])
+        args[_NEXT0_IDX] = np.int32(args[_NEXT0_IDX])
         result = pack_kernel(
             *args,
             k_slots=int(header["k_slots"]),

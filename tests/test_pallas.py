@@ -1,0 +1,167 @@
+"""Pallas packer parity: the fused kernel must match the scan kernel
+placement-for-placement (conftest runs it through the interpreter on the
+virtual CPU mesh; bench runs it compiled on the real chip)."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import Pod, Requirement, Resources
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.api.requirements import Op
+from karpenter_tpu.ops import pallas_packer
+from karpenter_tpu.ops.packer import run_pack
+from karpenter_tpu.ops.tensorize import compile_problem
+from karpenter_tpu.testing import Environment
+
+
+@pytest.fixture(scope="module")
+def setup():
+    env = Environment()
+    pool = env.default_node_pool()
+    nc = env.default_node_class()
+    types = env.instance_types.list(pool, nc)
+    return env, pool, types
+
+
+def assert_parity(prob, objective="nodes"):
+    scan = run_pack(prob, objective=objective)
+    fused = pallas_packer.run_pack_pallas(prob, objective=objective)
+    scan_take = np.asarray(scan.take)
+    G = len(prob.classes)
+    # per-class totals and per-slot aggregate placements must agree
+    np.testing.assert_array_equal(
+        scan_take[:G].sum(axis=1), fused.take[:G].sum(axis=1)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(scan.leftover)[:G], fused.leftover[:G]
+    )
+    ks = min(scan_take.shape[1], fused.take.shape[1])
+    np.testing.assert_array_equal(
+        scan_take[:G, :ks], fused.take[:G, :ks]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(scan.node_cfg)[:ks][np.asarray(scan.node_pods)[:ks] > 0],
+        fused.node_cfg[:ks][fused.node_pods[:ks] > 0],
+    )
+    return fused
+
+
+class TestPallasParity:
+    def test_homogeneous(self, setup):
+        env, pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(300)]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        assert pallas_packer.supports(prob)
+        assert_parity(prob)
+
+    def test_heterogeneous_classes(self, setup):
+        env, pool, types = setup
+        sizes = [
+            Resources(cpu=0.25, memory="512Mi"),
+            Resources(cpu=1, memory="2Gi"),
+            Resources(cpu=2, memory="4Gi"),
+            Resources(cpu=4, memory="16Gi"),
+        ]
+        pods = [Pod(requests=sizes[i % 4]) for i in range(400)]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        assert_parity(prob)
+
+    def test_cost_objective(self, setup):
+        env, pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(200)]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        assert_parity(prob, objective="cost")
+
+    def test_anti_affinity_cap(self, setup):
+        env, pool, types = setup
+        sel = (("app", "d"),)
+        pods = [
+            Pod(
+                labels={"app": "d"},
+                requests=Resources(cpu=0.25),
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME, label_selector=sel,
+                        anti=True,
+                    )
+                ],
+            )
+            for _ in range(50)
+        ]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        fused = assert_parity(prob)
+        assert (fused.take[: len(prob.classes)].max(axis=0) <= 1).all()
+
+    def test_zone_spread_split(self, setup):
+        env, pool, types = setup
+        sel = (("app", "z"),)
+        pods = [
+            Pod(
+                labels={"app": "z"},
+                requests=Resources(cpu=1, memory="1Gi"),
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+                    )
+                ],
+            )
+            for _ in range(60)
+        ]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        assert_parity(prob)
+
+    def test_mixed_constraints(self, setup):
+        env, pool, types = setup
+        pods = []
+        for i in range(150):
+            p = Pod(requests=Resources(cpu=[0.5, 1, 2][i % 3], memory="1Gi"))
+            if i % 4 == 0:
+                p.node_selector = {L.LABEL_ARCH: "arm64"}
+            if i % 5 == 0:
+                p.required_affinity = [
+                    Requirement(L.LABEL_ZONE, Op.IN, ["zone-a", "zone-b"])
+                ]
+            pods.append(p)
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        assert_parity(prob)
+
+    def test_existing_nodes_prefill(self, setup):
+        env, pool, types = setup
+        from karpenter_tpu.state.cluster import StateNode
+
+        existing = [
+            StateNode(
+                name=f"node-{i}",
+                provider_id=f"i-{i}",
+                labels={
+                    L.LABEL_ARCH: "amd64",
+                    L.LABEL_OS: "linux",
+                    L.LABEL_ZONE: "zone-a",
+                },
+                taints=[],
+                allocatable=Resources(cpu=8, memory="32Gi", pods=110),
+            )
+            for i in range(3)
+        ]
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(20)]
+        prob = compile_problem(
+            pods, [pool], {pool.name: types}, existing=existing
+        )
+        fused = assert_parity(prob)
+        # existing slots filled first
+        assert fused.take[:, :3].sum() > 0
+
+    def test_unsupported_raises(self, setup):
+        env, pool, types = setup
+        # more signatures than the VMEM state holds
+        pods = [
+            Pod(
+                requests=Resources(cpu=1),
+                node_selector={"custom-label": f"v{i}"},
+            )
+            for i in range(pallas_packer.S_MAX + 1)
+        ]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        with pytest.raises(ValueError, match="signatures"):
+            pallas_packer.run_pack_pallas(prob)
