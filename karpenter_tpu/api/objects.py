@@ -436,6 +436,10 @@ class NodeClass:
     security_group_selector_terms: List[SelectorTerm] = field(default_factory=list)
     image_selector_terms: List[SelectorTerm] = field(default_factory=list)
     image_family: str = "standard"  # standard | accelerated | custom
+    # static launch-template passthrough: when set, template resolution is
+    # bypassed and this user-owned template launches as-is (reference
+    # launchtemplate.go:104-107)
+    launch_template_name: str = ""
     user_data: str = ""
     role: str = ""
     tags: Dict[str, str] = field(default_factory=dict)
@@ -454,6 +458,7 @@ class NodeClass:
         (reference drift.go:136-152: NodeClass(Template)Drift)."""
         spec = {
             "image_family": self.image_family,
+            "launch_template_name": self.launch_template_name,
             "user_data": self.user_data,
             "role": self.role,
             "tags": sorted(self.tags.items()),

@@ -38,6 +38,29 @@ class InsufficientCapacityError(CloudAPIError):
         self.pool = pool  # (instance_type, zone, capacity_type)
 
 
+class LaunchTemplateNotFoundError(CloudAPIError):
+    """CreateFleet referenced a launch template that no longer exists —
+    the stale-template race the reference retries once
+    (pkg/providers/instance/instance.go:94-98)."""
+
+    def __init__(self, name: str):
+        super().__init__("InvalidLaunchTemplateName.NotFound", name)
+        self.name = name
+
+
+@dataclass
+class FakeLaunchTemplate:
+    """Cloud-side launch template (reference pkg/fake stores LTs so
+    hydration at launchtemplate.go:323-339 has something to read)."""
+
+    name: str
+    image_id: str = ""
+    security_group_ids: List[str] = field(default_factory=list)
+    user_data: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+    created_at: float = 0.0
+
+
 @dataclass
 class MachineShape:
     """Catalog row (analogue of one DescribeInstanceTypes entry)."""
@@ -164,6 +187,7 @@ class FakeCloud:
         self.security_groups: Dict[str, FakeSecurityGroup] = {}
         self.images: Dict[str, FakeImage] = {}
         self.instances: Dict[str, FakeInstance] = {}
+        self.launch_templates: Dict[str, FakeLaunchTemplate] = {}
         self.instance_profiles: Dict[str, str] = {}  # name -> role
         self.queue: List[QueueMessage] = []
         self.kube_version = "1.28"
@@ -276,6 +300,44 @@ class FakeCloud:
         self.recorder.record("GetProducts")
         return {t: s.od_price for t, s in self.shapes.items()}
 
+    # ----------------------------------------------------- launch templates
+    def create_launch_template(self, lt: FakeLaunchTemplate) -> FakeLaunchTemplate:
+        self.recorder.record("CreateLaunchTemplate", lt.name)
+        if not lt.created_at:
+            lt.created_at = self.clock.now()
+        self.launch_templates[lt.name] = lt
+        return lt
+
+    def describe_launch_templates(
+        self, tag_filters: Optional[Mapping[str, str]] = None
+    ) -> List[FakeLaunchTemplate]:
+        self.recorder.record(
+            "DescribeLaunchTemplates", tuple((tag_filters or {}).items())
+        )
+        out = []
+        for lt in self.launch_templates.values():
+            if tag_filters and not all(
+                lt.tags.get(k) == v or (v == "*" and k in lt.tags)
+                for k, v in tag_filters.items()
+            ):
+                continue
+            out.append(lt)
+        return out
+
+    def delete_launch_template(self, name: str) -> None:
+        self.recorder.record("DeleteLaunchTemplate", name)
+        self.launch_templates.pop(name, None)
+
+    # -------------------------------------------------------------- tagging
+    def create_tags(self, resource_id: str, tags: Mapping[str, str]) -> None:
+        """Per-resource tag stamping (the reference's CreateTags; used for
+        claim-specific tags that must NOT ride the shared fleet request)."""
+        with self._lock:
+            self.recorder.record("CreateTags", resource_id, tuple(sorted(tags.items())))
+            inst = self.instances.get(resource_id)
+            if inst is not None:
+                inst.tags.update(tags)
+
     # -------------------------------------------------------------- fleet
     def create_fleet(
         self,
@@ -297,6 +359,8 @@ class FakeCloud:
         """
         with self._lock:
             self.recorder.record("CreateFleet", len(overrides), capacity_type, count)
+            if launch_template and launch_template not in self.launch_templates:
+                raise LaunchTemplateNotFoundError(launch_template)
             errors: Dict[Tuple[str, str, str], InsufficientCapacityError] = {}
             launched: List[FakeInstance] = []
             ordered = sorted(

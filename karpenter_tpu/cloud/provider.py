@@ -180,6 +180,12 @@ class CloudProvider:
         stamped = claim.annotations.get(L.ANNOTATION_NODECLASS_HASH)
         if stamped is not None and stamped != node_class.static_hash():
             return DRIFT_NODECLASS
+        if node_class.launch_template_name:
+            # static-template nodes launch whatever the user's template says;
+            # comparing against resolver-managed images/SGs would flag every
+            # such node drifted forever (the reference skips live comparison
+            # for spec.launchTemplateName node classes the same way)
+            return ""
         try:
             instance = self.p.instances.get(claim.provider_id)
         except NodeClaimNotFoundError:

@@ -85,6 +85,9 @@ class DisruptionController:
         self.registry = registry
         self._last_non_empty: Dict[str, float] = {}  # claim -> last busy ts
         self._budgets: Dict[str, int] = {}  # per-pool allowance, per pass
+        # long-lived simulation scheduler (catalog cache shared across
+        # candidate evaluations and reconciles)
+        self._scheduler = TensorScheduler([], {}, objective="cost")
 
     # ------------------------------------------------------------- reconcile
     def reconcile(self) -> None:
@@ -299,12 +302,11 @@ class DisruptionController:
             pool.name: self.cloud_provider.get_instance_types(pool)
             for pool in pools
         }
-        scheduler = TensorScheduler(
+        scheduler = self._scheduler.update(
             pools,
             inventory,
             existing=remaining,
             daemonsets=self.kube.daemonset_pods(),
-            objective="cost",
         )
         result = scheduler.solve(pods)
         if result.unschedulable:

@@ -58,10 +58,10 @@ class PodBatcher:
         self._seen: set = set()
 
     def observe(self, pods: Sequence[Pod]) -> None:
-        now = self.clock.now()
-        new = {p.key() for p in pods} - self._seen
         if not pods:
             return
+        now = self.clock.now()
+        new = {p.key() for p in pods} - self._seen
         if self._first is None:
             self._first = now
             self._last = now
@@ -104,6 +104,9 @@ class Provisioner:
             self.settings.batch_idle_duration,
             self.settings.batch_max_duration,
         )
+        # long-lived scheduler: its compiled-catalog cache hits whenever the
+        # instance-type provider serves the same cached inventory lists
+        self.scheduler = TensorScheduler([], {})
 
     # -------------------------------------------------------------- reconcile
     def reconcile(self) -> List[NodeClaim]:
@@ -141,7 +144,7 @@ class Provisioner:
                 log.warning("inventory for pool %s failed: %s", pool.name, exc)
                 inventory[pool.name] = []
         snapshot = self.cluster.snapshot()
-        scheduler = TensorScheduler(
+        scheduler = self.scheduler.update(
             pools,
             inventory,
             existing=snapshot,
@@ -203,8 +206,18 @@ class Provisioner:
                             "NodeClaim", "InsufficientCapacity", claim.name,
                             str(exc),
                         )
-                        continue
-                    raise
+                    else:
+                        # per-claim isolation: one flaky cloud error must not
+                        # kill the reconcile loop or strand the other claims'
+                        # nominations (the reference logs-and-continues per
+                        # machine); the pods re-enter the next batch
+                        log.exception("launch of %s failed", claim.name)
+                        self.registry.inc("karpenter_nodeclaims_launch_failed",
+                                          {"reason": "error"})
+                        self.kube.record_event(
+                            "NodeClaim", "LaunchFailed", claim.name, str(exc)
+                        )
+                    continue
                 self.kube.put_node_claim(claim)
                 self.registry.inc(
                     "karpenter_nodeclaims_launched", {"nodepool": claim.pool_name}

@@ -3,7 +3,11 @@ cloudprovider error wrappers, cloudprovider.go:101, instance.go:121)."""
 
 from __future__ import annotations
 
-from karpenter_tpu.cloud.fake.backend import CloudAPIError, InsufficientCapacityError
+from karpenter_tpu.cloud.fake.backend import (
+    CloudAPIError,
+    InsufficientCapacityError,
+    LaunchTemplateNotFoundError,
+)
 
 
 class NodeClaimNotFoundError(Exception):
@@ -22,12 +26,6 @@ class InsufficientCapacityAggregateError(Exception):
     def __init__(self, pools):
         super().__init__(f"insufficient capacity in all {len(pools)} pools")
         self.pools = list(pools)
-
-
-class LaunchTemplateNotFoundError(CloudAPIError):
-    def __init__(self, name: str):
-        super().__init__("InvalidLaunchTemplateName.NotFound", name)
-        self.name = name
 
 
 def is_not_found(err: Exception) -> bool:

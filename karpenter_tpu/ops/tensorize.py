@@ -429,18 +429,48 @@ def compile_problem(
                 and c.when_unsatisfiable == "DoNotSchedule"
             )
             zr = rep.scheduling_requirements().get(L.LABEL_ZONE)
-            split_zones = [z for z in all_zones if zr is None or zr.has(z)]
+            cand_zones = [z for z in all_zones if zr is None or zr.has(z)]
+            if not cand_zones:
+                cand_zones = all_zones
+            # only split into zones where the class can actually land: at
+            # least one label-feasible, resource-fitting openable config, or
+            # an admitting existing node — a share pinned to a zone with no
+            # feasible placement would come back unschedulable even when a
+            # feasible near-balanced split exists
+            feas_zones = _feasible_zones(rep, catalog, pools, live, requests)
+            split_zones = [z for z in cand_zones if z in feas_zones]
             if not split_zones:
-                split_zones = all_zones
+                split_zones = cand_zones
             # seed with bound pods the constraint's SELECTOR matches (the
             # oracle replays placements the same way, topology.py:91-93)
             zcounts = {z: 0 for z in split_zones}
+            all_counts = {z: 0 for z in cand_zones}
             for sn in live:
                 if sn.zone in zcounts:
                     zcounts[sn.zone] += sum(
                         1 for bp in sn.pods if c0.selects(bp)
                     )
+                if sn.zone in all_counts:
+                    all_counts[sn.zone] += sum(
+                        1 for bp in sn.pods if c0.selects(bp)
+                    )
             share = _balanced_split(len(members), zcounts)
+            if len(split_zones) < len(cand_zones) and not reason:
+                # skew is measured against ALL candidate domains: if an
+                # infeasible zone anchors the global minimum and the split
+                # would push a feasible zone past min+maxSkew, the kernel's
+                # hard-pinned shares diverge from DoNotSchedule semantics —
+                # let the oracle arbitrate (it caps per-domain instead)
+                finals = dict(all_counts)
+                for z, take in share.items():
+                    finals[z] = finals.get(z, 0) + take
+                floor = min(finals.values(), default=0)
+                if any(
+                    finals[z] > floor + c0.max_skew for z in split_zones
+                ):
+                    reason = (
+                        "zone spread constrained by infeasible domains"
+                    )
             cursor = 0
             for z in split_zones:
                 take = share[z]
@@ -579,6 +609,41 @@ def compile_problem(
         n_track_slots=S,
         unsupported_reason=reason,
     )
+
+
+def _feasible_zones(
+    rep: Pod,
+    catalog: Catalog,
+    pools: Sequence[NodePool],
+    live: Sequence[StateNode],
+    requests: Resources,
+) -> set:
+    """Zones where `rep`'s class has >=1 feasible placement: a
+    label-compatible, resource-fitting openable config, or an admitting
+    existing node with room for the request."""
+    sched = rep.scheduling_requirements()
+    req_vec = _vec(requests, catalog.axes)
+    pools_by_name = {p.name: p for p in pools}
+    zones: set = set()
+    for pname, pr in catalog.pool_rows.items():
+        merged = _merge_pool(rep, sched, pools_by_name[pname])
+        if merged is None:
+            continue
+        type_ok = np.array(
+            [
+                it.requirements.compatible(merged, allow_undefined=True)
+                for it in pr.uniq_types
+            ],
+            dtype=bool,
+        )
+        fits = (req_vec[None, :] <= catalog.alloc[pr.rows] + 1e-6).all(axis=1)
+        ok_rows = type_ok[pr.t_of] & fits
+        zones.update(pr.zones[z] for z in set(pr.z_of[ok_rows].tolist()))
+    for sn in live:
+        if sn.zone and sn.zone not in zones and _fits_existing(rep, sched, sn):
+            if (sn.used + requests).fits(sn.allocatable):
+                zones.add(sn.zone)
+    return zones
 
 
 def _balanced_split(n: int, existing_counts: Dict[str, int]) -> Dict[str, int]:

@@ -33,6 +33,7 @@ from karpenter_tpu.cloud.fake.backend import (
     FakeCloud,
     FakeInstance,
     InsufficientCapacityError,
+    LaunchTemplateNotFoundError,
 )
 from karpenter_tpu.errors import (
     InsufficientCapacityAggregateError,
@@ -108,8 +109,16 @@ class InstanceProvider:
         capacity_type = self._capacity_type(claim, types)
         try:
             return self._launch(claim, node_class, types, capacity_type)
-        except InsufficientCapacityAggregateError:
-            raise
+        except LaunchTemplateNotFoundError:
+            if node_class.launch_template_name:
+                # user-owned static template vanished: recreating it is not
+                # ours to do — surface the error
+                raise
+            # the cached managed template went stale (deleted out-of-band):
+            # drop the cache and retry ONCE (reference instance.go:94-98)
+            log.debug("stale launch template for %s; recreating", claim.name)
+            self.launch_templates.invalidate(node_class)
+            return self._launch(claim, node_class, types, capacity_type)
 
     def _launch(
         self,
@@ -132,8 +141,12 @@ class InstanceProvider:
             self.subnets.update_inflight_ips(chosen_subnets, [])
             raise InsufficientCapacityAggregateError([])
         template = templates[0] if templates else None
+        # fleet-level tags carry only POOL-level identity: claim-specific
+        # tags (Name, nodeclaim) would make merged batch requests lie about
+        # N-1 of the N instances (the reference's batcher hashes the whole
+        # CreateFleetInput, so only identical requests merge — here the
+        # claim tags are stamped per instance after launch instead)
         request = {
-            "hash": self._fleet_hash(template, capacity_type, overrides),
             "overrides": overrides,
             "capacity_type": capacity_type,
             "launch_template": template.name if template else "",
@@ -143,12 +156,17 @@ class InstanceProvider:
                 **self.base_tags,
                 **node_class.tags,
                 L.ANNOTATION_MANAGED_BY: "karpenter-tpu",
-                "karpenter.sh/nodeclaim": claim.name,
                 "karpenter.sh/nodepool": claim.pool_name,
-                "Name": claim.name,
             },
         }
-        instance, errors = self._fleet_batcher.call(request)
+        request["hash"] = self._fleet_hash(request)
+        try:
+            instance, errors = self._fleet_batcher.call(request)
+        except Exception:
+            # refund the in-flight IP reservation on any fleet failure
+            # (stale template, API error) so subnet accounting stays sound
+            self.subnets.update_inflight_ips(chosen_subnets, [])
+            raise
         # capacity-error feedback keeps failed pools masked for 3m
         # (reference instance.go:365-371)
         for err in errors:
@@ -161,6 +179,12 @@ class InstanceProvider:
             raise InsufficientCapacityAggregateError(
                 [e.pool for e in errors]
             )
+        # claim-specific attribution tags, stamped on THIS instance only
+        # (LinkController adoption and _instance_to_claim read these)
+        self.cloud.create_tags(
+            instance.id,
+            {"Name": claim.name, "karpenter.sh/nodeclaim": claim.name},
+        )
         return instance
 
     # -------------------------------------------------------- create helpers
@@ -264,11 +288,22 @@ class InstanceProvider:
         return out
 
     @staticmethod
-    def _fleet_hash(template, capacity_type: str, overrides: Sequence[dict]) -> tuple:
+    def _fleet_hash(request: dict) -> tuple:
+        """Bucket key covering the ENTIRE merged request — only requests
+        whose every field matches may coalesce (the reference hashes the
+        whole CreateFleetInput, createfleet.go:44-55)."""
         return (
-            template.name if template else "",
-            capacity_type,
-            tuple(sorted((o["instance_type"], o["zone"]) for o in overrides)),
+            request["launch_template"],
+            request["image_id"],
+            tuple(request["security_group_ids"]),
+            request["capacity_type"],
+            tuple(sorted(request["tags"].items())),
+            tuple(
+                sorted(
+                    (o["instance_type"], o["zone"], o["subnet_id"])
+                    for o in request["overrides"]
+                )
+            ),
         )
 
     # ----------------------------------------------------------- batch execs

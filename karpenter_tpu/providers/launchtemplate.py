@@ -4,8 +4,9 @@
 (image, max_pods) group and creates/caches templates by an options hash
 (launchtemplate.go:99-126,139-145).  A static template name on the node
 class bypasses resolution entirely (launchtemplate.go:104-107).  The cache
-maps hash -> template name so repeat launches skip template creation; cache
-eviction deletes the remote template (launchtemplate.go:340-357).
+maps hash -> template name; on start the cache is hydrated from the
+cloud-side template store (launchtemplate.go:323-339), and cache eviction
+deletes the remote template (launchtemplate.go:340-357).
 """
 
 from __future__ import annotations
@@ -13,14 +14,20 @@ from __future__ import annotations
 import hashlib
 import json
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 from karpenter_tpu.api import InstanceType, NodeClass, NodePool
+from karpenter_tpu.api import labels as L
 from karpenter_tpu.cache.ttl import DEFAULT_TTL, TTLCache
-from karpenter_tpu.cloud.fake.backend import FakeCloud
+from karpenter_tpu.cloud.fake.backend import FakeCloud, FakeLaunchTemplate
 from karpenter_tpu.providers.image import LaunchSpec, Resolver
 from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
 from karpenter_tpu.utils.clock import Clock
+
+# tag key recording the options hash on the remote template, so a restarted
+# controller can rebuild the hash -> name map (launchtemplate.go:323-339)
+OPTIONS_HASH_TAG = "karpenter.sh/options-hash"
+CLUSTER_TAG = "karpenter.sh/cluster"
 
 
 @dataclass
@@ -51,9 +58,23 @@ class LaunchTemplateProvider:
         self.security_groups = security_groups
         self.cluster_name = cluster_name
         self.cluster_endpoint = cluster_endpoint
-        self._cache = TTLCache(clock, DEFAULT_TTL)
-        self._created: Dict[str, str] = {}  # options hash -> template name
+        # options hash -> template name; expiry deletes the remote template
+        self._cache = TTLCache(clock, DEFAULT_TTL, on_evict=self._evict)
+        self.hydrate()
 
+    # ------------------------------------------------------------- hydration
+    def hydrate(self) -> None:
+        """Rebuild the cache from cloud-side templates tagged for this
+        cluster, so repeat launches after a restart reuse templates instead
+        of recreating them (launchtemplate.go:323-339)."""
+        for lt in self.cloud.describe_launch_templates(
+            tag_filters={CLUSTER_TAG: self.cluster_name or "*"}
+        ):
+            h = lt.tags.get(OPTIONS_HASH_TAG)
+            if h:
+                self._cache.set(h, lt.name)
+
+    # ------------------------------------------------------------ ensure_all
     def ensure_all(
         self,
         node_class: NodeClass,
@@ -61,7 +82,12 @@ class LaunchTemplateProvider:
         instance_types: Sequence[InstanceType],
     ) -> List[LaunchTemplate]:
         """One launch template per (image, max_pods) group covering the
-        requested instance types (launchtemplate.go:99-126)."""
+        requested instance types (launchtemplate.go:99-126).  A static
+        `launch_template_name` on the node class bypasses resolution
+        (launchtemplate.go:104-107) — the user owns that template."""
+        self._cache.purge_expired()
+        if node_class.launch_template_name:
+            return [self._static(node_class, list(instance_types))]
         sg_ids = [g.id for g in self.security_groups.list(node_class)]
         specs = self.resolver.resolve(
             node_class,
@@ -73,10 +99,25 @@ class LaunchTemplateProvider:
         out: List[LaunchTemplate] = []
         for spec in specs:
             h = self._options_hash(node_class, spec, sg_ids)
-            name = self._created.get(h)
-            if name is None:
+            name = self._cache.get(h)
+            if name is not None:
+                self._cache.touch(h)  # keep hot templates alive
+            else:
                 name = f"lt-{h}"
-                self._created[h] = name
+                self.cloud.create_launch_template(
+                    FakeLaunchTemplate(
+                        name=name,
+                        image_id=spec.image_id,
+                        security_group_ids=list(sg_ids),
+                        user_data=spec.user_data,
+                        tags={
+                            CLUSTER_TAG: self.cluster_name,
+                            OPTIONS_HASH_TAG: h,
+                            L.ANNOTATION_MANAGED_BY: "karpenter-tpu",
+                        },
+                    )
+                )
+                self._cache.set(h, name)
             out.append(
                 LaunchTemplate(
                     name=name,
@@ -88,6 +129,21 @@ class LaunchTemplateProvider:
                 )
             )
         return out
+
+    def _static(
+        self, node_class: NodeClass, instance_types: List[InstanceType]
+    ) -> LaunchTemplate:
+        """User-owned template: pass through by name; image/SGs come from
+        the template itself at launch time."""
+        lt = self.cloud.launch_templates.get(node_class.launch_template_name)
+        return LaunchTemplate(
+            name=node_class.launch_template_name,
+            image_id=lt.image_id if lt else "",
+            security_group_ids=list(lt.security_group_ids) if lt else [],
+            user_data=lt.user_data if lt else "",
+            instance_types=instance_types,
+            static=True,
+        )
 
     @staticmethod
     def _options_hash(
@@ -106,9 +162,18 @@ class LaunchTemplateProvider:
             json.dumps(payload, sort_keys=True).encode()
         ).hexdigest()[:12]
 
+    # ------------------------------------------------------------- eviction
+    def _evict(self, _hash: str, name: str) -> None:
+        """Cache eviction deletes the remote template
+        (launchtemplate.go:340-357)."""
+        self.cloud.delete_launch_template(name)
+
     def invalidate(self, node_class: Optional[NodeClass] = None) -> None:
-        """Drop cached templates (e.g. after node-class drift) so the next
-        launch re-resolves; mirrors cache eviction at
-        launchtemplate.go:340-357."""
-        self._created.clear()
-        self._cache.flush()
+        """Drop cached templates (e.g. after node-class drift or a stale
+        launch-template error) so the next launch re-resolves; the remote
+        templates are deleted like any other eviction."""
+        for h in list(self._cache.keys()):
+            name = self._cache.get(h)
+            self._cache.delete(h)
+            if name is not None:
+                self._evict(h, name)

@@ -66,8 +66,35 @@ class TensorScheduler:
         # Invalidation is identity-based: the instance-type provider returns
         # a NEW list object whenever inventory or the ICE cache changes, so
         # the cache key captures the object identities of every input.
+        # `_catalog_pins` holds strong references to every keyed object —
+        # CPython recycles ids only after GC, so pinning them makes the
+        # id-based key sound for the cache's whole lifetime.
         self._catalog_key: tuple = ()
         self._catalog = None
+        self._catalog_pins: tuple = ()
+
+    def update(
+        self,
+        pools: Sequence[NodePool],
+        instance_types: Dict[str, List[InstanceType]],
+        existing: Sequence[StateNode] = (),
+        daemonsets: Sequence[Pod] = (),
+        objective: str = "",
+    ) -> "TensorScheduler":
+        """Refresh per-solve inputs on a LONG-LIVED scheduler.
+
+        The catalog cache keys on the identities of pools/instance-type
+        lists/daemonsets, so a controller that holds one TensorScheduler
+        across reconciles (like the reference's long-lived provisioner over
+        its 5m-TTL instance-type cache) reuses the compiled catalog whenever
+        the provider returns the same cached lists."""
+        self.pools = list(pools)
+        self.instance_types = instance_types
+        self.existing = list(existing)
+        self.daemonsets = list(daemonsets)
+        if objective:
+            self.objective = objective
+        return self
 
     # ------------------------------------------------------------------ solve
     def solve(self, pods: Iterable[Pod]) -> SchedulingResult:
@@ -88,6 +115,11 @@ class TensorScheduler:
                 self.pools, self.instance_types, self.daemonsets, axes
             )
             self._catalog_key = key
+            self._catalog_pins = (
+                tuple(self.pools),
+                tuple(self.instance_types.values()),
+                tuple(self.daemonsets),
+            )
         catalog = self._catalog
         prob = compile_problem(
             pods,
