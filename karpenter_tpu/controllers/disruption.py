@@ -15,14 +15,18 @@ v0.31 concepts/deprovisioning.md:14-24 ordering; designs/consolidation.md):
   replaced when they fit with one strictly-cheaper new node.  Multi-node
   consolidation deletes a whole candidate subset with a single (optional)
   replacement.  Spot nodes are delete-only (deprovisioning.md:83-110).
+- **replacement pre-spin**: a consolidation that needs a replacement
+  LAUNCHES it first, waits for it to register + initialize, and only then
+  cordons/deletes the candidates (deprovisioning.md:83-110 "Karpenter
+  launches the replacement and waits for it to become ready before
+  terminating"); a replacement that never comes up within the timeout is
+  rolled back and the candidates stay untouched.
 - **budgets**: pool.disruption.budgets caps concurrent disruptions per
   pool ("10%" or an absolute count).
 
 Every mechanism funnels into the termination controller's graceful
-cordon-and-drain; replacements launch through the provisioner's normal
-path once the evicted pods go pending.  Blockers (do-not-evict pods,
-already-disrupting nodes, pods without controllers) follow
-designs/consolidation.md:46-53.
+cordon-and-drain.  Blockers (do-not-evict pods, already-disrupting nodes,
+pods without controllers) follow designs/consolidation.md:46-53.
 """
 
 from __future__ import annotations
@@ -47,6 +51,22 @@ log = logging.getLogger(__name__)
 # how many top-ranked candidates multi-node consolidation considers per
 # pass (the reference bounds its subset search the same way)
 MULTI_NODE_CANDIDATES = 10
+
+# how long a consolidation replacement may take to register+initialize
+# before the action is rolled back (the reference's machine liveness bound
+# is 15m; consolidation aborts much sooner when validation fails)
+REPLACEMENT_TIMEOUT = 600.0
+
+
+@dataclass
+class _PendingReplacement:
+    """A launched-but-not-yet-ready consolidation replacement."""
+
+    claim_name: str
+    candidate_names: List[str]  # claims to delete once the replacement is up
+    pod_keys: List[str]  # pods the SIMULATION placed on the replacement
+    created_at: float
+    reason: str
 
 
 @dataclass
@@ -88,6 +108,9 @@ class DisruptionController:
         # long-lived simulation scheduler (catalog cache shared across
         # candidate evaluations and reconciles)
         self._scheduler = TensorScheduler([], {}, objective="cost")
+        # replacement pre-spin state
+        self._pending: Dict[str, _PendingReplacement] = {}
+        self._nominate_later: Dict[str, str] = {}  # pod key -> target node
 
     # ------------------------------------------------------------- reconcile
     def reconcile(self) -> None:
@@ -97,15 +120,191 @@ class DisruptionController:
         with self.registry.time(
             "karpenter_deprovisioning_evaluation_duration_seconds"
         ):
+            self._nominate_evicted()
+            self._reap_replacements()
             self._budgets = self._remaining_budgets()
-            candidates = self._candidates()
+            reserved = {
+                name
+                for pr in self._pending.values()
+                for name in pr.candidate_names
+            }
+            candidates = [
+                c for c in self._candidates() if c.claim.name not in reserved
+            ]
             if self._expire(candidates):
                 return
             if self.feature_gate_drift and self._drift(candidates):
                 return
             if self._emptiness(candidates):
                 return
-            self._consolidate(candidates)
+            if not self._pending:  # one replacement in flight at a time
+                self._consolidate(candidates)
+
+    # ------------------------------------------------- replacement pre-spin
+    def _nominate_evicted(self) -> None:
+        """Steer pods evicted off consolidated candidates onto their
+        replacement node as soon as they re-pend.  Eviction happens
+        asynchronously in the termination controller and can stall on PDBs,
+        so a pod still bound to a DRAINING candidate stays in the ledger."""
+        for pod_key, (target, cand_names) in list(self._nominate_later.items()):
+            pod = self.kube.pods.get(pod_key)
+            if pod is None:
+                self._nominate_later.pop(pod_key, None)
+                continue
+            if pod.node_name:
+                if pod.node_name in cand_names:
+                    continue  # still draining (e.g. PDB-blocked); keep waiting
+                # rebound somewhere else already
+                self._nominate_later.pop(pod_key, None)
+                continue
+            if target not in self.kube.node_claims and (
+                self.kube.nodes.get(target) is None
+            ):
+                self._nominate_later.pop(pod_key, None)
+                continue
+            self.cluster.nominate(pod_key, target)
+            self._nominate_later.pop(pod_key, None)
+
+    def _reap_replacements(self) -> None:
+        """Progress in-flight replacements: ready -> delete the candidates;
+        timed out / vanished -> roll back and keep the candidates."""
+        for name, pr in list(self._pending.items()):
+            claim = self.kube.node_claims.get(name)
+            if claim is None or claim.deleted_at is not None:
+                # replacement died; abort the action, free the candidates
+                self._uncordon_candidates(pr)
+                self._pending.pop(name)
+                continue
+            if claim.registered and claim.initialized:
+                cand_names = tuple(pr.candidate_names)
+                for cand_name in pr.candidate_names:
+                    cand = self.kube.node_claims.get(cand_name)
+                    if cand is not None:
+                        self.termination.mark_for_deletion(
+                            cand, reason=pr.reason
+                        )
+                for pk in pr.pod_keys:
+                    self._nominate_later[pk] = (claim.name, cand_names)
+                self._pending.pop(name)
+                continue
+            if self.clock.now() - pr.created_at > REPLACEMENT_TIMEOUT:
+                # rollback: the replacement never came up; terminate it,
+                # un-cordon the candidates, leave them untouched
+                log.warning(
+                    "consolidation replacement %s timed out; rolling back",
+                    name,
+                )
+                self.kube.record_event(
+                    "NodeClaim", "ReplacementTimeout", name, pr.reason
+                )
+                self.registry.inc(
+                    "karpenter_deprovisioning_replacement_failed",
+                    {"reason": "timeout"},
+                )
+                self.termination.mark_for_deletion(
+                    claim, reason="consolidation/rollback"
+                )
+                self._uncordon_candidates(pr)
+                self._pending.pop(name)
+
+    def _launch_replacement(
+        self, cands: Sequence[Candidate], vnode, reason: str
+    ) -> bool:
+        """Launch the simulation's replacement node BEFORE disrupting the
+        candidates (deprovisioning.md:83-110)."""
+        from karpenter_tpu.controllers.provisioning import claim_from_vnode
+
+        # check-and-consume budget per candidate (all-or-nothing)
+        taken: List[str] = []
+        for c in cands:
+            b = self._budgets.get(c.pool.name, 1)
+            if b <= 0:
+                for pname in taken:
+                    self._budgets[pname] += 1
+                return False
+            self._budgets[c.pool.name] = b - 1
+            taken.append(c.pool.name)
+        # pool limits: during the pre-spin overlap the replacement ADDS to
+        # pool usage, so the projection must stay inside pool.limits — the
+        # same admission the provisioner applies (designs/limits.md)
+        pool = vnode.pool
+        if pool.limits and not pool.limits.is_zero():
+            it = next(iter(vnode.final_instance_types()), None)
+            estimate = it.capacity if it is not None else vnode.used
+            if (self.cluster.pool_usage(pool.name) + estimate).exceeds(
+                pool.limits
+            ):
+                for pname in taken:
+                    self._budgets[pname] += 1
+                self.kube.record_event(
+                    "NodePool", "LimitExceeded", pool.name,
+                    "replacement deferred: pool at its limits",
+                )
+                return False
+        claim = claim_from_vnode(vnode)
+        try:
+            self.cloud_provider.create(claim)
+        except Exception as exc:
+            log.warning("replacement launch failed: %s", exc)
+            self.kube.record_event(
+                "NodeClaim", "ReplacementLaunchFailed", claim.name, str(exc)
+            )
+            for pname in taken:
+                self._budgets[pname] += 1
+            return False
+        self.kube.put_node_claim(claim)
+        # cordon the candidates so nothing new lands on capacity that is
+        # about to disappear (the reference taints karpenter.sh/disruption
+        # before waiting on the replacement)
+        for c in cands:
+            self._cordon_candidate(c.claim)
+        self.registry.inc(
+            "karpenter_deprovisioning_actions",
+            {"mechanism": "consolidation", "nodepool": cands[0].pool.name},
+        )
+        self._pending[claim.name] = _PendingReplacement(
+            claim_name=claim.name,
+            candidate_names=[c.claim.name for c in cands],
+            pod_keys=[p.key() for p in vnode.pods],
+            created_at=self.clock.now(),
+            reason=reason,
+        )
+        return True
+
+    def _cordon_candidate(self, claim: NodeClaim) -> None:
+        node = (
+            self.kube.node_by_provider_id(claim.provider_id)
+            if claim.provider_id
+            else None
+        )
+        if node is not None and not node.cordoned:
+            node.cordoned = True
+            if not any(
+                t.key == L.TAINT_DISRUPTION_KEY for t in node.taints
+            ):
+                from karpenter_tpu.controllers.termination import (
+                    DISRUPTION_TAINT,
+                )
+
+                node.taints.append(DISRUPTION_TAINT)
+
+    def _uncordon_candidates(self, pr: _PendingReplacement) -> None:
+        for cand_name in pr.candidate_names:
+            claim = self.kube.node_claims.get(cand_name)
+            if claim is None or claim.deleted_at is not None:
+                continue
+            node = (
+                self.kube.node_by_provider_id(claim.provider_id)
+                if claim.provider_id
+                else None
+            )
+            if node is not None and node.deleted_at is None:
+                node.cordoned = False
+                node.taints = [
+                    t
+                    for t in node.taints
+                    if t.key != L.TAINT_DISRUPTION_KEY
+                ]
 
     # ------------------------------------------------------------ candidates
     def _candidates(self) -> List[Candidate]:
@@ -238,7 +437,7 @@ class DisruptionController:
         return True
 
     def _consolidate_single(self, c: Candidate) -> bool:
-        fits, replacement_price = self._simulate([c])
+        fits, replacement_price, vnode = self._simulate([c])
         if not fits:
             return False
         if replacement_price == 0.0:
@@ -248,7 +447,7 @@ class DisruptionController:
         if c.claim.capacity_type == L.CAPACITY_TYPE_SPOT:
             return False
         if replacement_price < c.price:
-            return self._disrupt(c, "consolidation/replace")
+            return self._launch_replacement([c], vnode, "consolidation/replace")
         return False
 
     def _consolidate_multi(self, ranked: Sequence[Candidate]) -> bool:
@@ -256,10 +455,12 @@ class DisruptionController:
         the remaining nodes plus at most one cheaper replacement
         (designs/consolidation.md mechanisms:5-21)."""
         best: Optional[List[Candidate]] = None
+        best_vnode = None
+        best_price = 0.0
         pool = list(ranked[:MULTI_NODE_CANDIDATES])
         for size in range(len(pool), 1, -1):
             subset = pool[:size]
-            fits, replacement_price = self._simulate(subset)
+            fits, replacement_price, vnode = self._simulate(subset)
             if not fits:
                 continue
             combined = sum(c.price for c in subset)
@@ -269,9 +470,13 @@ class DisruptionController:
                 continue
             if replacement_price < combined:
                 best = subset
+                best_vnode = vnode
+                best_price = replacement_price
                 break
         if best is None:
             return False
+        if best_price > 0 and best_vnode is not None:
+            return self._launch_replacement(best, best_vnode, "consolidation/multi")
         acted = False
         for c in best:
             if self._disrupt(c, "consolidation/multi"):
@@ -280,14 +485,14 @@ class DisruptionController:
 
     def _simulate(
         self, removed: Sequence[Candidate]
-    ) -> Tuple[bool, float]:
+    ) -> Tuple[bool, float, Optional[object]]:
         """Scheduling simulation: do the removed nodes' pods fit on the
         remaining capacity plus at most ONE new (cheaper) node?
 
-        Returns (fits, replacement_price) — replacement_price 0.0 means
-        pure deletion suffices.  Reuses the tensor solver with the
-        candidate nodes excluded from the snapshot (the same kernel the
-        provisioner uses; SURVEY §7 step 7)."""
+        Returns (fits, replacement_price, replacement_vnode) —
+        replacement_price 0.0 means pure deletion suffices.  Reuses the
+        tensor solver with the candidate nodes excluded from the snapshot
+        (the same kernel the provisioner uses; SURVEY §7 step 7)."""
         removed_names = {c.state.name for c in removed}
         remaining = [
             sn
@@ -296,7 +501,7 @@ class DisruptionController:
         ]
         pods = [p for c in removed for p in c.reschedulable]
         if not pods:
-            return True, 0.0
+            return True, 0.0, None
         pools = [p for p in self.kube.node_pools.values() if not p.deleted]
         inventory = {
             pool.name: self.cloud_provider.get_instance_types(pool)
@@ -310,12 +515,13 @@ class DisruptionController:
         )
         result = scheduler.solve(pods)
         if result.unschedulable:
-            return False, 0.0
+            return False, 0.0, None
         if len(result.new_nodes) == 0:
-            return True, 0.0
+            return True, 0.0, None
         if len(result.new_nodes) > 1:
-            return False, 0.0
-        return True, result.new_nodes[0].cheapest_price()
+            return False, 0.0, None
+        vn = result.new_nodes[0]
+        return True, vn.cheapest_price(), vn
 
     # ---------------------------------------------------------------- action
     def _disrupt(self, c: Candidate, reason: str) -> bool:

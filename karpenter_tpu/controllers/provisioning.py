@@ -229,28 +229,35 @@ class Provisioner:
 
     # ------------------------------------------------------------- claim gen
     def _claim_from_vnode(self, vn: VirtualNode) -> NodeClaim:
-        pool = vn.pool
-        reqs = Requirements(iter(vn.requirements))
-        # constrain to the vnode's feasible types, price-ascending, top-60
-        # truncation happens in the instance provider
-        from karpenter_tpu.api.requirements import Op, Requirement
-
-        type_names = [t.name for t in vn.final_instance_types()]
-        if type_names:
-            reqs.add(Requirement(L.LABEL_INSTANCE_TYPE, Op.IN, type_names))
-        return NodeClaim(
-            pool_name=pool.name,
-            node_class_ref=pool.node_class_ref,
-            requirements=reqs,
-            requests=vn.used,
-            taints=list(pool.taints),
-            startup_taints=list(pool.startup_taints),
-            labels={**pool.labels, L.LABEL_NODEPOOL: pool.name},
-            annotations=dict(pool.annotations),
-            kubelet_max_pods=pool.kubelet_max_pods,
-        )
+        return claim_from_vnode(vn)
 
     @staticmethod
     def _claim_capacity_estimate(vn: VirtualNode) -> Resources:
         it = next(iter(vn.final_instance_types()), None)
         return it.capacity if it is not None else vn.used
+
+
+def claim_from_vnode(vn: VirtualNode) -> NodeClaim:
+    """Virtual node -> NodeClaim handshake object (the launch request the
+    CloudProvider consumes; reference cloudprovider.go:94-120).  Used by the
+    provisioner and by consolidation's replacement pre-spin."""
+    from karpenter_tpu.api.requirements import Op, Requirement
+
+    pool = vn.pool
+    reqs = Requirements(iter(vn.requirements))
+    # constrain to the vnode's feasible types, price-ascending; top-60
+    # truncation happens in the instance provider
+    type_names = [t.name for t in vn.final_instance_types()]
+    if type_names:
+        reqs.add(Requirement(L.LABEL_INSTANCE_TYPE, Op.IN, type_names))
+    return NodeClaim(
+        pool_name=pool.name,
+        node_class_ref=pool.node_class_ref,
+        requirements=reqs,
+        requests=vn.used,
+        taints=list(pool.taints),
+        startup_taints=list(pool.startup_taints),
+        labels={**pool.labels, L.LABEL_NODEPOOL: pool.name},
+        annotations=dict(pool.annotations),
+        kubelet_max_pods=pool.kubelet_max_pods,
+    )

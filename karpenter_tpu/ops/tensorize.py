@@ -224,9 +224,11 @@ class ClassMeta:
     pods: List[Pod]
     requests: Resources
     signature: Tuple
-    zone_pin: str = ""  # non-empty when the class was split by zone spread
+    zone_pin: str = ""  # non-empty when zone-split / affinity-anchored
     max_per_node: int = BIG
     track_slot: int = 0  # sig-count slot for anti-affinity/hostname-spread
+    infeasible: bool = False  # compile-time-proven unschedulable
+    unsched_reason: str = ""  # decode reason when infeasible
 
 
 @dataclass
@@ -264,39 +266,179 @@ class CompiledProblem:
 
 
 # ---------------------------------------------------------------------------
-# Support detection
+# Support detection + batch partitioning
 # ---------------------------------------------------------------------------
 
 
-def _unsupported_reason(pods: Sequence[Pod]) -> str:
-    """Constraint shapes the tensor kernel cannot express yet.
+def class_unsupported_reason(rep: Pod) -> str:
+    """Constraint shapes of a single class the tensor kernel cannot express.
 
-    Cross-class coupling (pod affinity; anti-affinity whose selector reaches
-    other pods) needs the anchoring logic of the oracle
-    (scheduling/topology.py); everything else compiles to masks.
+    Supported coupled shapes (compiled to masks/pins/splits):
+    - zone-keyed REQUIRED pod affinity -> compile-time domain anchoring
+      (the whole affinity component pins to one zone)
+    - self-selecting zone-keyed anti-affinity -> per-zone singleton split
+    - self-selecting hostname anti-affinity -> max-1-per-node cap
+    - hostname/zone topology spread -> per-node caps / zone shares
+
+    Everything else (hostname affinity = same-node co-location; exotic
+    topology keys) goes to the oracle half of a hybrid solve
+    (scheduling/solver.py).
     """
-    for p in pods:
-        for t in p.pod_affinity:
-            if not t.anti:
-                return "required pod affinity needs domain anchoring"
-            if t.topology_key != L.LABEL_HOSTNAME:
-                return f"anti-affinity on topology key {t.topology_key}"
-            if not t.selects(p):
-                return "anti-affinity selector reaching other pods"
-        for c in p.topology_spread:
-            if c.topology_key not in (L.LABEL_HOSTNAME, L.LABEL_ZONE):
-                return f"topology spread on key {c.topology_key}"
-    # anti-affinity selectors must not couple distinct classes
-    sigs: Dict[Tuple, Pod] = {}
-    for p in pods:
-        sigs.setdefault(p.constraint_signature(), p)
-    reps = list(sigs.values())
-    for a in reps:
-        for t in a.pod_affinity:
-            for b in reps:
-                if b.constraint_signature() != a.constraint_signature() and t.selects(b):
-                    return "anti-affinity coupling distinct pod classes"
+    has_zone_aff = False
+    has_zone_anti = False
+    for t in rep.pod_affinity:
+        if not t.anti:
+            if t.topology_key != L.LABEL_ZONE:
+                return f"pod affinity on topology key {t.topology_key}"
+            has_zone_aff = True
+        elif t.topology_key == L.LABEL_HOSTNAME:
+            if not t.selects(rep):
+                return "hostname anti-affinity selector reaching other pods"
+        elif t.topology_key == L.LABEL_ZONE:
+            if not t.selects(rep):
+                return "zone anti-affinity selector reaching other pods"
+            has_zone_anti = True
+        else:
+            return f"anti-affinity on topology key {t.topology_key}"
+    zone_spread = any(
+        c.topology_key == L.LABEL_ZONE
+        and c.selects(rep)
+        and c.when_unsatisfiable == "DoNotSchedule"
+        for c in rep.topology_spread
+    )
+    if has_zone_aff and (zone_spread or has_zone_anti):
+        return "zone affinity combined with another zone constraint"
+    if has_zone_anti and zone_spread:
+        return "zone anti-affinity combined with zone spread"
+    for c in rep.topology_spread:
+        if c.topology_key not in (L.LABEL_HOSTNAME, L.LABEL_ZONE):
+            return f"topology spread on key {c.topology_key}"
     return ""
+
+
+def _class_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
+    groups: Dict[Tuple, List[Pod]] = {}
+    for p in pods:
+        groups.setdefault((p.constraint_signature(), p.requests), []).append(p)
+    return list(groups.items())
+
+
+def _couples(a: Pod, b: Pod) -> bool:
+    """Any selector of `a` (affinity term or spread constraint) selects `b`."""
+    return any(t.selects(b) for t in a.pod_affinity) or any(
+        c.selects(b) for c in a.topology_spread
+    )
+
+
+def partition_pods(
+    pods: Sequence[Pod],
+) -> Tuple[List[Pod], List[Pod], str]:
+    """Split a batch into (tensor-solvable, oracle-only, reason).
+
+    A class is oracle-only when its own constraint shape is unsupported,
+    when an anti-affinity term couples it to a DIFFERENT class, or —
+    transitively — when any selector couples it (either direction) to an
+    oracle-only class.  The transitive closure guarantees the two halves
+    share no constraint groups, so solving them sequentially (tensor first,
+    oracle continuing on the tensor result) is sound: the only interaction
+    left is capacity, which the oracle sees exactly.
+    """
+    group_list = _class_groups(pods)
+    n = len(group_list)
+    reps = [members[0] for _, members in group_list]
+    sigs = [sig for (sig, _), _ in group_list]
+    reasons = [class_unsupported_reason(r) for r in reps]
+    # only classes carrying selectors can couple anything — the pairwise
+    # passes iterate those few, not all O(n^2) pairs (n ~ 500 at 10k pods).
+    # Groups sharing a SIGNATURE (same constraints, different requests) are
+    # not "distinct classes": the kernel tracks them through one shared
+    # per-signature counter slot, so only cross-SIG coupling needs the
+    # oracle.  Exception: zone anti-affinity's per-zone singleton split is
+    # per (sig, requests) group, so a sig spanning several request groups
+    # cannot share its <=1-per-zone cap on the tensor path.
+    sel_idx = [
+        i for i, r in enumerate(reps) if r.pod_affinity or r.topology_spread
+    ]
+    sig_groups: Dict[Tuple, int] = {}
+    for s in sigs:
+        sig_groups[s] = sig_groups.get(s, 0) + 1
+    for i in sel_idx:
+        rep = reps[i]
+        if sig_groups[sigs[i]] > 1 and any(
+            t.anti and t.topology_key == L.LABEL_ZONE for t in rep.pod_affinity
+        ):
+            reasons[i] = reasons[i] or (
+                "zone anti-affinity across multiple resource classes"
+            )
+        for t in rep.pod_affinity:
+            if not t.anti:
+                continue
+            for j, b in enumerate(reps):
+                if sigs[j] != sigs[i] and t.selects(b):
+                    why = "anti-affinity coupling distinct pod classes"
+                    reasons[i] = reasons[i] or why
+                    reasons[j] = reasons[j] or why
+        for c in rep.topology_spread:
+            for j, b in enumerate(reps):
+                if sigs[j] != sigs[i] and c.selects(b):
+                    # the spread group counts another class's pods; the
+                    # kernel's per-signature counters can't see them
+                    why = "topology spread coupling distinct pod classes"
+                    reasons[i] = reasons[i] or why
+                    reasons[j] = reasons[j] or why
+        for t in rep.pod_affinity:
+            if t.anti or t.topology_key != L.LABEL_ZONE:
+                continue
+            for j, b in enumerate(reps):
+                if sigs[j] == sigs[i] or not t.selects(b):
+                    continue
+                # anchoring pins the whole component to one zone, which is
+                # only sound when the selected class has no zone-keyed
+                # constraint of its own to honor (its own zone AFFINITY
+                # merges into the same component and is fine)
+                if any(
+                    c.topology_key == L.LABEL_ZONE
+                    and c.when_unsatisfiable == "DoNotSchedule"
+                    and c.selects(b)
+                    for c in b.topology_spread
+                ) or any(
+                    tt.anti and tt.topology_key == L.LABEL_ZONE
+                    for tt in b.pod_affinity
+                ):
+                    why = "zone affinity coupling a zone-constrained class"
+                    reasons[i] = reasons[i] or why
+                    reasons[j] = reasons[j] or why
+    # transitive closure over selector coupling (either direction)
+    edges: Dict[int, set] = {}
+    for i in sel_idx:
+        for j in range(n):
+            if i != j and _couples(reps[i], reps[j]):
+                edges.setdefault(i, set()).add(j)
+                edges.setdefault(j, set()).add(i)
+    frontier = [i for i in range(n) if reasons[i]]
+    while frontier:
+        i = frontier.pop()
+        for j in edges.get(i, ()):
+            if not reasons[j]:
+                reasons[j] = "coupled to an oracle-only pod class"
+                frontier.append(j)
+    supported: List[Pod] = []
+    unsupported: List[Pod] = []
+    why = ""
+    for i, (_, members) in enumerate(group_list):
+        if reasons[i]:
+            unsupported.extend(members)
+            why = why or reasons[i]
+        else:
+            supported.extend(members)
+    return supported, unsupported, why
+
+
+def _unsupported_reason(pods: Sequence[Pod]) -> str:
+    """Whole-batch gate used by `compile_problem`: non-empty when ANY pod
+    needs the oracle (callers that cannot hybrid-split fall back whole)."""
+    _, unsupported, why = partition_pods(pods)
+    return why if unsupported else ""
 
 
 # ---------------------------------------------------------------------------
@@ -355,17 +497,20 @@ def compile_problem(
     existing: Sequence[StateNode] = (),
     daemonsets: Sequence[Pod] = (),
     catalog: Optional[Catalog] = None,
+    presplit: bool = False,
 ) -> CompiledProblem:
     """Compile one scheduling problem to tensors.
 
     Pass a prebuilt ``catalog`` (from `build_catalog`) to skip re-deriving
     the launchable config rows — valid as long as the (pools,
     instance-types, daemonsets) snapshot is unchanged and the pods
-    introduce no new extended-resource axes.
+    introduce no new extended-resource axes.  ``presplit=True`` promises
+    the caller already ran `partition_pods` and kept only the supported
+    half, skipping the (pure-overhead) re-check on the hot path.
     """
     pods = list(pods)
     axes = _axes_for(pods)
-    reason = _unsupported_reason(pods)
+    reason = "" if presplit else _unsupported_reason(pods)
     if catalog is None or catalog.axes != axes:
         catalog = build_catalog(pools, instance_types, daemonsets, axes)
     pools = catalog.pools
@@ -404,19 +549,98 @@ def compile_problem(
 
     # ------------------------------------------------------------- classes
     all_zones = sorted(set(catalog.zones) | {sn.zone for sn in live if sn.zone})
-    groups: Dict[Tuple, List[Pod]] = {}
-    for p in pods:
-        groups.setdefault((p.constraint_signature(), p.requests), []).append(p)
+    group_list = _class_groups(pods)
+
+    # zone-keyed pod affinity: compile-time domain anchoring — each coupled
+    # component of classes pins to ONE zone (the oracle anchors the domain
+    # with the first matching placement; here the anchor is chosen up front
+    # from existing placements, zone requirements, and per-zone feasibility)
+    anchor_of = _anchor_zone_affinity(group_list, all_zones, catalog, pools, live)
 
     classes: List[ClassMeta] = []
     track_slots: Dict[Tuple, int] = {}
-    for (sig, requests), members in groups.items():
+    for gi, ((sig, requests), members) in enumerate(group_list):
         rep = members[0]
         maxper = _max_per_node(rep)
         slot = 0
         if maxper < BIG:
             slot = track_slots.setdefault(sig, len(track_slots) + 1)
-        if _zone_spread_zones(rep) and len(all_zones) > 1:
+        if gi in anchor_of:
+            zone = anchor_of[gi]
+            if zone is None:
+                classes.append(
+                    ClassMeta(
+                        pods=members,
+                        requests=requests,
+                        signature=sig,
+                        infeasible=True,
+                        unsched_reason=(
+                            "pod affinity has no admissible zone domain"
+                        ),
+                    )
+                )
+            else:
+                classes.append(
+                    ClassMeta(
+                        pods=members,
+                        requests=requests,
+                        signature=sig,
+                        zone_pin=zone,
+                        max_per_node=maxper,
+                        track_slot=slot,
+                    )
+                )
+        elif any(
+            t.anti and t.topology_key == L.LABEL_ZONE and t.selects(rep)
+            for t in rep.pod_affinity
+        ):
+            # self-selecting zone anti-affinity: at most one matching pod per
+            # zone -> one singleton class per remaining zone domain, pinned;
+            # zones already holding a matching pod are off-limits
+            terms = [
+                t
+                for t in rep.pod_affinity
+                if t.anti and t.topology_key == L.LABEL_ZONE
+            ]
+            excluded = {
+                sn.zone
+                for sn in live
+                if sn.zone
+                and any(t.selects(bp) for t in terms for bp in sn.pods)
+            }
+            zr = rep.scheduling_requirements().get(L.LABEL_ZONE)
+            allowed = [
+                z
+                for z in all_zones
+                if z not in excluded and (zr is None or zr.has(z))
+            ]
+            feasz = _feasible_zones(rep, catalog, pools, live, requests)
+            allowed.sort(key=lambda z: (z not in feasz, z))
+            for i, m in enumerate(members[: len(allowed)]):
+                classes.append(
+                    ClassMeta(
+                        pods=[m],
+                        requests=requests,
+                        signature=sig,
+                        zone_pin=allowed[i],
+                        max_per_node=maxper,
+                        track_slot=slot,
+                    )
+                )
+            extra = members[len(allowed):]
+            if extra:
+                classes.append(
+                    ClassMeta(
+                        pods=extra,
+                        requests=requests,
+                        signature=sig,
+                        infeasible=True,
+                        unsched_reason=(
+                            "zone anti-affinity: no remaining zone domain"
+                        ),
+                    )
+                )
+        elif _zone_spread_zones(rep) and len(all_zones) > 1:
             # Split the class across zones, balancing against existing skew.
             # Candidate domains are filtered by the pod's own zone
             # requirements (Kubernetes counts skew only over nodes that
@@ -518,6 +742,8 @@ def compile_problem(
     feas = np.zeros((G, C), dtype=bool)
     classes_by_sig: Dict[Tuple, List[int]] = {}
     for g, cm in enumerate(classes):
+        if cm.infeasible:
+            continue  # proven unschedulable at compile time: row stays 0
         classes_by_sig.setdefault((cm.signature, cm.zone_pin), []).append(g)
 
     pools_by_name = {p.name: p for p in pools}
@@ -644,6 +870,98 @@ def _feasible_zones(
             if (sn.used + requests).fits(sn.allocatable):
                 zones.add(sn.zone)
     return zones
+
+
+def _anchor_zone_affinity(
+    group_list: List[Tuple[Tuple, List[Pod]]],
+    all_zones: Sequence[str],
+    catalog: Catalog,
+    pools: Sequence[NodePool],
+    live: Sequence[StateNode],
+) -> Dict[int, Optional[str]]:
+    """Choose one anchor zone per zone-affinity component.
+
+    Returns {group index -> zone} for every group in a component that
+    carries zone-keyed required pod affinity (None = no admissible zone,
+    i.e. compile-time unschedulable).  Components are the transitive
+    closure of "some affinity term selects the other class" — every class
+    in a component pins to the same zone, the compile-time-sound rendering
+    of the oracle's first-placement domain anchoring (scheduling.md:124-430
+    interPodAffinity semantics; scheduling/topology.py _AffinityGroup)."""
+    aff_terms: Dict[int, List] = {}
+    for gi, (_, members) in enumerate(group_list):
+        rep = members[0]
+        terms = [
+            t
+            for t in rep.pod_affinity
+            if not t.anti and t.topology_key == L.LABEL_ZONE
+        ]
+        if terms:
+            aff_terms[gi] = terms
+    if not aff_terms:
+        return {}
+
+    n = len(group_list)
+    reps = [members[0] for _, members in group_list]
+    parent = list(range(n))
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a: int, b: int) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[rb] = ra
+
+    for gi, terms in aff_terms.items():
+        for t in terms:
+            for gj in range(n):
+                if gj != gi and t.selects(reps[gj]):
+                    union(gi, gj)
+
+    comps: Dict[int, List[int]] = {}
+    for gi in range(n):
+        comps.setdefault(find(gi), []).append(gi)
+
+    out: Dict[int, Optional[str]] = {}
+    for idxs in comps.values():
+        if not any(gi in aff_terms for gi in idxs):
+            continue
+        # candidates: intersection of every member's own zone requirements
+        cand = set(all_zones)
+        for gi in idxs:
+            zr = reps[gi].scheduling_requirements().get(L.LABEL_ZONE)
+            if zr is not None:
+                cand &= {z for z in all_zones if zr.has(z)}
+        # existing matching placements anchor the domain (followers must
+        # join the zone that already holds matching pods)
+        for gi in idxs:
+            for t in aff_terms.get(gi, ()):
+                dom = {
+                    sn.zone
+                    for sn in live
+                    if sn.zone and any(t.selects(bp) for bp in sn.pods)
+                }
+                if dom:
+                    cand &= dom
+        # prefer a zone feasible for every class in the component
+        feas = set(cand)
+        for gi in idxs:
+            feas &= _feasible_zones(
+                reps[gi], catalog, pools, live, group_list[gi][0][1]
+            )
+        if feas:
+            pick: Optional[str] = sorted(feas)[0]
+        elif cand:
+            pick = sorted(cand)[0]
+        else:
+            pick = None
+        for gi in idxs:
+            out[gi] = pick
+    return out
 
 
 def _balanced_split(n: int, existing_counts: Dict[str, int]) -> Dict[str, int]:

@@ -255,8 +255,14 @@ class Scheduler:
                 self.topology.record(pod, domains)
 
     # ------------------------------------------------------------------ solve
-    def solve(self, pods: Iterable[Pod]) -> SchedulingResult:
-        result = SchedulingResult()
+    def solve(
+        self, pods: Iterable[Pod], result: Optional[SchedulingResult] = None
+    ) -> SchedulingResult:
+        """Schedule `pods`; pass a pre-populated `result` to CONTINUE a
+        solve — its new_nodes participate as open virtual nodes (the hybrid
+        tensor+oracle path seeds the tensor half's placements this way)."""
+        if result is None:
+            result = SchedulingResult()
         for pod in sorted(pods, key=pod_sort_key):
             if self._schedule_existing(pod, result):
                 continue

@@ -29,6 +29,7 @@ from karpenter_tpu.ops.tensorize import (
     ConfigMeta,
     build_catalog,
     compile_problem,
+    partition_pods,
 )
 from karpenter_tpu.scheduling.scheduler import (
     Scheduler,
@@ -98,9 +99,25 @@ class TensorScheduler:
 
     # ------------------------------------------------------------------ solve
     def solve(self, pods: Iterable[Pod]) -> SchedulingResult:
+        """Solve a batch: tensor path for everything the kernel expresses,
+        oracle CONTINUATION for the remainder (hybrid).  One pod with an
+        exotic constraint no longer sends the whole 10k-pod batch to the
+        O(pods x nodes) Python loop — only its coupled closure goes."""
+        pods = list(pods)
+        supported, unsupported, _reason = partition_pods(pods)
+        if not supported:
+            return self._oracle(pods)
+        result = self._solve_tensor(supported)
+        if result is None:  # tensor compile bailed; solve everything oracle
+            return self._oracle(pods)
+        if unsupported:
+            self.last_path = "hybrid"
+            result = self._oracle_continue(unsupported, supported, result)
+        return result
+
+    def _solve_tensor(self, pods: List[Pod]) -> Optional[SchedulingResult]:
         import jax
 
-        pods = list(pods)
         from karpenter_tpu.ops.tensorize import _axes_for
 
         axes = _axes_for(pods)
@@ -128,9 +145,10 @@ class TensorScheduler:
             existing=self.existing,
             daemonsets=self.daemonsets,
             catalog=catalog,
+            presplit=True,
         )
         if not prob.supported:
-            return self._oracle(pods)
+            return None
         self.last_path = "tensor"
         result = self.pack_fn(prob, objective=self.objective)
         # one transfer for everything decode needs (the device link may be
@@ -159,6 +177,50 @@ class TensorScheduler:
             daemonsets=self.daemonsets,
             zones=self.zones,
         ).solve(pods)
+
+    def _oracle_continue(
+        self,
+        unsupported: List[Pod],
+        supported: List[Pod],
+        result: SchedulingResult,
+    ) -> SchedulingResult:
+        """Continue the tensor result with the oracle for the oracle-only
+        pods.  `partition_pods`'s transitive closure guarantees the two
+        halves share no constraint groups, so seeding the oracle with the
+        tensor half's placements (capacity + topology domains) makes the
+        sequential composition exact."""
+        from karpenter_tpu.scheduling.topology import HOSTNAME, ZONE
+
+        sch = Scheduler(
+            self.pools,
+            self.instance_types,
+            existing=self.existing,
+            daemonsets=self.daemonsets,
+            zones=self.zones,
+        )
+        by_key = {p.key(): p for p in supported}
+        en_by_name = {en.name: en for en in sch.existing}
+        for pod_key, node_name in result.existing_placements.items():
+            pod = by_key.get(pod_key)
+            en = en_by_name.get(node_name)
+            if pod is None or en is None:
+                continue
+            en.used = en.used + pod.requests
+            en.pods.append(pod)
+            domains = {HOSTNAME: node_name}
+            if en.state.zone:
+                domains[ZONE] = en.state.zone
+            sch.topology.record(pod, domains)
+        for vn in result.new_nodes:
+            sch.topology.universe.setdefault(HOSTNAME, set()).add(vn.name)
+            opts = vn.zone_options()
+            zone = next(iter(opts)) if len(opts) == 1 else None
+            for pod in vn.pods:
+                domains = {HOSTNAME: vn.name}
+                if zone:
+                    domains[ZONE] = zone
+                sch.topology.record(pod, domains)
+        return sch.solve(unsupported, result=result)
 
     # ------------------------------------------------------------- internals
     @staticmethod
@@ -261,6 +323,8 @@ class TensorScheduler:
 
     @staticmethod
     def _why_unschedulable(prob: CompiledProblem, g: int) -> str:
+        if prob.classes[g].unsched_reason:
+            return prob.classes[g].unsched_reason
         row = prob.feas[g]
         if not row.any():
             return "pod incompatible with every instance type / offering"
