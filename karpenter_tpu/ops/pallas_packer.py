@@ -40,8 +40,14 @@ import numpy as np
 from karpenter_tpu.ops.packer import PackResult, _bucket, node_slot_bound
 from karpenter_tpu.ops.tensorize import CompiledProblem
 
-S_MAX = 32  # max distinct (signature, zone-pin) rows the VMEM state holds
-T_MAX = 8  # max tracked anti-affinity counter rows
+# max distinct (signature, zone-pin) rows the VMEM state holds.  The
+# budget: sigfeas (S, C/128, 128) f32 + sig_ok (S, K/128, 128) f32 must fit
+# VMEM next to the residual state; at C=4096, K=1024 that is 4 MiB + 1 MiB
+# at S=256 — comfortably inside a v5e core's 16 MiB.  The update is a
+# masked broadcast over the whole S axis (no per-row loop), so raising this
+# costs VMEM, not compile time.
+S_MAX = 256
+T_MAX = 64  # max tracked anti-affinity counter rows
 R_FIX = 8  # fixed resource-axis width (padded)
 LANES = 128
 BIGF = float(2**30)
@@ -166,10 +172,18 @@ def _pack_step(
     take_i = take.astype(jnp.int32)
     npods_s[:] = npods_s[:] + take_i
     trk_s[pl.ds(tslot, 1)] = trk_s[pl.ds(tslot, 1)] + take_i[None]
-    n_sig = sigok_s.shape[0]
-    for s in range(n_sig):
-        sig_col_s = jnp.sum(sel * sigfeas_ref[s])
-        sigok_s[s] = jnp.where(wmask, sig_col_s, sigok_s[s])
+    # newly-opened slots adopt config c_star's admission column for EVERY
+    # signature at once: extract column c_star of sigfeas via the one-hot
+    # `sel` reduction, then a masked broadcast over (S, K) — no per-row
+    # loop, so the signature capacity S_MAX is a VMEM budget, not a compile
+    # budget.  All intermediates stay >=2-D (Mosaic's layout inference
+    # aborts on 1-D reshapes of 3-D reductions).
+    sig_col = jnp.sum(
+        jnp.sum(sigfeas_ref[:] * sel[None], axis=2), axis=1, keepdims=True
+    )  # (S, 1)
+    sigok_s[:] = jnp.where(
+        wmask[None], sig_col[:, :, None], sigok_s[:]
+    )
     nxt_s[0] = nxt + opened.astype(jnp.int32)
 
     take_ref[0] = take_i
@@ -378,18 +392,25 @@ def run_pack_pallas(
 # ~7us/step vs the scan's ~29us/step)
 PALLAS_MIN_CLASSES = 256
 
+# which kernel the last auto_pack dispatch ran ("pallas" | "scan") —
+# observability for the bench harness and the scheduler's metrics
+LAST_KERNEL = "scan"
+
 
 def auto_pack(
     prob: CompiledProblem, k_slots: int = 0, objective: str = "nodes"
 ) -> PackResult:
     """Backend dispatch: the fused Pallas kernel for large heterogeneous
     batches on real TPUs, the lax.scan kernel otherwise."""
+    global LAST_KERNEL
     if (
         len(prob.classes) >= PALLAS_MIN_CLASSES
         and supports(prob)
         and jax.devices()[0].platform == "tpu"
     ):
+        LAST_KERNEL = "pallas"
         return run_pack_pallas(prob, k_slots, objective)
     from karpenter_tpu.ops.packer import run_pack
 
+    LAST_KERNEL = "scan"
     return run_pack(prob, k_slots, objective)

@@ -61,7 +61,8 @@ class TensorScheduler:
         # the device half of the solve: local run_pack by default, or a
         # sidecar's RemoteSolver.pack_problem (service/client.py)
         self.pack_fn = pack_fn
-        self.last_path = ""  # "tensor" | "oracle" (observability)
+        self.last_path = ""  # "tensor" | "oracle" | "hybrid" (observability)
+        self.last_kernel = ""  # "pallas" | "scan" | "" (oracle)
         # Prebuilt config-axis tensors — the analogue of the reference's
         # seqnum-keyed instance-type cache (instancetype.go:97-104).
         # Invalidation is identity-based: the instance-type provider returns
@@ -151,6 +152,13 @@ class TensorScheduler:
             return None
         self.last_path = "tensor"
         result = self.pack_fn(prob, objective=self.objective)
+        from karpenter_tpu.ops import pallas_packer
+
+        self.last_kernel = (
+            pallas_packer.LAST_KERNEL
+            if self.pack_fn is auto_pack
+            else getattr(self.pack_fn, "kernel_name", "custom")
+        )
         # one transfer for everything decode needs (the device link may be
         # high-latency; per-array fetches would pay the round trip each)
         take, leftover, node_cfg, node_used = jax.device_get(
