@@ -110,7 +110,9 @@ class DisruptionController:
         self._scheduler = TensorScheduler([], {}, objective="cost")
         # replacement pre-spin state
         self._pending: Dict[str, _PendingReplacement] = {}
-        self._nominate_later: Dict[str, str] = {}  # pod key -> target node
+        # pod key -> (replacement claim name, names of the disrupted
+        # candidates it is draining off of)
+        self._nominate_later: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
 
     # ------------------------------------------------------------- reconcile
     def reconcile(self) -> None:
@@ -121,15 +123,31 @@ class DisruptionController:
             "karpenter_deprovisioning_evaluation_duration_seconds"
         ):
             self._nominate_evicted()
-            self._reap_replacements()
+            if self._reap_replacements():
+                # a replacement just became ready (or rolled back): let the
+                # candidate drain + pod rebinding settle before considering
+                # any further disruption — otherwise the just-ready, not-yet
+                # -populated replacement looks like an empty candidate and
+                # consolidation would delete the very node it pre-spun
+                return
             self._budgets = self._remaining_budgets()
             reserved = {
                 name
                 for pr in self._pending.values()
                 for name in pr.candidate_names
             }
+            # protect in-flight replacements until their nominated pods
+            # bind: the pre-spun claim itself, plus any node still the
+            # target of a pending nomination
+            protected = {pr.claim_name for pr in self._pending.values()}
+            protected |= {
+                target for target, _cands in self._nominate_later.values()
+            }
             candidates = [
-                c for c in self._candidates() if c.claim.name not in reserved
+                c
+                for c in self._candidates()
+                if c.claim.name not in reserved
+                and c.claim.name not in protected
             ]
             if self._expire(candidates):
                 return
@@ -137,8 +155,14 @@ class DisruptionController:
                 return
             if self._emptiness(candidates):
                 return
-            if not self._pending:  # one replacement in flight at a time
-                self._consolidate(candidates)
+            # consolidation only: a slow-registering replacement in pool A
+            # must not freeze consolidation in pool B (_launch_replacement
+            # enforces one in-flight replacement per TARGET pool), and a
+            # node holding in-flight pod nominations is not consolidatable
+            # (its usage is about to grow) — but it still expires/drifts
+            self._consolidate(
+                [c for c in candidates if not c.state.nominated]
+            )
 
     # ------------------------------------------------- replacement pre-spin
     def _nominate_evicted(self) -> None:
@@ -165,15 +189,19 @@ class DisruptionController:
             self.cluster.nominate(pod_key, target)
             self._nominate_later.pop(pod_key, None)
 
-    def _reap_replacements(self) -> None:
+    def _reap_replacements(self) -> bool:
         """Progress in-flight replacements: ready -> delete the candidates;
-        timed out / vanished -> roll back and keep the candidates."""
+        timed out / vanished -> roll back and keep the candidates.  Returns
+        True when any replacement was resolved this pass (the reconcile
+        stops there so the resulting evictions/rebinds settle first)."""
+        acted = False
         for name, pr in list(self._pending.items()):
             claim = self.kube.node_claims.get(name)
             if claim is None or claim.deleted_at is not None:
                 # replacement died; abort the action, free the candidates
                 self._uncordon_candidates(pr)
                 self._pending.pop(name)
+                acted = True
                 continue
             if claim.registered and claim.initialized:
                 cand_names = tuple(pr.candidate_names)
@@ -186,6 +214,7 @@ class DisruptionController:
                 for pk in pr.pod_keys:
                     self._nominate_later[pk] = (claim.name, cand_names)
                 self._pending.pop(name)
+                acted = True
                 continue
             if self.clock.now() - pr.created_at > REPLACEMENT_TIMEOUT:
                 # rollback: the replacement never came up; terminate it,
@@ -206,6 +235,8 @@ class DisruptionController:
                 )
                 self._uncordon_candidates(pr)
                 self._pending.pop(name)
+                acted = True
+        return acted
 
     def _launch_replacement(
         self, cands: Sequence[Candidate], vnode, reason: str
@@ -214,6 +245,17 @@ class DisruptionController:
         candidates (deprovisioning.md:83-110)."""
         from karpenter_tpu.controllers.provisioning import claim_from_vnode
 
+        # one replacement in flight per TARGET pool — keyed on where the
+        # replacement lands, not where the candidates live, so a cheapest
+        # -in-pool-A vnode for pool-B candidates still respects pool A's
+        # in-flight replacement
+        pending_pools = {
+            self.kube.node_claims[pr.claim_name].pool_name
+            for pr in self._pending.values()
+            if pr.claim_name in self.kube.node_claims
+        }
+        if vnode.pool.name in pending_pools:
+            return False
         # check-and-consume budget per candidate (all-or-nothing)
         taken: List[str] = []
         for c in cands:
@@ -494,10 +536,17 @@ class DisruptionController:
         tensor solver with the candidate nodes excluded from the snapshot
         (the same kernel the provisioner uses; SURVEY §7 step 7)."""
         removed_names = {c.state.name for c in removed}
+        # in-flight replacements (and nomination targets that haven't
+        # absorbed their pods yet) are spoken-for capacity — counting them
+        # as free would let a second action double-book them
+        spoken_for = {pr.claim_name for pr in self._pending.values()}
+        spoken_for |= {t for t, _c in self._nominate_later.values()}
         remaining = [
             sn
             for sn in self.cluster.snapshot()
-            if sn.name not in removed_names and not sn.marked_for_deletion()
+            if sn.name not in removed_names
+            and not sn.marked_for_deletion()
+            and sn.name not in spoken_for
         ]
         pods = [p for c in removed for p in c.reschedulable]
         if not pods:

@@ -109,15 +109,17 @@ class InstanceProvider:
         capacity_type = self._capacity_type(claim, types)
         try:
             return self._launch(claim, node_class, types, capacity_type)
-        except LaunchTemplateNotFoundError:
+        except LaunchTemplateNotFoundError as exc:
             if node_class.launch_template_name:
                 # user-owned static template vanished: recreating it is not
                 # ours to do — surface the error
                 raise
             # the cached managed template went stale (deleted out-of-band):
-            # drop the cache and retry ONCE (reference instance.go:94-98)
+            # drop ONLY that template and retry ONCE (instance.go:94-98);
+            # a blanket invalidation would break concurrent launches that
+            # are mid-flight against other, perfectly valid templates
             log.debug("stale launch template for %s; recreating", claim.name)
-            self.launch_templates.invalidate(node_class)
+            self.launch_templates.invalidate_template(exc.name)
             return self._launch(claim, node_class, types, capacity_type)
 
     def _launch(

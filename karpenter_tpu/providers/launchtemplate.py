@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -23,6 +24,8 @@ from karpenter_tpu.cloud.fake.backend import FakeCloud, FakeLaunchTemplate
 from karpenter_tpu.providers.image import LaunchSpec, Resolver
 from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
 from karpenter_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
 
 # tag key recording the options hash on the remote template, so a restarted
 # controller can rebuild the hash -> name map (launchtemplate.go:323-339)
@@ -58,6 +61,14 @@ class LaunchTemplateProvider:
         self.security_groups = security_groups
         self.cluster_name = cluster_name
         self.cluster_endpoint = cluster_endpoint
+        if not cluster_name:
+            # Settings.validate() makes this unreachable through the
+            # Operator; a directly-constructed anonymous provider cannot
+            # re-adopt its templates after restart, so they leak remotely
+            log.warning(
+                "launch-template provider has no cluster name: templates "
+                "created now cannot be re-owned after a restart"
+            )
         # options hash -> template name; expiry deletes the remote template
         self._cache = TTLCache(clock, DEFAULT_TTL, on_evict=self._evict)
         self.hydrate()
@@ -66,9 +77,15 @@ class LaunchTemplateProvider:
     def hydrate(self) -> None:
         """Rebuild the cache from cloud-side templates tagged for this
         cluster, so repeat launches after a restart reuse templates instead
-        of recreating them (launchtemplate.go:323-339)."""
+        of recreating them (launchtemplate.go:323-339).  Adoption requires
+        an EXACT cluster-tag match: with no cluster name configured there
+        is no safe ownership claim, so nothing is adopted (cache eviction
+        deletes remote templates — wildcard adoption would make this
+        provider delete other clusters' templates)."""
+        if not self.cluster_name:
+            return
         for lt in self.cloud.describe_launch_templates(
-            tag_filters={CLUSTER_TAG: self.cluster_name or "*"}
+            tag_filters={CLUSTER_TAG: self.cluster_name}
         ):
             h = lt.tags.get(OPTIONS_HASH_TAG)
             if h:
@@ -168,12 +185,24 @@ class LaunchTemplateProvider:
         (launchtemplate.go:340-357)."""
         self.cloud.delete_launch_template(name)
 
-    def invalidate(self, node_class: Optional[NodeClass] = None) -> None:
-        """Drop cached templates (e.g. after node-class drift or a stale
-        launch-template error) so the next launch re-resolves; the remote
-        templates are deleted like any other eviction."""
+    def invalidate(self) -> None:
+        """Drop every cached template (e.g. after node-class drift) so the
+        next launch re-resolves; the remote templates are deleted like any
+        other eviction."""
         for h in list(self._cache.keys()):
             name = self._cache.get(h)
             self._cache.delete(h)
             if name is not None:
                 self._evict(h, name)
+
+    def invalidate_template(self, name: str) -> None:
+        """Drop the cache entry for a template OBSERVED MISSING remotely
+        (the stale-launch-template retry): only the failing template is
+        re-resolved, so concurrent launches against other templates keep
+        their cache entries — and their single retry.  No remote delete:
+        the template is already gone, and a concurrent retry may have just
+        recreated it under the same deterministic name — deleting here
+        would tear down that fresh template and burn the peer's retry."""
+        for h in list(self._cache.keys()):
+            if self._cache.get(h) == name:
+                self._cache.delete(h)

@@ -170,3 +170,110 @@ class TestProvisionerErrorIsolation:
         assert env.registry.counter(
             "karpenter_nodeclaims_launch_failed", {"reason": "error"}
         ) >= 0  # no crash is the real assertion
+
+
+class TestHydrationOwnership:
+    def test_no_cluster_name_adopts_nothing(self, env, setup):
+        """With no cluster name there is no safe ownership claim: hydration
+        must not adopt (and later remote-delete) other clusters' templates
+        (eviction deletes remote, launchtemplate.go:340-357)."""
+        pool, nc = setup
+        env.cloud.create_launch_template(
+            FakeLaunchTemplate(
+                name="other-cluster-lt",
+                image_id="image-standard-amd64",
+                security_group_ids=["sg-default"],
+                user_data="#other",
+                tags={
+                    "karpenter.sh/cluster": "other",
+                    OPTIONS_HASH_TAG: "deadbeef0000",
+                },
+            )
+        )
+        from karpenter_tpu.providers.launchtemplate import LaunchTemplateProvider
+
+        anon = LaunchTemplateProvider(
+            env.cloud,
+            env.launch_templates.resolver,
+            env.security_groups,
+            env.clock,
+            cluster_name="",
+        )
+        assert len(anon._cache) == 0
+        env.clock.step(3600)
+        anon._cache.purge_expired()
+        assert "other-cluster-lt" in env.cloud.launch_templates
+
+    def test_exact_cluster_match_only(self, env, setup):
+        """Hydration adopts templates of THIS cluster only — a foreign
+        cluster's template must survive this provider's cache lifecycle."""
+        pool, nc = setup
+        env.cloud_provider.create(make_claim(pool))  # our own template
+        env.cloud.create_launch_template(
+            FakeLaunchTemplate(
+                name="other-cluster-lt",
+                image_id="image-standard-amd64",
+                security_group_ids=["sg-default"],
+                user_data="#other",
+                tags={
+                    "karpenter.sh/cluster": "other",
+                    OPTIONS_HASH_TAG: "deadbeef0000",
+                },
+            )
+        )
+        from karpenter_tpu.providers.launchtemplate import LaunchTemplateProvider
+
+        fresh = LaunchTemplateProvider(
+            env.cloud,
+            env.launch_templates.resolver,
+            env.security_groups,
+            env.clock,
+            cluster_name=env.launch_templates.cluster_name,
+        )
+        assert len(fresh._cache) >= 1
+        env.clock.step(3600)
+        fresh._cache.purge_expired()
+        # ours evicted+deleted; the foreign one untouched
+        assert "other-cluster-lt" in env.cloud.launch_templates
+
+
+class TestScopedInvalidation:
+    def test_invalidate_template_leaves_others(self, env, setup):
+        """The stale-template retry must drop only the template observed
+        missing; other cached templates (other node classes' in-flight
+        launches) keep their entries."""
+        pool, nc = setup
+        env.cloud_provider.create(make_claim(pool))
+        first = set(env.cloud.launch_templates)
+        # second node class with different user data -> a second template
+        from karpenter_tpu.api import NodeClass
+        from karpenter_tpu.api.objects import SelectorTerm
+
+        nc2 = NodeClass(
+            name="alt",
+            subnet_selector_terms=[SelectorTerm.of(Name="*")],
+            security_group_selector_terms=[SelectorTerm.of(Name="*")],
+            user_data="#alternate",
+        )
+        env.kube.put_node_class(nc2)
+        pool2 = env.default_node_pool(name="alt")
+        pool2.node_class_ref = "alt"
+        claim2 = make_claim(pool2)
+        claim2.node_class_ref = "alt"
+        env.cloud_provider.create(claim2)
+        second = set(env.cloud.launch_templates) - first
+        assert second, "expected a distinct second template"
+        # the stale template vanished out-of-band (that's why the retry
+        # path calls invalidate_template in the first place)
+        stale = next(iter(second))
+        env.cloud.launch_templates.pop(stale)
+        cached_before = set(env.launch_templates._cache.keys())
+        env.launch_templates.invalidate_template(stale)
+        # exactly one cache entry dropped; no remote deletes issued (the
+        # remote template is already gone — and a concurrent retry may
+        # have just recreated the same name)
+        assert len(cached_before) - len(set(env.launch_templates._cache.keys())) == 1
+        assert env.cloud.recorder.count("DeleteLaunchTemplate") == 0
+        # every other remote template (first launch's and the rest of the
+        # second's) is untouched
+        assert (first | second) - {stale} <= set(env.cloud.launch_templates)

@@ -61,8 +61,10 @@ class TestReplacementPreSpin:
         kept = _replace_scenario(env)
         before = set(env.kube.node_claims)
         env.kubelet.startup_delay = 6.0  # registration takes 3 ticks
-        for _ in range(30):
+        seen_claims = set(env.kube.node_claims)
+        for _ in range(70):
             env.step(2.0)
+            seen_claims |= set(env.kube.node_claims)
             pending = env.kube.pending_pods()
             if pending:
                 # a pod may only be pending while its replacement target
@@ -74,17 +76,16 @@ class TestReplacementPreSpin:
                     if name not in before and n.ready
                 ]
                 assert ready_new, "pods pending with no replacement up"
-            # candidates may only be deleted once a new claim launched
-            if set(env.kube.node_claims) - before:
-                break
-        for _ in range(40):
-            env.step(2.0)
             if not env.kube.pending_pods() and len(env.kube.node_claims) == 1:
                 break
         assert len(env.kube.node_claims) == 1
         (claim,) = env.kube.node_claims.values()
         assert claim.name not in before  # it IS the replacement
         assert not env.kube.pending_pods()
+        # exactly ONE replacement was ever launched: the just-ready
+        # replacement must never itself be consolidated away (which would
+        # force a third claim to cover the capacity gap)
+        assert len(seen_claims - before) == 1, seen_claims - before
         for p in kept:
             assert env.kube.pods[p.key()].node_name == claim.name
         # strictly cheaper
