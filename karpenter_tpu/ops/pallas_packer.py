@@ -162,6 +162,12 @@ def _pack_step(
     wmask = (kiota >= nxt) & (kiota < nxt + opened.astype(jnp.int32))
     offset = (kiota - nxt).astype(jnp.float32) * per_safe
     take2 = jnp.where(wmask, jnp.clip(n2 - offset, 0.0, per_safe), 0.0)
+    # f32 ceil-division can overshoot an exact quotient by one slot; a
+    # phantom zero-take slot would silently shift every later slot index
+    # away from the scan kernel's (which ceil-divides in exact ints).
+    # Masking on take2>0 makes the opened window exact.
+    wmask = wmask & (take2 > 0)
+    opened = jnp.sum(wmask.astype(jnp.float32))
     take = take1 + take2
 
     # ---- state updates --------------------------------------------------
@@ -389,7 +395,18 @@ def run_pack_pallas(
 
 # below this count the fused kernel's fixed launch cost outweighs its
 # per-step win over the scan kernel (measured on TPU v5e: ~20ms fixed,
-# ~7us/step vs the scan's ~29us/step)
+# ~7us/step vs the scan's ~29us/step).
+#
+# Caveat measured on the tunneled v5e used by the driver (round 3): the
+# axon remote runtime dispatches Mosaic custom calls asynchronously ONLY
+# until the first device->host transfer of the session; after any
+# `device_get` every pallas_call launch synchronizes with the host
+# (~90-100 ms, one tunnel round-trip), while pure-XLA executables keep
+# async dispatch.  A solver must fetch results, so on THAT runtime the
+# fused kernel carries a flat ~100 ms penalty the scan kernel does not.
+# This is a property of the tunnel, not the kernel: on directly-attached
+# TPUs D2H goes over PCIe and no such mode switch exists.  bench.py
+# reports the fused kernel and the scan kernel side by side.
 PALLAS_MIN_CLASSES = 256
 
 # which kernel the last auto_pack dispatch ran ("pallas" | "scan") —
