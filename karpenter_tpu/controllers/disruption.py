@@ -34,7 +34,7 @@ from __future__ import annotations
 import logging
 import math
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 from karpenter_tpu.api import NodeClaim, NodePool, Pod
 from karpenter_tpu.api import labels as L
@@ -67,6 +67,16 @@ class _PendingReplacement:
     pod_keys: List[str]  # pods the SIMULATION placed on the replacement
     created_at: float
     reason: str
+
+
+class _Nomination(NamedTuple):
+    """A pod evicted off a consolidated candidate, waiting to be steered
+    onto its replacement once it re-pends."""
+
+    target: str  # replacement claim/node name
+    candidate_names: Tuple[str, ...]  # nodes it is draining off of
+    since: float  # reap timestamp; entries age out (permanently PDB-blocked
+    # pods must not protect their target forever)
 
 
 @dataclass
@@ -110,9 +120,7 @@ class DisruptionController:
         self._scheduler = TensorScheduler([], {}, objective="cost")
         # replacement pre-spin state
         self._pending: Dict[str, _PendingReplacement] = {}
-        # pod key -> (replacement claim name, names of the disrupted
-        # candidates it is draining off of)
-        self._nominate_later: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+        self._nominate_later: Dict[str, _Nomination] = {}
 
     # ------------------------------------------------------------- reconcile
     def reconcile(self) -> None:
@@ -123,13 +131,14 @@ class DisruptionController:
             "karpenter_deprovisioning_evaluation_duration_seconds"
         ):
             self._nominate_evicted()
-            if self._reap_replacements():
-                # a replacement just became ready (or rolled back): let the
-                # candidate drain + pod rebinding settle before considering
-                # any further disruption — otherwise the just-ready, not-yet
-                # -populated replacement looks like an empty candidate and
-                # consolidation would delete the very node it pre-spun
-                return
+            # when a replacement just became ready (or rolled back), let the
+            # candidate drain + pod rebinding settle before CONSOLIDATING
+            # again — otherwise the just-ready, not-yet-populated
+            # replacement looks like an empty candidate and consolidation
+            # would delete the very node it pre-spun.  Expiration, drift and
+            # emptiness are not at risk (the replacement and nomination
+            # targets are in `protected`) and still run this pass.
+            reaped = self._reap_replacements()
             self._budgets = self._remaining_budgets()
             reserved = {
                 name
@@ -140,9 +149,7 @@ class DisruptionController:
             # bind: the pre-spun claim itself, plus any node still the
             # target of a pending nomination
             protected = {pr.claim_name for pr in self._pending.values()}
-            protected |= {
-                target for target, _cands in self._nominate_later.values()
-            }
+            protected |= {n.target for n in self._nominate_later.values()}
             candidates = [
                 c
                 for c in self._candidates()
@@ -154,6 +161,8 @@ class DisruptionController:
             if self.feature_gate_drift and self._drift(candidates):
                 return
             if self._emptiness(candidates):
+                return
+            if reaped:
                 return
             # consolidation only: a slow-registering replacement in pool A
             # must not freeze consolidation in pool B (_launch_replacement
@@ -170,30 +179,40 @@ class DisruptionController:
         replacement node as soon as they re-pend.  Eviction happens
         asynchronously in the termination controller and can stall on PDBs,
         so a pod still bound to a DRAINING candidate stays in the ledger."""
-        for pod_key, (target, cand_names) in list(self._nominate_later.items()):
+        now = self.clock.now()
+        for pod_key, nom in list(self._nominate_later.items()):
             pod = self.kube.pods.get(pod_key)
             if pod is None:
                 self._nominate_later.pop(pod_key, None)
                 continue
             if pod.node_name:
-                if pod.node_name in cand_names:
-                    continue  # still draining (e.g. PDB-blocked); keep waiting
+                if pod.node_name in nom.candidate_names:
+                    # still draining (e.g. PDB-blocked); keep waiting — but
+                    # not forever: a permanently blocked pod must not
+                    # protect its target / hide its capacity indefinitely.
+                    # The age-out applies ONLY while the pod is stuck on a
+                    # draining candidate, so a pod that finally drains
+                    # after the deadline is still nominated below.
+                    if now - nom.since > REPLACEMENT_TIMEOUT:
+                        self._nominate_later.pop(pod_key, None)
+                    continue
                 # rebound somewhere else already
                 self._nominate_later.pop(pod_key, None)
                 continue
-            if target not in self.kube.node_claims and (
-                self.kube.nodes.get(target) is None
+            if nom.target not in self.kube.node_claims and (
+                self.kube.nodes.get(nom.target) is None
             ):
                 self._nominate_later.pop(pod_key, None)
                 continue
-            self.cluster.nominate(pod_key, target)
+            self.cluster.nominate(pod_key, nom.target)
             self._nominate_later.pop(pod_key, None)
 
     def _reap_replacements(self) -> bool:
         """Progress in-flight replacements: ready -> delete the candidates;
         timed out / vanished -> roll back and keep the candidates.  Returns
         True when any replacement was resolved this pass (the reconcile
-        stops there so the resulting evictions/rebinds settle first)."""
+        then skips consolidation — only that mechanism — so the resulting
+        evictions/rebinds settle before the next subset search)."""
         acted = False
         for name, pr in list(self._pending.items()):
             claim = self.kube.node_claims.get(name)
@@ -211,8 +230,11 @@ class DisruptionController:
                         self.termination.mark_for_deletion(
                             cand, reason=pr.reason
                         )
+                now = self.clock.now()
                 for pk in pr.pod_keys:
-                    self._nominate_later[pk] = (claim.name, cand_names)
+                    self._nominate_later[pk] = _Nomination(
+                        claim.name, cand_names, now
+                    )
                 self._pending.pop(name)
                 acted = True
                 continue
@@ -540,7 +562,7 @@ class DisruptionController:
         # absorbed their pods yet) are spoken-for capacity — counting them
         # as free would let a second action double-book them
         spoken_for = {pr.claim_name for pr in self._pending.values()}
-        spoken_for |= {t for t, _c in self._nominate_later.values()}
+        spoken_for |= {n.target for n in self._nominate_later.values()}
         remaining = [
             sn
             for sn in self.cluster.snapshot()

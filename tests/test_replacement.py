@@ -91,6 +91,137 @@ class TestReplacementPreSpin:
         # strictly cheaper
         assert claim.price > 0
 
+    def test_nominate_later_entries_age_out(self, env):
+        """A pod that never drains off its candidate (e.g. permanently
+        PDB-blocked) must not protect its replacement target forever
+        (round-3 ADVICE): the nomination ledger entry ages out after the
+        replacement timeout."""
+        from karpenter_tpu.controllers.disruption import (
+            REPLACEMENT_TIMEOUT,
+            _Nomination,
+        )
+
+        env.default_node_class()
+        env.default_node_pool()
+        pod = Pod(requests=Resources(cpu=1))
+        env.kube.put_pod(pod)
+        env.settle()
+        node_name = env.kube.pods[pod.key()].node_name
+        assert node_name
+        dc = env.operator.disruption
+        # simulate a reaped replacement whose pod is stuck on the candidate
+        dc._nominate_later[pod.key()] = _Nomination(
+            "replacement-target", (node_name,), env.clock.now()
+        )
+        env.step(1.0)
+        # still bound to the candidate within the window: keep waiting
+        assert pod.key() in dc._nominate_later
+        env.clock.step(REPLACEMENT_TIMEOUT + 1.0)
+        env.step(1.0)
+        assert pod.key() not in dc._nominate_later
+
+    def test_late_draining_pod_still_nominated(self, env):
+        """A pod that finally drains AFTER the replacement timeout must be
+        nominated onto its target, not silently dropped — the age-out only
+        applies while the pod is stuck on a draining candidate."""
+        from karpenter_tpu.controllers.disruption import (
+            REPLACEMENT_TIMEOUT,
+            _Nomination,
+        )
+
+        env.default_node_class()
+        env.default_node_pool()
+        pod = Pod(requests=Resources(cpu=1))
+        env.kube.put_pod(pod)
+        env.settle()
+        node_name = env.kube.pods[pod.key()].node_name
+        dc = env.operator.disruption
+        entry_ts = env.clock.now()
+        env.clock.step(REPLACEMENT_TIMEOUT + 10.0)
+        # the pod drains (re-pends) just after the deadline, before the
+        # next reconcile observes the stale entry
+        pod.node_name = ""
+        pod.phase = "Pending"
+        dc._nominate_later[pod.key()] = _Nomination(
+            "some-target", (node_name,), entry_ts
+        )
+        # target doesn't exist as claim/node -> entry is dropped cleanly;
+        # register a claim so nomination has a live target instead
+        dc._nominate_evicted()
+        # with no such target the ledger entry is removed without nominating
+        assert pod.key() not in dc._nominate_later
+        # now with a live target: nomination must happen despite the age
+        target = next(iter(env.kube.node_claims))
+        dc._nominate_later[pod.key()] = _Nomination(
+            target, (node_name,), entry_ts
+        )
+        dc._nominate_evicted()
+        assert pod.key() not in dc._nominate_later
+        assert env.cluster.nominated_node(pod.key()) == target
+
+    def test_expiration_runs_in_same_pass_as_reap(self, env):
+        """A resolving replacement must not defer expiration/drift/emptiness
+        for the whole pass (round-3 ADVICE): only consolidation is skipped
+        after a reap acts."""
+        from karpenter_tpu.controllers.disruption import _PendingReplacement
+
+        env.default_node_class()
+        env.default_node_pool(disruption=Disruption(expire_after=100.0))
+        pod = Pod(requests=Resources(cpu=1))
+        env.kube.put_pod(pod)
+        env.settle()
+        (claim,) = env.kube.node_claims.values()
+        env.clock.step(200.0)  # the node is now past expire_after
+        dc = env.operator.disruption
+        # a ready in-flight replacement that will be reaped this pass
+        dc._pending[claim.name] = _PendingReplacement(
+            claim_name=claim.name,
+            candidate_names=[],
+            pod_keys=[],
+            created_at=env.clock.now(),
+            reason="test",
+        )
+        dc.reconcile()
+        assert not dc._pending  # the reap acted...
+        assert claim.deleted_at is not None  # ...and expiration still ran
+
+    def test_reaped_replacement_protected_from_expiration(self, env):
+        """The pass that runs expiration after a reap must NOT expire the
+        just-reaped replacement itself: its nomination targets keep it in
+        `protected` until the drained pods bind."""
+        from karpenter_tpu.controllers.disruption import _PendingReplacement
+
+        env.default_node_class()
+        env.default_node_pool(disruption=Disruption(expire_after=100.0))
+        p1 = Pod(requests=Resources(cpu=28, memory="48Gi"))
+        env.kube.put_pod(p1)
+        env.settle()
+        (b_name,) = env.kube.node_claims  # the "replacement" node
+        p2 = Pod(requests=Resources(cpu=28, memory="48Gi"))
+        env.kube.put_pod(p2)  # forces a second node A
+        env.settle()
+        assert len(env.kube.node_claims) == 2
+        b_claim = env.kube.node_claims[b_name]
+        assert b_claim.deleted_at is None
+        # backdate B past expire_after (advancing the clock instead would
+        # let settle()'s own reconciles expire it before the reap pass)
+        b_claim.created_at = env.clock.now() - 200.0
+        dc = env.operator.disruption
+        dc._pending[b_name] = _PendingReplacement(
+            claim_name=b_name,
+            candidate_names=[],
+            pod_keys=[p1.key()],
+            created_at=env.clock.now(),
+            reason="test",
+        )
+        dc.reconcile()
+        assert not dc._pending  # reaped
+        # B is the only expired node, but it is protected by its pending
+        # nomination — expiration must not tear it down
+        assert p1.key() in dc._nominate_later
+        assert dc._nominate_later[p1.key()].target == b_name
+        assert env.kube.node_claims[b_name].deleted_at is None
+
     def test_rollback_when_replacement_never_registers(self, env):
         """A replacement that never comes up is rolled back: the candidate
         stays, its pods never move."""
