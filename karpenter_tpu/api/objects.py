@@ -138,6 +138,9 @@ class Pod:
     def __setattr__(self, name, value):
         if name in Pod._SIG_FIELDS:
             self.__dict__.pop("_sig", None)
+            self.__dict__.pop("_gkey", None)
+        elif name == "requests":
+            self.__dict__.pop("_gkey", None)
         object.__setattr__(self, name, value)
 
     def __post_init__(self):
@@ -190,6 +193,48 @@ class Pod:
             self.namespace,
         )
         return sig
+
+    def class_key(self) -> "ClassKey":
+        """Interned (constraint_signature, requests) grouping key.
+
+        The tensor solver groups every pod on every solve; hashing the deep
+        signature tuple per lookup dominates the host-side compile at 10k
+        pods.  Interning pays the deep hash once per pod, after which
+        lookups hash a cached int and compare by identity."""
+        ck = self.__dict__.get("_gkey")
+        if ck is None:
+            raw = (self.constraint_signature(), self.requests)
+            ck = _CLASS_KEY_INTERN.get(raw)
+            if ck is None:
+                if len(_CLASS_KEY_INTERN) > 200_000:
+                    _CLASS_KEY_INTERN.clear()  # unbounded-workload backstop
+                ck = ClassKey(raw)
+                _CLASS_KEY_INTERN[raw] = ck
+            self.__dict__["_gkey"] = ck
+        return ck
+
+
+class ClassKey:
+    """A pod-class grouping key with a precomputed hash (see
+    Pod.class_key).  Equal keys are the same object via the intern table,
+    so __eq__ is an identity check first."""
+
+    __slots__ = ("key", "_h")
+
+    def __init__(self, key: Tuple):
+        self.key = key
+        self._h = hash(key)
+
+    def __hash__(self) -> int:
+        return self._h
+
+    def __eq__(self, other) -> bool:
+        return self is other or (
+            isinstance(other, ClassKey) and self.key == other.key
+        )
+
+
+_CLASS_KEY_INTERN: Dict[Tuple, ClassKey] = {}
 
 
 # ---------------------------------------------------------------------------
@@ -267,8 +312,20 @@ class InstanceType:
     overhead: Overhead = field(default_factory=Overhead)
     offerings: Offerings = field(default_factory=Offerings)
 
+    def __setattr__(self, name, value):
+        if name in ("capacity", "overhead"):
+            self.__dict__.pop("_alloc", None)
+        object.__setattr__(self, name, value)
+
     def allocatable(self) -> Resources:
-        return (self.capacity - self.overhead.total()).clamp_nonnegative()
+        # memoized: the oracle's fit loop calls this per (pod, node, type)
+        # probe; capacity/overhead reassignment invalidates (__setattr__)
+        a = self.__dict__.get("_alloc")
+        if a is None:
+            self.__dict__["_alloc"] = a = (
+                self.capacity - self.overhead.total()
+            ).clamp_nonnegative()
+        return a
 
     def cheapest_price(self, reqs: Optional[Requirements] = None) -> float:
         offs = self.offerings.available()

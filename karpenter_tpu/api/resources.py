@@ -90,29 +90,38 @@ class Resources:
         return self.get("memory")
 
     # -- algebra -------------------------------------------------------------
+    # results of arithmetic are already-parsed floats; routing them through
+    # __init__'s quantity parsing would re-validate every entry (the oracle
+    # fit loop does millions of these per large hybrid solve)
+    @classmethod
+    def _from_raw(cls, q: Dict[str, float]) -> "Resources":
+        r = object.__new__(cls)
+        r._q = q
+        return r
+
     def __add__(self, other: "Resources") -> "Resources":
         q = dict(self._q)
         for k, v in other._q.items():
             q[k] = q.get(k, 0.0) + v
-        return Resources(q)
+        return Resources._from_raw(q)
 
     def __sub__(self, other: "Resources") -> "Resources":
         q = dict(self._q)
         for k, v in other._q.items():
             q[k] = q.get(k, 0.0) - v
-        return Resources(q)
+        return Resources._from_raw(q)
 
     def clamp_nonnegative(self) -> "Resources":
-        return Resources({k: max(v, 0.0) for k, v in self._q.items()})
+        return Resources._from_raw({k: max(v, 0.0) for k, v in self._q.items()})
 
     def scaled(self, factor: float) -> "Resources":
-        return Resources({k: v * factor for k, v in self._q.items()})
+        return Resources._from_raw({k: v * factor for k, v in self._q.items()})
 
     def merge_max(self, other: "Resources") -> "Resources":
         q = dict(self._q)
         for k, v in other._q.items():
             q[k] = max(q.get(k, 0.0), v)
-        return Resources(q)
+        return Resources._from_raw(q)
 
     def fits(self, capacity: "Resources", eps: float = 1e-9) -> bool:
         """True iff every requested axis is <= capacity on that axis.

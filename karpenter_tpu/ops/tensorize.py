@@ -40,7 +40,7 @@ pod affinity, zone-keyed anti-affinity — is reported via
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -123,6 +123,60 @@ class Catalog:
     pool_rows: Dict[str, _PoolRows]
     pool_overhead: Dict[str, Resources]
     zones: List[str]
+    # (constraint-signature, pool) -> (type_ok, zone_ok, ct_ok) bool vectors
+    # (None when the pod can't merge with the pool at all).  The exact
+    # Requirements-algebra checks are the host-side compile's dominant cost
+    # at many-class batches; they depend only on the signature and this
+    # catalog snapshot, so they memoize for the catalog's lifetime.
+    feas_memo: Dict = field(default_factory=dict)
+
+
+_MEMO_MISS = object()
+
+
+def _pool_feas(
+    catalog: "Catalog",
+    rep: Pod,
+    sig: Tuple,
+    pname: str,
+    pools_by_name: Dict[str, NodePool],
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Memoized per-(signature, pool) compatibility vectors over the pool's
+    unique types / zones / capacity types.  Zone PINS are intentionally not
+    part of the key: config rows exist only for a type's actual offerings,
+    so pinning composes exactly as a per-row zone filter on top of these."""
+    memo = catalog.feas_memo
+    key = (sig, pname)
+    ent = memo.get(key, _MEMO_MISS)
+    if ent is _MEMO_MISS:
+        pr = catalog.pool_rows[pname]
+        merged = _merge_pool(rep, rep.scheduling_requirements(), pools_by_name[pname])
+        if merged is None:
+            ent = None
+        else:
+            type_ok = np.fromiter(
+                (
+                    it.requirements.compatible(merged, allow_undefined=True)
+                    for it in pr.uniq_types
+                ),
+                bool,
+                len(pr.uniq_types),
+            )
+            zr = merged.get(L.LABEL_ZONE)
+            zone_ok = np.fromiter(
+                (zr is None or zr.has(z) for z in pr.zones), bool, len(pr.zones)
+            )
+            cr = merged.get(L.LABEL_CAPACITY_TYPE)
+            ct_ok = np.fromiter(
+                (cr is None or cr.has(ct) for ct in pr.capacity_types),
+                bool,
+                len(pr.capacity_types),
+            )
+            ent = (type_ok, zone_ok, ct_ok)
+        if len(memo) > 50_000:
+            memo.clear()  # unbounded-workload backstop
+        memo[key] = ent
+    return ent
 
 
 def build_catalog(
@@ -317,10 +371,10 @@ def class_unsupported_reason(rep: Pod) -> str:
 
 
 def _class_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
-    groups: Dict[Tuple, List[Pod]] = {}
+    groups: Dict[object, List[Pod]] = {}
     for p in pods:
-        groups.setdefault((p.constraint_signature(), p.requests), []).append(p)
-    return list(groups.items())
+        groups.setdefault(p.class_key(), []).append(p)
+    return [(ck.key, members) for ck, members in groups.items()]
 
 
 def _couples(a: Pod, b: Pod) -> bool:
@@ -333,7 +387,20 @@ def _couples(a: Pod, b: Pod) -> bool:
 def partition_pods(
     pods: Sequence[Pod],
 ) -> Tuple[List[Pod], List[Pod], str]:
-    """Split a batch into (tensor-solvable, oracle-only, reason).
+    """Split a batch into (tensor-solvable, oracle-only, reason); see
+    `partition_groups` (which the solver uses directly so the class
+    grouping is computed once per solve, not once here and again in
+    `compile_problem`)."""
+    sup_groups, unsupported, why = partition_groups(pods)
+    supported = [p for _, members in sup_groups for p in members]
+    return supported, unsupported, why
+
+
+def partition_groups(
+    pods: Sequence[Pod],
+) -> Tuple[List[Tuple[Tuple, List[Pod]]], List[Pod], str]:
+    """Split a batch into (tensor-solvable class groups, oracle-only pods,
+    reason).
 
     A class is oracle-only when its own constraint shape is unsupported,
     when an anti-affinity term couples it to a DIFFERENT class, or —
@@ -344,27 +411,35 @@ def partition_pods(
     left is capacity, which the oracle sees exactly.
     """
     group_list = _class_groups(pods)
-    n = len(group_list)
-    reps = [members[0] for _, members in group_list]
-    sigs = [sig for (sig, _), _ in group_list]
-    reasons = [class_unsupported_reason(r) for r in reps]
-    # only classes carrying selectors can couple anything — the pairwise
-    # passes iterate those few, not all O(n^2) pairs (n ~ 500 at 10k pods).
-    # Groups sharing a SIGNATURE (same constraints, different requests) are
-    # not "distinct classes": the kernel tracks them through one shared
-    # per-signature counter slot, so only cross-SIG coupling needs the
-    # oracle.  Exception: zone anti-affinity's per-zone singleton split is
-    # per (sig, requests) group, so a sig spanning several request groups
-    # cannot share its <=1-per-zone cap on the tensor path.
+    # every relation below (selector coupling, anti-affinity reach, the
+    # unsupported-shape check) depends only on the constraint SIGNATURE
+    # (labels + selectors + namespace), never on the request vector — so
+    # the pairwise passes run over unique signatures, not classes.  Groups
+    # sharing a signature are not "distinct classes" to the kernel: it
+    # tracks them through one shared per-signature counter slot, so only
+    # cross-SIG coupling needs the oracle.  Exception: zone anti-affinity's
+    # per-zone singleton split is per (sig, requests) group, so a sig
+    # spanning several request groups cannot share its <=1-per-zone cap.
+    sig_index: Dict[Tuple, int] = {}
+    sig_rep: List[Pod] = []
+    sig_count: List[int] = []
+    sig_of: List[int] = []
+    for (sig, _), members in group_list:
+        s = sig_index.get(sig)
+        if s is None:
+            s = sig_index[sig] = len(sig_rep)
+            sig_rep.append(members[0])
+            sig_count.append(0)
+        sig_count[s] += 1
+        sig_of.append(s)
+    m = len(sig_rep)
+    reasons = [class_unsupported_reason(r) for r in sig_rep]
     sel_idx = [
-        i for i, r in enumerate(reps) if r.pod_affinity or r.topology_spread
+        i for i, r in enumerate(sig_rep) if r.pod_affinity or r.topology_spread
     ]
-    sig_groups: Dict[Tuple, int] = {}
-    for s in sigs:
-        sig_groups[s] = sig_groups.get(s, 0) + 1
     for i in sel_idx:
-        rep = reps[i]
-        if sig_groups[sigs[i]] > 1 and any(
+        rep = sig_rep[i]
+        if sig_count[i] > 1 and any(
             t.anti and t.topology_key == L.LABEL_ZONE for t in rep.pod_affinity
         ):
             reasons[i] = reasons[i] or (
@@ -373,14 +448,14 @@ def partition_pods(
         for t in rep.pod_affinity:
             if not t.anti:
                 continue
-            for j, b in enumerate(reps):
-                if sigs[j] != sigs[i] and t.selects(b):
+            for j, b in enumerate(sig_rep):
+                if j != i and t.selects(b):
                     why = "anti-affinity coupling distinct pod classes"
                     reasons[i] = reasons[i] or why
                     reasons[j] = reasons[j] or why
         for c in rep.topology_spread:
-            for j, b in enumerate(reps):
-                if sigs[j] != sigs[i] and c.selects(b):
+            for j, b in enumerate(sig_rep):
+                if j != i and c.selects(b):
                     # the spread group counts another class's pods; the
                     # kernel's per-signature counters can't see them
                     why = "topology spread coupling distinct pod classes"
@@ -389,8 +464,8 @@ def partition_pods(
         for t in rep.pod_affinity:
             if t.anti or t.topology_key != L.LABEL_ZONE:
                 continue
-            for j, b in enumerate(reps):
-                if sigs[j] == sigs[i] or not t.selects(b):
+            for j, b in enumerate(sig_rep):
+                if j == i or not t.selects(b):
                     continue
                 # anchoring pins the whole component to one zone, which is
                 # only sound when the selected class has no zone-keyed
@@ -411,27 +486,28 @@ def partition_pods(
     # transitive closure over selector coupling (either direction)
     edges: Dict[int, set] = {}
     for i in sel_idx:
-        for j in range(n):
-            if i != j and _couples(reps[i], reps[j]):
+        for j in range(m):
+            if i != j and _couples(sig_rep[i], sig_rep[j]):
                 edges.setdefault(i, set()).add(j)
                 edges.setdefault(j, set()).add(i)
-    frontier = [i for i in range(n) if reasons[i]]
+    frontier = [i for i in range(m) if reasons[i]]
     while frontier:
         i = frontier.pop()
         for j in edges.get(i, ()):
             if not reasons[j]:
                 reasons[j] = "coupled to an oracle-only pod class"
                 frontier.append(j)
-    supported: List[Pod] = []
+    sup_groups: List[Tuple[Tuple, List[Pod]]] = []
     unsupported: List[Pod] = []
     why = ""
-    for i, (_, members) in enumerate(group_list):
-        if reasons[i]:
-            unsupported.extend(members)
-            why = why or reasons[i]
+    for i, group in enumerate(group_list):
+        reason = reasons[sig_of[i]]
+        if reason:
+            unsupported.extend(group[1])
+            why = why or reason
         else:
-            supported.extend(members)
-    return supported, unsupported, why
+            sup_groups.append(group)
+    return sup_groups, unsupported, why
 
 
 def _unsupported_reason(pods: Sequence[Pod]) -> str:
@@ -498,6 +574,7 @@ def compile_problem(
     daemonsets: Sequence[Pod] = (),
     catalog: Optional[Catalog] = None,
     presplit: bool = False,
+    groups: Optional[List[Tuple[Tuple, List[Pod]]]] = None,
 ) -> CompiledProblem:
     """Compile one scheduling problem to tensors.
 
@@ -507,9 +584,15 @@ def compile_problem(
     introduce no new extended-resource axes.  ``presplit=True`` promises
     the caller already ran `partition_pods` and kept only the supported
     half, skipping the (pure-overhead) re-check on the hot path.
+    ``groups`` passes the caller's `partition_groups` output so the class
+    grouping isn't recomputed (every member of a group shares the
+    representative's requests and constraint signature by construction).
     """
-    pods = list(pods)
-    axes = _axes_for(pods)
+    if groups is None:
+        pods = list(pods)
+        groups = _class_groups(pods)
+    reps = [members[0] for _, members in groups]
+    axes = _axes_for(reps)
     reason = "" if presplit else _unsupported_reason(pods)
     if catalog is None or catalog.axes != axes:
         catalog = build_catalog(pools, instance_types, daemonsets, axes)
@@ -549,7 +632,7 @@ def compile_problem(
 
     # ------------------------------------------------------------- classes
     all_zones = sorted(set(catalog.zones) | {sn.zone for sn in live if sn.zone})
-    group_list = _class_groups(pods)
+    group_list = groups
 
     # zone-keyed pod affinity: compile-time domain anchoring — each coupled
     # component of classes pins to ONE zone (the oracle anchors the domain
@@ -755,24 +838,14 @@ def compile_problem(
             sched.add(Requirement(L.LABEL_ZONE, Op.IN, [zone_pin]))
         row = np.zeros(C, dtype=bool)
         for pname, pr in catalog.pool_rows.items():
-            merged = _merge_pool(rep, sched, pools_by_name[pname])
-            if merged is None:
+            ent = _pool_feas(catalog, rep, sig, pname, pools_by_name)
+            if ent is None:
                 continue
-            type_ok = np.array(
-                [
-                    it.requirements.compatible(merged, allow_undefined=True)
-                    for it in pr.uniq_types
-                ],
-                dtype=bool,
-            )
-            zr = merged.get(L.LABEL_ZONE)
-            zone_ok = np.array(
-                [zr is None or zr.has(z) for z in pr.zones], dtype=bool
-            )
-            cr = merged.get(L.LABEL_CAPACITY_TYPE)
-            ct_ok = np.array(
-                [cr is None or cr.has(ct) for ct in pr.capacity_types], dtype=bool
-            )
+            type_ok, zone_ok, ct_ok = ent
+            if zone_pin:
+                zone_ok = zone_ok & np.fromiter(
+                    (z == zone_pin for z in pr.zones), bool, len(pr.zones)
+                )
             row[pr.rows] = type_ok[pr.t_of] & zone_ok[pr.z_of] & ct_ok[pr.ct_of]
         for e, sn in enumerate(live):
             row[first_existing + e] = _fits_existing(rep, sched, sn)
@@ -851,17 +924,12 @@ def _feasible_zones(
     req_vec = _vec(requests, catalog.axes)
     pools_by_name = {p.name: p for p in pools}
     zones: set = set()
+    sig = rep.constraint_signature()
     for pname, pr in catalog.pool_rows.items():
-        merged = _merge_pool(rep, sched, pools_by_name[pname])
-        if merged is None:
+        ent = _pool_feas(catalog, rep, sig, pname, pools_by_name)
+        if ent is None:
             continue
-        type_ok = np.array(
-            [
-                it.requirements.compatible(merged, allow_undefined=True)
-                for it in pr.uniq_types
-            ],
-            dtype=bool,
-        )
+        type_ok = ent[0]
         fits = (req_vec[None, :] <= catalog.alloc[pr.rows] + 1e-6).all(axis=1)
         ok_rows = type_ok[pr.t_of] & fits
         zones.update(pr.zones[z] for z in set(pr.z_of[ok_rows].tolist()))

@@ -16,6 +16,8 @@ consolidation reuses to simulate evicted-pod rescheduling.
 from __future__ import annotations
 
 import itertools
+
+import numpy as np
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -69,6 +71,15 @@ class VirtualNode:
     name: str = ""
     pods: List[Pod] = field(default_factory=list)
     used: Resources = field(default_factory=Resources)
+    # deferred launch-flexibility widening (tensor decode attaches it): the
+    # full price-ordered alternate-type list is only needed per LAUNCHED
+    # node, so computing it inside the solve would tax every decoded node
+    # on the 200ms critical path
+    widen_thunk: Optional[object] = None
+    # (pod constraint shape, zone choice) -> types passing the label /
+    # offering compatibility scan.  The scan result is per pod SHAPE, not
+    # per pod — cleared whenever a commit narrows this node's requirements
+    _fit_cache: Dict = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.name:
@@ -88,34 +99,58 @@ class VirtualNode:
         return zones
 
     def _fits_some_type(
-        self, reqs: Requirements, used: Resources
+        self,
+        reqs: Requirements,
+        used: Resources,
+        cache_key: Optional[Tuple] = None,
     ) -> List[InstanceType]:
-        out = []
-        for t in self.feasible_types:
-            if not t.requirements.compatible(reqs, allow_undefined=True):
-                continue
-            if not used.fits(t.allocatable()):
-                continue
-            if not t.offerings.available().compatible(reqs):
-                continue
-            out.append(t)
-        return out
+        ent = self._fit_cache.get(cache_key) if cache_key is not None else None
+        if ent is None:
+            cand = [
+                t
+                for t in self.feasible_types
+                if t.requirements.compatible(reqs, allow_undefined=True)
+                and t.offerings.available().compatible(reqs)
+            ]
+            ent = (cand, {})
+            if cache_key is not None:
+                self._fit_cache[cache_key] = ent
+        cand, mats = ent
+        if not cand:
+            return []
+        # one vectorized compare over the candidate list's allocatable
+        # matrix instead of a per-type Resources.fits walk
+        items = sorted(used._q.items())
+        axes = tuple(k for k, _ in items)
+        mat = mats.get(axes)
+        if mat is None:
+            mats[axes] = mat = np.array(
+                [[t.allocatable().get(a) for a in axes] for t in cand],
+                dtype=np.float64,
+            )
+        vec = np.array([v for _, v in items])
+        mask = (vec <= mat + 1e-9).all(axis=1)
+        if mask.all():
+            return list(cand)
+        return [t for t, ok in zip(cand, mask) if ok]
 
     def try_add(self, pod: Pod, topology: TopologyTracker) -> bool:
         if not tolerates_all(pod.tolerations, self.pool.taints):
             return False
+        # topology first: hostname-keyed constraints treat this node as a
+        # domain; a node with no pods yet is a fresh domain (NEW_DOMAIN).
+        # Checked before the Requirements merge because it is by far the
+        # cheapest rejection — a co-location follower probes every open
+        # node and all but its anchor fail here.
+        host_allowed = topology.allowed_domains(pod, HOSTNAME)
+        if host_allowed is not None and self.name not in host_allowed:
+            if not (NEW_DOMAIN in host_allowed and not self.pods):
+                return False
         reqs = Requirements(iter(self.requirements))
         for r in pod.scheduling_requirements():
             reqs.add(r)
         if reqs.is_unsatisfiable():
             return False
-
-        # topology: hostname-keyed constraints treat this node as a domain;
-        # a node with no pods yet is a fresh domain (NEW_DOMAIN)
-        host_allowed = topology.allowed_domains(pod, HOSTNAME)
-        if host_allowed is not None and self.name not in host_allowed:
-            if not (NEW_DOMAIN in host_allowed and not self.pods):
-                return False
         # zone-keyed constraints narrow the node's zone choice; any pod
         # carrying one must PIN a zone so the placement is counted/anchored
         # (first affinity pod anchors the domain for followers)
@@ -134,11 +169,15 @@ class VirtualNode:
             reqs.add(Requirement(ZONE, Op.IN, [zone_choice]))
 
         new_used = self.used + pod.requests
-        feasible = self._fits_some_type(reqs, new_used)
+        sig = pod.constraint_signature()
+        feasible = self._fits_some_type(
+            reqs, new_used, cache_key=(sig[0], sig[1], zone_choice)
+        )
         if not feasible:
             return False
 
-        # commit
+        # commit narrows requirements/types: shape-keyed scans are stale
+        self._fit_cache.clear()
         self.requirements = reqs
         self.feasible_types = feasible
         self.used = new_used
@@ -164,6 +203,24 @@ class VirtualNode:
         """Feasible types, price-ascending (reference
         pkg/providers/instance/instance.go:391-408)."""
         return sorted(self.feasible_types, key=lambda t: t.cheapest_price(self.requirements))
+
+
+def _feasible_types_get(self: VirtualNode) -> List[InstanceType]:
+    if self.widen_thunk is not None:
+        thunk, self.widen_thunk = self.widen_thunk, None
+        self.__dict__["_ftypes"] = thunk()
+    return self.__dict__["_ftypes"]
+
+
+def _feasible_types_set(self: VirtualNode, value: List[InstanceType]) -> None:
+    self.__dict__["_ftypes"] = value
+
+
+# `feasible_types` is a property (attached post-dataclass so the dataclass
+# machinery still generates the __init__ parameter): reading it consumes a
+# pending widen_thunk, so EVERY consumer — including direct attribute reads
+# — observes the fully widened list, never the narrow committed-type one.
+VirtualNode.feasible_types = property(_feasible_types_get, _feasible_types_set)
 
 
 @dataclass

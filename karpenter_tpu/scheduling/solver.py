@@ -15,7 +15,7 @@ agnostic to which path solved the batch.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -29,7 +29,7 @@ from karpenter_tpu.ops.tensorize import (
     ConfigMeta,
     build_catalog,
     compile_problem,
-    partition_pods,
+    partition_groups,
 )
 from karpenter_tpu.scheduling.scheduler import (
     Scheduler,
@@ -105,10 +105,11 @@ class TensorScheduler:
         exotic constraint no longer sends the whole 10k-pod batch to the
         O(pods x nodes) Python loop — only its coupled closure goes."""
         pods = list(pods)
-        supported, unsupported, _reason = partition_pods(pods)
-        if not supported:
+        sup_groups, unsupported, _reason = partition_groups(pods)
+        if not sup_groups:
             return self._oracle(pods)
-        result = self._solve_tensor(supported)
+        supported = [p for _, members in sup_groups for p in members]
+        result = self._solve_tensor(supported, sup_groups)
         if result is None:  # tensor compile bailed; solve everything oracle
             return self._oracle(pods)
         if unsupported:
@@ -116,12 +117,14 @@ class TensorScheduler:
             result = self._oracle_continue(unsupported, supported, result)
         return result
 
-    def _solve_tensor(self, pods: List[Pod]) -> Optional[SchedulingResult]:
+    def _solve_tensor(
+        self, pods: List[Pod], groups
+    ) -> Optional[SchedulingResult]:
         import jax
 
         from karpenter_tpu.ops.tensorize import _axes_for
 
-        axes = _axes_for(pods)
+        axes = _axes_for([members[0] for _, members in groups])
         key = (
             axes,
             tuple(id(p) for p in self.pools),
@@ -147,6 +150,7 @@ class TensorScheduler:
             daemonsets=self.daemonsets,
             catalog=catalog,
             presplit=True,
+            groups=groups,
         )
         if not prob.supported:
             return None
@@ -303,31 +307,53 @@ class TensorScheduler:
         the node's total usage, and (c) shares the committed pool, zone and
         capacity type — so the instance provider can hand CreateFleet up to
         60 price-ordered fallbacks (reference instance.go:54,391-408)
-        instead of a single pinned type."""
+        instead of a single pinned type.
+
+        Attached LAZILY (VirtualNode.widen_thunk): the widening is consumed
+        per launched node, not per solve, so it stays off the solve's
+        critical path.  Each thunk captures only per-node SLICES (its feas
+        rows, usage row, committed config) plus the catalog-lifetime
+        configs/alloc/openable arrays — never the CompiledProblem itself,
+        which holds the whole batch's pod lists."""
         C = len(prob.configs)
+        configs = prob.configs
+        alloc = prob.alloc
+        openable = prob.openable
+
+        def widen(committed, class_feas: np.ndarray, used_row: np.ndarray):
+            def thunk() -> List:
+                mask = openable & class_feas
+                mask = mask & (used_row[None, :] <= alloc + 1e-6).all(axis=1)
+                seen = {committed.instance_type.name}
+                alts = []
+                for c in np.nonzero(mask[:C])[0]:
+                    cfg = configs[c]
+                    if (
+                        cfg.zone != committed.zone
+                        or cfg.capacity_type != committed.capacity_type
+                        or cfg.pool is not committed.pool
+                    ):
+                        continue
+                    name = cfg.instance_type.name
+                    if name in seen:
+                        continue
+                    seen.add(name)
+                    alts.append((cfg.price, cfg.instance_type))
+                alts.sort(key=lambda pair: pair[0])
+                return [committed.instance_type] + [it for _, it in alts]
+
+            return thunk
+
         for k, vn in vnodes.items():
-            committed = prob.configs[node_cfg[k]]
-            mask = prob.openable.copy()
-            for g in slot_classes.get(k, ()):
-                mask &= prob.feas[g]
-            mask &= (node_used[k][None, :] <= prob.alloc + 1e-6).all(axis=1)
-            seen = {committed.instance_type.name}
-            alts = []
-            for c in np.nonzero(mask[:C])[0]:
-                cfg = prob.configs[c]
-                if (
-                    cfg.zone != committed.zone
-                    or cfg.capacity_type != committed.capacity_type
-                    or cfg.pool is not committed.pool
-                ):
-                    continue
-                name = cfg.instance_type.name
-                if name in seen:
-                    continue
-                seen.add(name)
-                alts.append((cfg.price, cfg.instance_type))
-            alts.sort(key=lambda pair: pair[0])
-            vn.feasible_types = [committed.instance_type] + [it for _, it in alts]
+            classes = slot_classes.get(k, ())
+            class_feas = (
+                prob.feas[list(classes)].all(axis=0)
+                if classes
+                else np.ones(prob.feas.shape[1], bool)
+            )
+            vn.widen_thunk = widen(
+                configs[node_cfg[k]], class_feas, node_used[k].copy()
+            )
 
     @staticmethod
     def _why_unschedulable(prob: CompiledProblem, g: int) -> str:

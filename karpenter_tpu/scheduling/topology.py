@@ -81,6 +81,35 @@ class TopologyTracker:
         self._spread: Dict[Tuple, _SpreadGroup] = {}
         self._affinity: Dict[Tuple, _AffinityGroup] = {}
         self._placements: List[Tuple[Pod, Dict[str, str]]] = []
+        # label indexes: selectors are matchLabels conjunctions, so a group
+        # can only select pods carrying its FIRST label pair, and a pod can
+        # only be selected by broad (empty-selector) groups or groups
+        # registered under one of its label pairs.  Without these indexes
+        # both record() and lazy group replay scan everything — quadratic
+        # over a 10k-placement hybrid solve.
+        self._placements_by_label: Dict[Tuple[str, str], List[int]] = {}
+        self._groups_by_label: Dict[Tuple[str, str], List[object]] = {}
+        self._broad_groups: List[object] = []
+
+    def _register_group(self, selector: Tuple, g: object) -> None:
+        if selector:
+            self._groups_by_label.setdefault(tuple(selector[0]), []).append(g)
+        else:
+            self._broad_groups.append(g)
+
+    def _replay_candidates(
+        self, selector: Tuple
+    ) -> Iterable[Tuple[Pod, Dict[str, str]]]:
+        if not selector:
+            return self._placements
+        idxs = self._placements_by_label.get(tuple(selector[0]), ())
+        return (self._placements[i] for i in idxs)
+
+    def _candidate_groups(self, pod: Pod) -> List[object]:
+        out = list(self._broad_groups)
+        for kv in pod.labels.items():
+            out.extend(self._groups_by_label.get(kv, ()))
+        return out
 
     # -- group creation (lazy, replaying history) ----------------------------
     def _spread_group(self, c: TopologySpreadConstraint) -> _SpreadGroup:
@@ -88,10 +117,11 @@ class TopologyTracker:
         g = self._spread.get(key)
         if g is None:
             g = _SpreadGroup(c)
-            for pod, domains in self._placements:
+            for pod, domains in self._replay_candidates(c.label_selector):
                 if c.selects(pod) and c.topology_key in domains:
                     g.counts[domains[c.topology_key]] += 1
             self._spread[key] = g
+            self._register_group(c.label_selector, g)
         return g
 
     def _affinity_group(self, t: PodAffinityTerm) -> _AffinityGroup:
@@ -99,10 +129,11 @@ class TopologyTracker:
         g = self._affinity.get(key)
         if g is None:
             g = _AffinityGroup(t)
-            for pod, domains in self._placements:
+            for pod, domains in self._replay_candidates(t.label_selector):
                 if t.selects(pod) and t.topology_key in domains:
                     g.domains.add(domains[t.topology_key])
             self._affinity[key] = g
+            self._register_group(t.label_selector, g)
         return g
 
     # -- queries -------------------------------------------------------------
@@ -148,13 +179,13 @@ class TopologyTracker:
         their domain pinned at placement time so the group's counts stay
         sound — even when the pod carries no constraint of its own.
         """
-        return any(
-            g.constraint.topology_key == key and g.constraint.selects(pod)
-            for g in self._spread.values()
-        ) or any(
-            g.term.topology_key == key and g.term.selects(pod)
-            for g in self._affinity.values()
-        )
+        for g in self._candidate_groups(pod):
+            if isinstance(g, _SpreadGroup):
+                if g.constraint.topology_key == key and g.constraint.selects(pod):
+                    return True
+            elif g.term.topology_key == key and g.term.selects(pod):
+                return True
+        return False
 
     def preferred_domain(self, pod: Pod, key: str, candidates: Set[str]) -> str:
         """Pick the candidate domain with the lowest aggregate spread count
@@ -165,13 +196,16 @@ class TopologyTracker:
         for c in pod.topology_spread:
             if c.topology_key == key and c.selects(pod):
                 self._spread_group(c)
+        groups = [
+            g
+            for g in self._candidate_groups(pod)
+            if isinstance(g, _SpreadGroup)
+            and g.constraint.topology_key == key
+            and g.constraint.selects(pod)
+        ]
 
         def load(d: str) -> int:
-            return sum(
-                g.counts.get(d, 0)
-                for g in self._spread.values()
-                if g.constraint.topology_key == key and g.constraint.selects(pod)
-            )
+            return sum(g.counts.get(d, 0) for g in groups)
 
         return min(sorted(candidates), key=load)
 
@@ -179,14 +213,18 @@ class TopologyTracker:
     def record(self, pod: Pod, domains: Dict[str, str]) -> None:
         """Record a placement: `domains` maps topology key -> chosen domain
         (e.g. {zone: 'zone-a', hostname: 'node-3'})."""
+        idx = len(self._placements)
         self._placements.append((pod, dict(domains)))
         for key, domain in domains.items():
             self.universe.setdefault(key, set()).add(domain)
-        for g in self._spread.values():
-            c = g.constraint
-            if c.selects(pod) and c.topology_key in domains:
-                g.counts[domains[c.topology_key]] += 1
-        for g in self._affinity.values():
-            t = g.term
-            if t.selects(pod) and t.topology_key in domains:
-                g.domains.add(domains[t.topology_key])
+        for kv in pod.labels.items():
+            self._placements_by_label.setdefault(kv, []).append(idx)
+        for g in self._candidate_groups(pod):
+            if isinstance(g, _SpreadGroup):
+                c = g.constraint
+                if c.selects(pod) and c.topology_key in domains:
+                    g.counts[domains[c.topology_key]] += 1
+            else:
+                t = g.term
+                if t.selects(pod) and t.topology_key in domains:
+                    g.domains.add(domains[t.topology_key])
