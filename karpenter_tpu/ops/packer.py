@@ -245,26 +245,66 @@ def pad_problem(prob: CompiledProblem, k_slots: int = 0) -> Tuple[tuple, int]:
     return args, Kp
 
 
-# device-resident copies of the padded catalog constants, keyed by the
-# identity of the compiled problem's (alloc, price, openable) sources and
-# the padded shape.  The entry pins the source arrays so the id-based key
-# stays sound (same pattern as TensorScheduler's catalog cache).
+@jax.jit
+def compact_take(take: jax.Array):
+    """Sparse (values, flat indices, nnz) view of a take matrix
+    ([G, K...] — trailing slot axes may be flat or tiled).
+
+    FFD leaves take sparse — each class touches a prefix of partially
+    filled slots plus its freshly opened window — and on a high-latency
+    device link fetching the dense int32 matrix is the solve's largest
+    transfer.  Callers fetch the sparse triple and fall back to the dense
+    array iff nnz overflowed the static (heuristic) G + 2K buffer."""
+    flat = take.reshape(-1)
+    k = flat.shape[0] // take.shape[0]
+    ncap = take.shape[0] + 2 * k
+    (idx,) = jnp.nonzero(flat, size=ncap, fill_value=0)
+    return flat[idx], idx, jnp.count_nonzero(flat)
+
+
+def expand_take(
+    vals: np.ndarray, idx: np.ndarray, nnz: int, take_dev: jax.Array
+) -> np.ndarray:
+    """Rebuild the dense take matrix from its fetched sparse triple,
+    falling back to a dense fetch iff nnz overflowed the static buffer.
+    Kept separate from the fetch so callers can bundle the sparse triple
+    into ONE device_get with their other outputs (each device_get is a
+    full round trip on a tunneled link)."""
+    shape = take_dev.shape
+    if int(nnz) > len(idx):
+        return np.asarray(jax.device_get(take_dev))
+    out = np.zeros(int(np.prod(shape)), np.int32)
+    out[idx] = vals
+    return out.reshape(shape)
+
+
+# device-resident constant caches, keyed by source-array identity with the
+# sources pinned in the entry so the id-based key stays sound (the same
+# pattern as TensorScheduler's catalog cache)
+def cached_device_put(cache: dict, srcs: tuple, extra_key: tuple, build):
+    import jax as _jax
+
+    key = tuple(id(s) for s in srcs) + extra_key
+    ent = cache.get(key)
+    if ent is not None and all(a is b for a, b in zip(ent[0], srcs)):
+        return ent[1]
+    dev = _jax.device_put(build())
+    if len(cache) > 32:
+        cache.clear()
+    cache[key] = (srcs, dev)
+    return dev
+
+
 _DEV_CONST_CACHE: dict = {}
 
 
 def _device_constants(prob, alloc_p, price_p, openable_p):
-    import jax
-
-    srcs = (prob.alloc, prob.price, prob.openable)
-    key = tuple(id(s) for s in srcs) + (alloc_p.shape,)
-    ent = _DEV_CONST_CACHE.get(key)
-    if ent is not None and all(a is b for a, b in zip(ent[0], srcs)):
-        return ent[1]
-    dev = jax.device_put((alloc_p, price_p, openable_p))
-    if len(_DEV_CONST_CACHE) > 32:
-        _DEV_CONST_CACHE.clear()
-    _DEV_CONST_CACHE[key] = (srcs, dev)
-    return dev
+    return cached_device_put(
+        _DEV_CONST_CACHE,
+        (prob.alloc, prob.price, prob.openable),
+        (alloc_p.shape,),
+        lambda: (alloc_p, price_p, openable_p),
+    )
 
 
 def run_pack(

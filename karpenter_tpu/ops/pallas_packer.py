@@ -37,7 +37,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from karpenter_tpu.ops.packer import PackResult, _bucket, node_slot_bound
+from karpenter_tpu.ops.packer import (
+    PackResult,
+    _bucket,
+    cached_device_put,
+    compact_take,
+    expand_take,
+    node_slot_bound,
+)
 from karpenter_tpu.ops.tensorize import CompiledProblem
 
 # max distinct (signature, zone-pin) rows the VMEM state holds.  The
@@ -91,8 +98,6 @@ def _exclusive_prefix(x: jax.Array) -> jax.Array:
 def _pack_step(
     # scalar-prefetch args (SMEM, full arrays indexed by program id)
     cnt_ref, maxper_ref, slot_ref, sig_ref, reqf_ref, next0_ref,
-    # per-class blocks
-    feas_ref,
     # resident tables
     sigfeas_ref, alloc_ref, price_ref, open_ref,
     # initial state
@@ -121,6 +126,11 @@ def _pack_step(
     tslot = slot_ref[g]
     srow = sig_ref[g]
     req = [reqf_ref[g * R_FIX + r] for r in range(R_FIX)]
+    # the class's config-admission row IS its signature's row (classes of a
+    # signature share the feasibility row by construction), so the kernel
+    # reads sigfeas instead of a per-class [G, C] input — that input was
+    # the largest host->device upload of the whole solve
+    feas_g = sigfeas_ref[pl.ds(srow, 1)][0]  # (CR, 128)
 
     # ---- fill open slots (first-fit in slot order) ----------------------
     ok = sigok_s[pl.ds(srow, 1)][0]  # (KR, 128)
@@ -136,7 +146,6 @@ def _pack_step(
     n2 = n - jnp.sum(take1)
 
     # ---- open new slots on the best config ------------------------------
-    feas_g = feas_ref[0]  # (CR, 128)
     capc = jnp.full((cr, LANES), BIGF)
     for r in range(R_FIX):
         per_r = jnp.floor(alloc_ref[r] / jnp.maximum(req[r], 1e-9) + 1e-4)
@@ -210,11 +219,16 @@ from jax.experimental.pallas import tpu as pltpu  # noqa: E402
     jax.jit, static_argnames=("g_steps", "kr", "cr", "s8", "t8", "objective", "interpret")
 )
 def _pallas_pack(
-    req, cnt, maxper, slot, sig, feas, sigfeas, alloc_t, price_n, openable,
+    req, cnt, maxper, slot, sig, sigfeas_packed, alloc_t, price_n, openable,
     rem0, cfg0, npods0, sigok0, trk0, next0,
     *, g_steps: int, kr: int, cr: int, s8: int, t8: int, objective: str,
     interpret: bool,
 ):
+    # sigfeas ships bit-packed (32x smaller upload than f32) and unpacks
+    # on device with plain XLA ops before the pallas launch
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (sigfeas_packed[:, :, None] >> shifts) & jnp.uint8(1)
+    sigfeas = bits.reshape(s8, cr, LANES).astype(jnp.float32)
     kernel = functools.partial(
         _pack_step, objective=objective, n_steps=g_steps
     )
@@ -223,7 +237,6 @@ def _pallas_pack(
         num_scalar_prefetch=6,  # cnt, maxper, slot, sig, req_flat, next0
         grid=(g_steps,),
         in_specs=[
-            pl.BlockSpec((1, cr, LANES), lambda g, *_: (g, 0, 0)),  # feas
             full(),  # sigfeas
             full(),  # alloc_t
             full(),  # price_n
@@ -261,10 +274,16 @@ def _pallas_pack(
         interpret=interpret,
     )(
         cnt, maxper, slot, sig, req.reshape(-1), next0,
-        feas, sigfeas, alloc_t, price_n, openable,
+        sigfeas, alloc_t, price_n, openable,
         rem0, cfg0, npods0, sigok0, trk0,
     )
-    return out
+    # sparse compaction of the take matrix on device (ops.packer
+    # compact_take): the dense [G, K] int32 output is the solve's largest
+    # device->host transfer.  The dense array is still returned un-fetched
+    # for the rare overflow fallback.
+    take_dense = out[0]
+    vals, idx, nnz = compact_take(take_dense)
+    return take_dense, vals, idx, nnz, out[1], out[2], out[3]
 
 
 def supports(prob: CompiledProblem) -> bool:
@@ -277,8 +296,46 @@ def supports(prob: CompiledProblem) -> bool:
     )
 
 
+def _sig_key(prob: CompiledProblem, gidx: int) -> Tuple:
+    """Admission-row key for a class: the (signature, zone_pin) pair PLUS
+    the feasibility row content.  Classes of one signature usually share
+    their row, but the pool-weight priority pass restricts feas per class
+    by request size, and compile-time-infeasible classes carry all-zero
+    rows — collapsing those onto one signature row would let the kernel
+    open/fill configs the class may not use."""
+    cm = prob.classes[gidx]
+    return (cm.signature, cm.zone_pin, prob.feas[gidx].tobytes())
+
+
 def _n_signatures(prob: CompiledProblem) -> int:
-    return len({(cm.signature, cm.zone_pin) for cm in prob.classes}) or 1
+    return len({_sig_key(prob, g) for g in range(len(prob.classes))}) or 1
+
+
+# device-resident (alloc_t, price_n, openable) per catalog snapshot
+_PALLAS_CONST_CACHE: dict = {}
+
+
+def _pallas_device_constants(prob: CompiledProblem, cr: int, R: int):
+    def build():
+        C = len(prob.price)
+        alloc_t = np.zeros((R_FIX, cr, LANES), np.float32)
+        alloc_t.reshape(R_FIX, -1)[:R, :C] = prob.alloc.T
+        finite = prob.price[np.isfinite(prob.price)]
+        ceil = float(finite.max()) + 1.0 if finite.size else 1.0
+        price_n = np.full((cr, LANES), BIGF, np.float32)
+        price_n.reshape(-1)[:C] = np.where(
+            np.isfinite(prob.price), prob.price / ceil, np.float32(BIGF)
+        )
+        openable = np.zeros((cr, LANES), np.float32)
+        openable.reshape(-1)[:C] = prob.openable.astype(np.float32)
+        return alloc_t, price_n, openable
+
+    return cached_device_put(
+        _PALLAS_CONST_CACHE,
+        (prob.alloc, prob.price, prob.openable),
+        (cr,),
+        build,
+    )
 
 
 def run_pack_pallas(
@@ -309,12 +366,16 @@ def run_pack_pallas(
     kr, cr = Kp // LANES, Cp // LANES
     E = len(prob.used0)
 
-    # signature rows: map each class to its (signature, zone_pin) row
+    # signature rows: map each class to its admission row (see _sig_key —
+    # feas-row content is part of the key, so every class's row is exact)
     sig_keys = {}
     sig_of = np.zeros(Gp, np.int32)
-    for gidx, cm in enumerate(prob.classes):
-        key = (cm.signature, cm.zone_pin)
-        sig_of[gidx] = sig_keys.setdefault(key, len(sig_keys))
+    sig_first_class = {}
+    for gidx in range(G):
+        key = _sig_key(prob, gidx)
+        srow = sig_keys.setdefault(key, len(sig_keys))
+        sig_of[gidx] = srow
+        sig_first_class.setdefault(srow, gidx)
     s8 = max(_bucket(max(len(sig_keys), 1), floor=8), 8)
     t8 = max(_bucket(max(prob.n_track_slots, 1), floor=8), 8)
 
@@ -326,22 +387,14 @@ def run_pack_pallas(
     maxper[:G] = np.minimum(prob.maxper, 2**20)
     slot = np.zeros(Gp, np.int32)
     slot[:G] = prob.slot
-    feas = np.zeros((Gp, cr, LANES), np.float32)
-    feas.reshape(Gp, -1)[:G, :C] = prob.feas.astype(np.float32)
-    # signature x config admission (class rows of one signature are equal)
-    sigfeas = np.zeros((s8, cr, LANES), np.float32)
+    # signature x config admission (class rows of one signature are equal),
+    # shipped bit-packed: the f32 per-class admission inputs were ~12 MB of
+    # host->device upload per solve — pure latency on a tunneled device
+    sigfeas_rows = np.zeros((s8, cr * LANES), bool)
     for gidx in range(G):
-        sigfeas[sig_of[gidx]].reshape(-1)[:C] = prob.feas[gidx]
-    alloc_t = np.zeros((R_FIX, cr, LANES), np.float32)
-    alloc_t.reshape(R_FIX, -1)[:R, :C] = prob.alloc.T
-    finite = prob.price[np.isfinite(prob.price)]
-    ceil = float(finite.max()) + 1.0 if finite.size else 1.0
-    price_n = np.full((cr, LANES), BIGF, np.float32)
-    price_n.reshape(-1)[:C] = np.where(
-        np.isfinite(prob.price), prob.price / ceil, np.float32(BIGF)
-    )
-    openable = np.zeros((cr, LANES), np.float32)
-    openable.reshape(-1)[:C] = prob.openable.astype(np.float32)
+        sigfeas_rows[sig_of[gidx], :C] = prob.feas[gidx]
+    sigfeas_packed = np.packbits(sigfeas_rows, axis=1, bitorder="little")
+    alloc_t, price_n, openable = _pallas_device_constants(prob, cr, R)
 
     rem0 = np.zeros((R_FIX, kr, LANES), np.float32)
     cfg0 = np.full((kr, LANES), -1, np.int32)
@@ -354,27 +407,26 @@ def run_pack_pallas(
         rem0.reshape(R_FIX, -1)[:R, :E] = rem_e.T
         cfg0.reshape(-1)[:E] = prob.cfg0
         npods0.reshape(-1)[:E] = prob.npods0
-        for key, srow in sig_keys.items():
-            gidx = next(
-                i
-                for i, cm in enumerate(prob.classes)
-                if (cm.signature, cm.zone_pin) == key
-            )
+        for srow, gidx in sig_first_class.items():
             sigok0[srow].reshape(-1)[:E] = prob.feas[
                 gidx, len(prob.configs) - E :
             ].astype(np.float32)
         trk0.reshape(t8, -1)[: prob.sig_used0.shape[0], :E] = prob.sig_used0
 
     out = _pallas_pack(
-        req, cnt, maxper, slot, sig_of, feas, sigfeas, alloc_t, price_n,
+        req, cnt, maxper, slot, sig_of, sigfeas_packed, alloc_t, price_n,
         openable, rem0, cfg0, npods0, sigok0, trk0,
         np.array([E], np.int32),
         g_steps=Gp, kr=kr, cr=cr, s8=s8, t8=t8, objective=objective,
         interpret=interpret,
     )
-    # one transfer for all outputs (the device link may be high-latency)
-    take, cfg_out, npods_out, rem_out = jax.device_get(out)
-    take_flat = np.asarray(take).reshape(Gp, Kp)
+    # one transfer for all outputs (the device link may be high-latency);
+    # take arrives sparse unless the nonzero count overflowed the buffer
+    take_dense, vals, idx, nnz, cfg_out, npods_out, rem_out = out
+    nnz_v, vals_v, idx_v, cfg_out, npods_out, rem_out = jax.device_get(
+        (nnz, vals, idx, cfg_out, npods_out, rem_out)
+    )
+    take_flat = expand_take(vals_v, idx_v, nnz_v, take_dense).reshape(Gp, Kp)
     leftover = cnt - take_flat.sum(axis=1).astype(np.int32)
     node_cfg = np.asarray(cfg_out).reshape(Kp)
     node_pods = np.asarray(npods_out).reshape(Kp)
@@ -395,18 +447,12 @@ def run_pack_pallas(
 
 # below this count the fused kernel's fixed launch cost outweighs its
 # per-step win over the scan kernel (measured on TPU v5e: ~20ms fixed,
-# ~7us/step vs the scan's ~29us/step).
-#
-# Caveat measured on the tunneled v5e used by the driver (round 3): the
-# axon remote runtime dispatches Mosaic custom calls asynchronously ONLY
-# until the first device->host transfer of the session; after any
-# `device_get` every pallas_call launch synchronizes with the host
-# (~90-100 ms, one tunnel round-trip), while pure-XLA executables keep
-# async dispatch.  A solver must fetch results, so on THAT runtime the
-# fused kernel carries a flat ~100 ms penalty the scan kernel does not.
-# This is a property of the tunnel, not the kernel: on directly-attached
-# TPUs D2H goes over PCIe and no such mode switch exists.  bench.py
-# reports the fused kernel and the scan kernel side by side.
+# ~7us/step vs the scan's ~29us/step).  With the bit-packed admission
+# upload and the sparse take fetch (round 4), the fused kernel measures
+# FASTER than the scan kernel end-to-end at this class count even on the
+# driver's tunneled v5e (177ms vs 190ms p50 on bench config 2), where
+# transfer latency once buried its per-step win.  bench.py still reports
+# both kernels side by side.
 PALLAS_MIN_CLASSES = 256
 
 # which kernel the last auto_pack dispatch ran ("pallas" | "scan") —

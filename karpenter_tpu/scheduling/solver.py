@@ -157,17 +157,29 @@ class TensorScheduler:
         self.last_path = "tensor"
         result = self.pack_fn(prob, objective=self.objective)
         from karpenter_tpu.ops import pallas_packer
+        from karpenter_tpu.ops.packer import compact_take, expand_take
 
         self.last_kernel = (
             pallas_packer.LAST_KERNEL
             if self.pack_fn is auto_pack
             else getattr(self.pack_fn, "kernel_name", "custom")
         )
-        # one transfer for everything decode needs (the device link may be
-        # high-latency; per-array fetches would pay the round trip each)
-        take, leftover, node_cfg, node_used = jax.device_get(
-            (result.take, result.leftover, result.node_cfg, result.node_used)
-        )
+
+        def fetch(res):
+            # ONE transfer for everything decode needs (the device link may
+            # be high-latency; per-array fetches would pay the round trip
+            # each), with the big take matrix riding along sparsely
+            if isinstance(res.take, jax.Array):
+                vals, idx, nnz = compact_take(res.take)
+                vals, idx, nnz, lo, cfg, used = jax.device_get(
+                    (vals, idx, nnz, res.leftover, res.node_cfg, res.node_used)
+                )
+                return expand_take(vals, idx, nnz, res.take), lo, cfg, used
+            return jax.device_get(
+                (res.take, res.leftover, res.node_cfg, res.node_used)
+            )
+
+        take, leftover, node_cfg, node_used = fetch(result)
         # grow the slot bucket if the solve ran out of node slots while
         # feasible configs remained
         k = int(node_cfg.shape[0])
@@ -175,9 +187,7 @@ class TensorScheduler:
         while self._overflowed(prob, leftover) and k < max_k:
             k *= 2
             result = self.pack_fn(prob, k_slots=k, objective=self.objective)
-            take, leftover, node_cfg, node_used = jax.device_get(
-                (result.take, result.leftover, result.node_cfg, result.node_used)
-            )
+            take, leftover, node_cfg, node_used = fetch(result)
         return self._decode(prob, take, node_cfg, node_used)
 
     def _oracle(self, pods: List[Pod]) -> SchedulingResult:

@@ -152,6 +152,42 @@ class TestPallasParity:
         # existing slots filled first
         assert fused.take[:, :3].sum() > 0
 
+    def test_pool_priority_splits_signature_rows(self, setup):
+        """Classes sharing a constraint signature can carry DIFFERENT
+        feasibility rows (the pool-weight priority pass restricts per
+        class by request size) — the fused kernel must not collapse them
+        onto one admission row.  Regression: small pods restricted to a
+        high-weight small-instance pool once masked the big pods' only
+        feasible (low-weight) pool, leaving them unschedulable."""
+        from karpenter_tpu.api import Requirements
+        from karpenter_tpu.testing import Environment
+
+        env = Environment()
+        nc = env.default_node_class()
+        small = env.default_node_pool(
+            name="small",
+            weight=100,
+            requirements=Requirements(
+                [Requirement(L.LABEL_INSTANCE_CPU, Op.LT, ["9"])]
+            ),
+        )
+        big = env.default_node_pool(
+            name="big",
+            weight=0,
+            requirements=Requirements(
+                [Requirement(L.LABEL_INSTANCE_CPU, Op.GT, ["31"])]
+            ),
+        )
+        inventory = {
+            "small": env.instance_types.list(small, nc),
+            "big": env.instance_types.list(big, nc),
+        }
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(30)]
+        pods += [Pod(requests=Resources(cpu=24, memory="48Gi")) for _ in range(10)]
+        prob = compile_problem(pods, [small, big], inventory)
+        fused = assert_parity(prob)
+        assert fused.leftover[: len(prob.classes)].sum() == 0
+
     def test_unsupported_raises(self, setup):
         env, pool, types = setup
         # more signatures than the VMEM state holds
