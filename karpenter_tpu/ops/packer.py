@@ -281,14 +281,15 @@ def expand_take(
 # device-resident constant caches, keyed by source-array identity with the
 # sources pinned in the entry so the id-based key stays sound (the same
 # pattern as TensorScheduler's catalog cache)
-def cached_device_put(cache: dict, srcs: tuple, extra_key: tuple, build):
+def cached_device_put(cache: dict, srcs: tuple, extra_key: tuple, build, shardings=None):
     import jax as _jax
 
     key = tuple(id(s) for s in srcs) + extra_key
     ent = cache.get(key)
     if ent is not None and all(a is b for a, b in zip(ent[0], srcs)):
         return ent[1]
-    dev = _jax.device_put(build())
+    built = build()
+    dev = _jax.device_put(built, shardings) if shardings else _jax.device_put(built)
     if len(cache) > 32:
         cache.clear()
     cache[key] = (srcs, dev)

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from karpenter_tpu.ops.packer import PackResult, pack_kernel
+from karpenter_tpu.ops.packer import PackResult, pack_kernel, pad_problem
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -39,16 +39,19 @@ MODEL_AXIS = "model"
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     """A 2D (data, model) mesh over the first `n_devices` devices.
 
-    Even device counts split (n/2, 2) so both axes are exercised; odd
-    counts degrade to (n, 1).
+    Both axis sizes are POWERS OF TWO (devices beyond the largest
+    power-of-two count are left out): the kernel's padded buckets are
+    power-of-two sized, and a non-power-of-two axis could not evenly
+    divide them.  Counts >= 2 split (n/2, 2) so both axes are exercised.
     """
     devices = jax.devices()
     n = n_devices if n_devices is not None else len(devices)
-    devices = devices[:n]
-    if n >= 2 and n % 2 == 0:
-        shape = (n // 2, 2)
-    else:
-        shape = (n, 1)
+    n = min(n, len(devices))
+    p2 = 1
+    while p2 * 2 <= n:
+        p2 *= 2
+    devices = devices[:p2]
+    shape = (p2 // 2, 2) if p2 >= 2 else (1, 1)
     return Mesh(np.array(devices).reshape(shape), (DATA_AXIS, MODEL_AXIS))
 
 
@@ -113,3 +116,90 @@ def sharded_solve_step(mesh: Mesh, k_slots: int):
             on_k2, on_k, on_k, repl, on_sk,  # bin state
         ),
     )
+
+
+# (mesh, k_slots, objective) -> jitted sharded pack; Mesh is hashable
+_SHARDED_PACK_CACHE: dict = {}
+
+
+def _sharded_pack(mesh: Mesh, k_slots: int, objective: str):
+    key = (mesh, k_slots, objective)
+    fn = _SHARDED_PACK_CACHE.get(key)
+    if fn is not None:
+        return fn
+    repl = NamedSharding(mesh, P())
+    on_c = NamedSharding(mesh, P(MODEL_AXIS))
+    on_c2 = NamedSharding(mesh, P(MODEL_AXIS, None))
+    on_gc = NamedSharding(mesh, P(None, MODEL_AXIS))
+    on_k = NamedSharding(mesh, P(DATA_AXIS))
+    on_k2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    on_sk = NamedSharding(mesh, P(None, DATA_AXIS))
+
+    def step(
+        req, cnt, maxper, slot, feas, alloc, price, openable,
+        used0, cfg0, npods0, next0, sig0,
+    ) -> PackResult:
+        return pack_kernel(
+            req, cnt, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, next0, sig0,
+            k_slots=k_slots, objective=objective,
+        )
+
+    fn = jax.jit(
+        step,
+        in_shardings=(
+            repl, repl, repl, repl,  # class tensors (scan xs)
+            on_gc,  # feas [G, C] — config axis sharded over "model"
+            on_c2, on_c, on_c,  # catalog: alloc, price, openable
+            on_k2, on_k, on_k, repl, on_sk,  # bin state over "data"
+        ),
+    )
+    _SHARDED_PACK_CACHE[key] = fn
+    return fn
+
+
+_MESH_CONST_CACHE: dict = {}
+
+
+def mesh_pack_fn(mesh: Optional[Mesh] = None):
+    """A TensorScheduler ``pack_fn`` that runs the packing kernel sharded
+    over a device mesh: the node-slot state over "data", the config
+    catalog over "model", with XLA SPMD inserting the collectives (the
+    K-cumsum becomes a collective prefix, the per-class config argmin an
+    all-reduce).  Drop-in for ops.packer.run_pack — same padding, same
+    PackResult contract, same upload hygiene (bit-packed feasibility,
+    catalog constants uploaded once per snapshot with their target
+    shardings) — so the whole production solve path (compile -> pack ->
+    decode) runs multi-chip without further changes."""
+    from karpenter_tpu.ops.packer import cached_device_put, node_slot_bound
+
+    if mesh is None:
+        mesh = make_mesh()
+    dp = mesh.devices.shape[0]
+    on_c = NamedSharding(mesh, P(MODEL_AXIS))
+    on_c2 = NamedSharding(mesh, P(MODEL_AXIS, None))
+
+    def pack(prob, k_slots: int = 0, objective: str = "nodes") -> PackResult:
+        # the "data" axis shards the node-slot bucket; keep it divisible
+        if k_slots <= 0:
+            k_slots = node_slot_bound(prob)
+        k_slots = max(k_slots, 8 * dp)
+        args, kp = pad_problem(prob, k_slots)
+        (req, cnt, maxper, slot, feas, alloc, price, openable,
+         used0, cfg0, npods0, e0, sig0) = args
+        feas = np.packbits(feas, axis=1, bitorder="little")
+        alloc, price, openable = cached_device_put(
+            _MESH_CONST_CACHE,
+            (prob.alloc, prob.price, prob.openable),
+            (alloc.shape, mesh),
+            lambda: (alloc, price, openable),
+            shardings=(on_c2, on_c, on_c),
+        )
+        return _sharded_pack(mesh, kp, objective)(
+            req, cnt, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, e0, sig0,
+        )
+
+    pack.kernel_name = "scan-sharded"
+    pack.mesh = mesh
+    return pack

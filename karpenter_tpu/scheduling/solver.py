@@ -39,6 +39,32 @@ from karpenter_tpu.scheduling.scheduler import (
 from karpenter_tpu.state.cluster import StateNode
 
 
+def default_pack_fn():
+    """Backend selection for the device half of the solve.
+
+    - multi-device TPU slice (or ``KARPENTER_TPU_SHARDED=1``): the
+      mesh-sharded kernel from parallel/mesh.py — node-slot state over
+      "data", config catalog over "model", XLA collectives over ICI.
+    - otherwise: auto_pack (fused Pallas kernel for large heterogeneous
+      batches on one TPU, the lax.scan kernel elsewhere).
+    """
+    import os
+
+    import jax
+
+    forced = os.environ.get("KARPENTER_TPU_SHARDED", "")
+    devices = jax.devices()
+    if forced == "1" or (
+        forced != "0"
+        and len(devices) > 1
+        and devices[0].platform == "tpu"
+    ):
+        from karpenter_tpu.parallel.mesh import mesh_pack_fn
+
+        return mesh_pack_fn()
+    return auto_pack
+
+
 class TensorScheduler:
     """Drop-in replacement for the oracle `Scheduler` backed by the kernel."""
 
@@ -50,7 +76,7 @@ class TensorScheduler:
         daemonsets: Sequence[Pod] = (),
         zones: Sequence[str] = (),
         objective: str = "nodes",
-        pack_fn=auto_pack,
+        pack_fn=None,
     ):
         self.pools = list(pools)
         self.instance_types = instance_types
@@ -58,8 +84,13 @@ class TensorScheduler:
         self.daemonsets = list(daemonsets)
         self.zones = list(zones)
         self.objective = objective
-        # the device half of the solve: local run_pack by default, or a
-        # sidecar's RemoteSolver.pack_problem (service/client.py)
+        # the device half of the solve: the default (None) resolves to the
+        # mesh-sharded kernel on a multi-chip slice / auto_pack on one
+        # device — LAZILY, at the first solve, because resolving queries
+        # jax.devices() and initializing the backend at construction time
+        # would break callers that pin the platform afterward
+        # (testing.pin_cpu_platform).  Callers may pass a sidecar's
+        # RemoteSolver.pack_problem (service/client.py) or a forced kernel.
         self.pack_fn = pack_fn
         self.last_path = ""  # "tensor" | "oracle" | "hybrid" (observability)
         self.last_kernel = ""  # "pallas" | "scan" | "" (oracle)
@@ -155,6 +186,8 @@ class TensorScheduler:
         if not prob.supported:
             return None
         self.last_path = "tensor"
+        if self.pack_fn is None:
+            self.pack_fn = default_pack_fn()
         result = self.pack_fn(prob, objective=self.objective)
         from karpenter_tpu.ops import pallas_packer
         from karpenter_tpu.ops.packer import compact_take, expand_take

@@ -85,6 +85,33 @@ def test_sharded_matches_single_device(problem):
     )
 
 
+def test_mesh_pack_fn_flagship_scale_through_scheduler():
+    """The production multi-chip path (TensorScheduler with the
+    mesh-sharded pack_fn) must decode identical placements to the
+    single-device default at bench scale — the flagship 10k-pod x
+    ~500-type problem sharded over the 8-device CPU mesh."""
+    import bench
+    from karpenter_tpu.parallel.mesh import mesh_pack_fn
+    from karpenter_tpu.scheduling.solver import TensorScheduler
+
+    pool, types, pods = bench.build_problem()
+
+    single = TensorScheduler([pool], {pool.name: types}).solve(pods)
+    sharded = TensorScheduler(
+        [pool], {pool.name: types}, pack_fn=mesh_pack_fn(make_mesh(8))
+    ).solve(pods)
+
+    assert not single.unschedulable and not sharded.unschedulable
+    assert len(single.new_nodes) == len(sharded.new_nodes)
+    s_sizes = sorted(
+        (len(n.pods), n.feasible_types[0].name) for n in single.new_nodes
+    )
+    m_sizes = sorted(
+        (len(n.pods), n.feasible_types[0].name) for n in sharded.new_nodes
+    )
+    assert s_sizes == m_sizes
+
+
 def test_all_pods_placed_or_leftover(problem):
     p = problem
     K = p["used0"].shape[0]
