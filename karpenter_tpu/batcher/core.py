@@ -27,17 +27,16 @@ TERMINATE_WINDOWS = (0.1, 1.0, 500)
 
 @dataclass
 class BatchStats:
-    """Observability counters (reference batcher/metrics.go emits batch
-    size / window-duration metrics)."""
+    """Running totals; per-batch distributions live in the metrics
+    registry (karpenter_cloudprovider_batcher_batch_size/_time_seconds,
+    reference batcher/metrics.go)."""
 
     batches: int = 0
     items: int = 0
-    sizes: List[int] = field(default_factory=list)
 
     def record(self, size: int) -> None:
         self.batches += 1
         self.items += size
-        self.sizes.append(size)
 
 
 class Batcher:
@@ -59,6 +58,7 @@ class Batcher:
         max_items: int = 1000,
         hasher: Callable[[Any], Hashable] = lambda _req: 0,
         name: str = "batcher",
+        registry=None,
     ):
         self.executor = executor
         self.idle_s = idle_s
@@ -67,6 +67,11 @@ class Batcher:
         self.hasher = hasher
         self.name = name
         self.stats = BatchStats()
+        # exported as karpenter_cloudprovider_batcher_batch_size /
+        # _batch_time_seconds{batcher} (reference pkg/batcher/metrics.go)
+        if registry is None:
+            from karpenter_tpu.metrics.registry import REGISTRY as registry
+        self.registry = registry
         self._lock = threading.Lock()
         self._buckets: Dict[Hashable, _Bucket] = {}
 
@@ -130,6 +135,15 @@ class _Bucket:
         requests = [r for r, _ in self.items]
         futures = [f for _, f in self.items]
         self.parent.stats.record(len(requests))
+        labels = {"batcher": self.parent.name}
+        self.parent.registry.observe(
+            "karpenter_cloudprovider_batcher_batch_size", len(requests), labels
+        )
+        self.parent.registry.observe(
+            "karpenter_cloudprovider_batcher_batch_time_seconds",
+            time.monotonic() - self._first_at,
+            labels,
+        )
         try:
             results = self.parent.executor(requests)
             if len(results) != len(requests):

@@ -135,6 +135,7 @@ class QueueMessage:
     id: str
     body: dict
     receipt: str = ""
+    enqueued_at: float = 0.0  # queue-side timestamp (SQS SentTimestamp)
 
 
 class _CallRecorder:
@@ -458,7 +459,13 @@ class FakeCloud:
     # -------------------------------------------------------------- queue
     def send_message(self, body: dict) -> None:
         with self._lock:
-            self.queue.append(QueueMessage(id=f"m-{next(self._seq)}", body=body))
+            self.queue.append(
+                QueueMessage(
+                    id=f"m-{next(self._seq)}",
+                    body=body,
+                    enqueued_at=self.clock.now(),
+                )
+            )
 
     def receive_messages(self, max_messages: int = 10) -> List[QueueMessage]:
         with self._lock:

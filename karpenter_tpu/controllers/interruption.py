@@ -86,9 +86,18 @@ class InterruptionController:
             for c in self.kube.node_claims.values()
             if c.provider_id
         }
+        now = self.cloud.clock.now()
         for msg in messages:
+            if msg.enqueued_at:
+                # end-to-end reaction latency (reference
+                # interruption/metrics.go message latency histogram)
+                self.registry.observe(
+                    "karpenter_interruption_message_latency_time_seconds",
+                    max(now - msg.enqueued_at, 0.0),
+                )
             self._handle(msg, claims_by_instance)
             self.cloud.delete_message(msg)
+            self.registry.inc("karpenter_interruption_deleted_messages")
 
     def _handle(self, msg: QueueMessage, claims: Dict[str, NodeClaim]) -> None:
         parsed = _parse(msg.body)
@@ -116,5 +125,9 @@ class InterruptionController:
                 )
         self.kube.record_event(
             "NodeClaim", "Interruption", claim.name, parsed.kind
+        )
+        self.registry.inc(
+            "karpenter_interruption_actions_performed",
+            {"action": "CordonAndDrain", "message_type": parsed.kind},
         )
         self.termination.mark_for_deletion(claim, reason=parsed.kind)

@@ -81,6 +81,13 @@ class TerminationController:
         self.registry.inc(
             "karpenter_nodes_terminated", {"nodepool": claim.pool_name}
         )
+        # deletion-stamp -> gone latency (reference
+        # karpenter_nodes_termination_time_seconds)
+        self.registry.observe(
+            "karpenter_nodes_termination_time_seconds",
+            max(self.clock.now() - claim.deleted_at, 0.0),
+            {"nodepool": claim.pool_name},
+        )
 
     # -------------------------------------------------------------- internals
     def _cordon(self, node: Node) -> None:

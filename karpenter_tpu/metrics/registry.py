@@ -12,8 +12,26 @@ dump — the reference's ~50 published metrics map onto these names, e.g.
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+# per-series sample window kept for test/debug inspection; count/sum run
+# unbounded so dump() stays exact while memory stays O(1) per series
+_HIST_WINDOW = 1024
+
+
+class _Hist:
+    __slots__ = ("count", "total", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.samples: deque = deque(maxlen=_HIST_WINDOW)
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.samples.append(value)
 
 
 def _key(labels: Optional[Mapping[str, str]]) -> Tuple:
@@ -27,8 +45,8 @@ class Registry:
             lambda: defaultdict(float)
         )
         self.gauges: Dict[str, Dict[Tuple, float]] = defaultdict(dict)
-        self.histograms: Dict[str, Dict[Tuple, List[float]]] = defaultdict(
-            lambda: defaultdict(list)
+        self.histograms: Dict[str, Dict[Tuple, _Hist]] = defaultdict(
+            lambda: defaultdict(_Hist)
         )
 
     # ------------------------------------------------------------- recording
@@ -42,7 +60,22 @@ class Registry:
 
     def observe(self, name: str, value: float, labels: Optional[Mapping[str, str]] = None):
         with self._lock:
-            self.histograms[name][_key(labels)].append(value)
+            self.histograms[name][_key(labels)].observe(value)
+
+    def reset_gauge(self, name: str):
+        """Drop every series of a gauge family — used by collectors that
+        re-emit their full set each reconcile so vanished nodes/pools do
+        not leave stale series behind."""
+        with self._lock:
+            self.gauges.pop(name, None)
+
+    def unset(self, name: str, labels: Optional[Mapping[str, str]] = None):
+        """Drop ONE gauge series (collectors that prune their own emitted
+        key set instead of resetting the whole family)."""
+        with self._lock:
+            series = self.gauges.get(name)
+            if series is not None:
+                series.pop(_key(labels), None)
 
     class _Timer:
         def __init__(self, registry: "Registry", name: str, labels):
@@ -73,7 +106,9 @@ class Registry:
         return self.gauges.get(name, {}).get(_key(labels))
 
     def histogram(self, name: str, labels: Optional[Mapping[str, str]] = None) -> List[float]:
-        return list(self.histograms.get(name, {}).get(_key(labels), ()))
+        """Recent samples of a series (bounded window; see _HIST_WINDOW)."""
+        h = self.histograms.get(name, {}).get(_key(labels))
+        return list(h.samples) if h is not None else []
 
     def dump(self) -> str:
         """Prometheus-text-style dump (for the /metrics analogue)."""
@@ -86,9 +121,9 @@ class Registry:
                 for labels, v in sorted(series.items()):
                     lines.append(f"{name}{_fmt(labels)} {v:g}")
             for name, series in sorted(self.histograms.items()):
-                for labels, vs in sorted(series.items()):
-                    lines.append(f"{name}_count{_fmt(labels)} {len(vs)}")
-                    lines.append(f"{name}_sum{_fmt(labels)} {sum(vs):g}")
+                for labels, h in sorted(series.items()):
+                    lines.append(f"{name}_count{_fmt(labels)} {h.count}")
+                    lines.append(f"{name}_sum{_fmt(labels)} {h.total:g}")
         return "\n".join(lines)
 
 

@@ -29,6 +29,8 @@ from karpenter_tpu.controllers.nodeclass import NodeClassController
 from karpenter_tpu.controllers.provisioning import Provisioner
 from karpenter_tpu.controllers.tagging import TaggingController
 from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.controllers.metrics_state import MetricsStateController
+from karpenter_tpu.metrics.decorators import MetricsCloudProvider
 from karpenter_tpu.metrics.registry import REGISTRY, Registry
 from karpenter_tpu.providers.image import ImageProvider, Resolver
 from karpenter_tpu.providers.instance import InstanceProvider
@@ -85,22 +87,28 @@ class Operator:
         )
         self.instance_types = InstanceTypeProvider(
             cloud, self.pricing, self.subnets, self.unavailable,
-            self.settings, self.clock,
+            self.settings, self.clock, registry=registry,
         )
         self.instances = InstanceProvider(
             cloud, self.subnets, self.launch_templates, self.unavailable,
             tags=self.settings.tags, batch_windows=batch_windows,
+            registry=registry,
         )
-        self.cloud_provider = CloudProvider(
-            cloud,
-            kube,
-            ProviderBundle(
-                instance_types=self.instance_types,
-                instances=self.instances,
-                images=self.images,
-                subnets=self.subnets,
-                security_groups=self.security_groups,
+        # duration/error decoration mirrors reference main.go:46
+        # (metrics.Decorate(cloudProvider))
+        self.cloud_provider = MetricsCloudProvider(
+            CloudProvider(
+                cloud,
+                kube,
+                ProviderBundle(
+                    instance_types=self.instance_types,
+                    instances=self.instances,
+                    images=self.images,
+                    subnets=self.subnets,
+                    security_groups=self.security_groups,
+                ),
             ),
+            registry=registry,
         )
 
         # ---- controllers (conditional registration mirrors
@@ -134,23 +142,45 @@ class Operator:
             self.interruption = InterruptionController(
                 kube, cloud, self.termination, self.unavailable, registry
             )
+        self.metrics_state = MetricsStateController(
+            kube, self.cluster, self.clock, registry
+        )
         self._pricing_updated_at = self.clock.now()
         self._stop = threading.Event()
 
     # ------------------------------------------------------------------ loop
+    def _reconcile(self, name: str, controller) -> None:
+        """One controller tick with reconcile metrics (the analogue of the
+        controller-runtime `controller_runtime_reconcile_*` series every
+        reference controller exports)."""
+        labels = {"controller": name}
+        self.registry.inc("karpenter_controller_reconcile_total", labels)
+        with self.registry.time(
+            "karpenter_controller_reconcile_time_seconds", labels
+        ):
+            try:
+                controller.reconcile()
+            except Exception:
+                self.registry.inc(
+                    "karpenter_controller_reconcile_errors_total", labels
+                )
+                raise
+
     def reconcile_once(self) -> None:
         """One tick of every control loop, in a stable order: status
         resolution, provisioning, lifecycle, events, disruption, cleanup."""
-        self.node_class_controller.reconcile()
-        self.provisioner.reconcile()
-        self.lifecycle.reconcile()
+        self._reconcile("nodeclass", self.node_class_controller)
+        self._reconcile("provisioner", self.provisioner)
+        self._reconcile("lifecycle", self.lifecycle)
         if self.interruption is not None:
-            self.interruption.reconcile()
-        self.disruption.reconcile()
-        self.termination.reconcile()
-        self.link.reconcile()  # adopt before GC lists, so no race to reap
-        self.garbage_collection.reconcile()
-        self.tagging.reconcile()
+            self._reconcile("interruption", self.interruption)
+        self._reconcile("disruption", self.disruption)
+        self._reconcile("termination", self.termination)
+        # adopt before GC lists, so no race to reap
+        self._reconcile("link", self.link)
+        self._reconcile("garbagecollection", self.garbage_collection)
+        self._reconcile("tagging", self.tagging)
+        self._reconcile("metrics_state", self.metrics_state)
         # 12h pricing refresh (reference pricing/controller.go:39-41)
         if self.clock.now() - self._pricing_updated_at >= PRICING_UPDATE_PERIOD:
             if not self.settings.isolated_vpc:

@@ -60,6 +60,7 @@ class InstanceProvider:
         unavailable: UnavailableOfferings,
         tags: Optional[Mapping[str, str]] = None,
         batch_windows: Optional[dict] = None,
+        registry=None,
     ):
         self.cloud = cloud
         self.subnets = subnets
@@ -76,17 +77,17 @@ class InstanceProvider:
             executor=self._exec_create_fleet,
             idle_s=cf[0], max_s=cf[1], max_items=cf[2],
             hasher=lambda req: req["hash"],
-            name="create-fleet",
+            name="create-fleet", registry=registry,
         )
         self._describe_batcher = Batcher(
             executor=self._exec_describe,
             idle_s=de[0], max_s=de[1], max_items=de[2],
-            name="describe-instances",
+            name="describe-instances", registry=registry,
         )
         self._terminate_batcher = Batcher(
             executor=self._exec_terminate,
             idle_s=te[0], max_s=te[1], max_items=te[2],
-            name="terminate-instances",
+            name="terminate-instances", registry=registry,
         )
 
     # ------------------------------------------------------------------ create

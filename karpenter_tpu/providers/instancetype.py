@@ -71,6 +71,7 @@ class InstanceTypeProvider:
         unavailable: UnavailableOfferings,
         settings: Settings,
         clock: Clock,
+        registry=None,
     ):
         self.cloud = cloud
         self.pricing = pricing
@@ -79,6 +80,13 @@ class InstanceTypeProvider:
         self.settings = settings
         self._cache = TTLCache(clock, INSTANCE_TYPES_ZONES_TTL)
         self.catalog_seq = 0  # bump when the catalog changes
+        if registry is None:
+            from karpenter_tpu.metrics.registry import REGISTRY as registry
+        self.registry = registry
+        # (metric, label tuple) keys this provider has emitted, so stale
+        # series for types/offerings that left the catalog get pruned
+        self._exported: set = set()
+        self._export_epoch: tuple = ()
 
     # ------------------------------------------------------------------ list
     def list(
@@ -108,7 +116,54 @@ class InstanceTypeProvider:
             for name, shape in sorted(shapes.items())
         ]
         self._cache.set(key, out)
+        self._export_gauges(out)
         return out
+
+    def _export_gauges(self, types: List[InstanceType]) -> None:
+        """Per-type vCPU/memory/price gauges (reference
+        pkg/providers/instancetype/metrics.go:1-56).  The emitted key set
+        is tracked so series for types/offerings no longer in the catalog
+        are pruned (a family-wide reset would be wrong: different node
+        classes legitimately emit different zone subsets)."""
+        emitted: set = set()
+
+        def put(metric: str, value: float, labels: dict) -> None:
+            self.registry.set(metric, value, labels)
+            emitted.add((metric, tuple(sorted(labels.items()))))
+
+        for it in types:
+            label = {"instance_type": it.name}
+            put(
+                "karpenter_cloudprovider_instance_type_cpu_cores",
+                it.capacity.cpu,
+                label,
+            )
+            put(
+                "karpenter_cloudprovider_instance_type_memory_bytes",
+                it.capacity.memory,
+                label,
+            )
+            for off in it.offerings:
+                put(
+                    "karpenter_cloudprovider_instance_type_price_estimate",
+                    off.price,
+                    {
+                        "instance_type": it.name,
+                        "capacity_type": off.capacity_type,
+                        "zone": off.zone,
+                    },
+                )
+        # prune only when the CATALOG changed: within one epoch, calls for
+        # different node classes legitimately emit different zone subsets,
+        # and their union is the live series set
+        epoch = (self.catalog_seq, self.unavailable.seq_num)
+        if epoch != self._export_epoch:
+            for metric, key in self._exported - emitted:
+                self.registry.unset(metric, dict(key))
+            self._exported = emitted
+            self._export_epoch = epoch
+        else:
+            self._exported |= emitted
 
     def _zones(self, node_class: Optional[NodeClass]) -> List[str]:
         if node_class is not None and node_class.subnet_selector_terms:
