@@ -1,0 +1,141 @@
+"""Deployable controller entry point: ``python -m karpenter_tpu``.
+
+The analogue of the reference's controller binary
+(cmd/controller/main.go:33-70): resolve Settings (file > env > defaults),
+build the Operator (DI root: caches, providers, CloudProvider facade,
+controllers), optionally point the provisioner's solver at a remote
+sidecar (service/server.py), expose the metrics dump over HTTP, and run
+the reconcile loop until SIGINT/SIGTERM.
+
+The cloud backend is pluggable; this process wires the in-repo simulation
+backend (cloud/fake/backend.py) — a real deployment substitutes its cloud
+by constructing the Operator with a different backend, exactly as the
+reference swaps fake and AWS session clients.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from karpenter_tpu.api import Settings
+from karpenter_tpu.cloud.fake.backend import FakeCloud
+from karpenter_tpu.metrics.registry import REGISTRY
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.state.kube import KubeStore
+
+log = logging.getLogger("karpenter_tpu")
+
+
+def _metrics_server(port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path not in ("/metrics", "/healthz"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = (
+                b"ok" if self.path == "/healthz" else REGISTRY.dump().encode()
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet access log
+            pass
+
+    server = ThreadingHTTPServer(("", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m karpenter_tpu")
+    parser.add_argument(
+        "--settings-file",
+        help="JSON settings file (the karpenter-global-settings configmap "
+        "analogue); KARPENTER_* env vars apply when omitted",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=1.0, help="reconcile interval (s)"
+    )
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=8080,
+        help="HTTP port for /metrics and /healthz (0 disables)",
+    )
+    parser.add_argument(
+        "--solver-address",
+        default="",
+        help="host:port of a solver sidecar (service/server.py); the "
+        "in-process kernel is used when omitted",
+    )
+    parser.add_argument(
+        "--dump-settings", action="store_true",
+        help="print the resolved settings and exit",
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+    if args.settings_file:
+        settings = Settings.from_file(args.settings_file)
+    else:
+        settings = Settings.from_env()
+    settings.validate()
+    if args.dump_settings:
+        print(json.dumps(settings.__dict__, default=str, indent=2))
+        return 0
+
+    from karpenter_tpu.cloud.fake.backend import generate_catalog
+    from karpenter_tpu.utils.clock import Clock
+
+    cloud = FakeCloud(
+        Clock(), shapes=generate_catalog()
+    ).with_default_topology()
+    kube = KubeStore()
+    operator = Operator(cloud, kube, settings=settings)
+
+    if args.solver_address:
+        from karpenter_tpu.service.client import RemoteSolver
+
+        host, _, port = args.solver_address.partition(":")
+        # default port matches service/server.py's listener
+        remote = RemoteSolver(host, int(port)) if port else RemoteSolver(host)
+        operator.provisioner.scheduler.pack_fn = remote.pack_problem
+        log.info("solver sidecar at %s", args.solver_address)
+
+    server = None
+    if args.metrics_port:
+        server = _metrics_server(args.metrics_port)
+        log.info("metrics on :%d/metrics", args.metrics_port)
+
+    def _stop(_sig, _frame):
+        log.info("shutting down")
+        operator.stop()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    log.info(
+        "karpenter-tpu controller running (cluster=%s, interval=%.1fs)",
+        settings.cluster_name,
+        args.interval,
+    )
+    operator.run(interval_s=args.interval)
+    if server is not None:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,12 @@ class Resources:
     def is_zero(self) -> bool:
         return all(v == 0.0 for v in self._q.values())
 
+    def is_empty(self) -> bool:
+        """No axes at all — distinct from is_zero(): `limits: {cpu: 0}`
+        has an axis (and means "provision nothing"), `limits: {}` has none
+        (and means "unlimited")."""
+        return not self._q
+
     @property
     def cpu(self) -> float:
         return self.get("cpu")

@@ -23,6 +23,47 @@ class Settings:
     batch_idle_duration: float = 1.0
     batch_max_duration: float = 10.0
 
+    @classmethod
+    def from_file(cls, path: str) -> "Settings":
+        """Load from a JSON file — the configmap analogue
+        (karpenter-global-settings, reference settings.go:48-61)."""
+        import json
+
+        with open(path) as f:
+            raw = json.load(f)
+        known = {f.name for f in cls.__dataclass_fields__.values()}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown settings: {sorted(unknown)}")
+        return cls(**raw)
+
+    @classmethod
+    def from_env(cls, environ=None) -> "Settings":
+        """Load from KARPENTER_* environment variables (the CLI/env layer
+        of the reference's 3-tier config, website v0.31 settings.md:15-27):
+        KARPENTER_CLUSTER_NAME, KARPENTER_CLUSTER_ENDPOINT,
+        KARPENTER_ISOLATED_VPC, KARPENTER_INTERRUPTION_QUEUE_NAME, ..."""
+        import json
+        import os
+
+        environ = environ if environ is not None else os.environ
+        kw: Dict[str, object] = {}
+        for f in cls.__dataclass_fields__.values():
+            raw = environ.get(f"KARPENTER_{f.name.upper()}")
+            if raw is None:
+                continue
+            if f.type in ("bool", bool):
+                kw[f.name] = raw.lower() in ("1", "true", "yes")
+            elif f.type in ("float", float):
+                kw[f.name] = float(raw)
+            elif f.type in ("int", int):
+                kw[f.name] = int(raw)
+            elif f.name == "tags":
+                kw[f.name] = json.loads(raw)
+            else:
+                kw[f.name] = raw
+        return cls(**kw)
+
     def validate(self) -> None:
         if not self.cluster_name:
             raise ValueError("cluster_name is required")

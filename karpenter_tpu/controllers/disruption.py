@@ -292,7 +292,7 @@ class DisruptionController:
         # pool usage, so the projection must stay inside pool.limits — the
         # same admission the provisioner applies (designs/limits.md)
         pool = vnode.pool
-        if pool.limits and not pool.limits.is_zero():
+        if not pool.limits.is_empty():
             it = next(iter(vnode.final_instance_types()), None)
             estimate = it.capacity if it is not None else vnode.used
             if (self.cluster.pool_usage(pool.name) + estimate).exceeds(

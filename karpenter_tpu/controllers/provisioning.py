@@ -144,6 +144,28 @@ class Provisioner:
                 log.warning("inventory for pool %s failed: %s", pool.name, exc)
                 inventory[pool.name] = []
         snapshot = self.cluster.snapshot()
+        # limits-aware participation (reference designs/limits.md: a
+        # provisioner at its limits stops launching): a limited pool only
+        # offers the solve instance types that still FIT its remaining
+        # headroom — otherwise the launch admission rejects every claim
+        # and the batch's pods ping-pong on the full pool forever instead
+        # of SPILLING to the next pool by weight.  The solve ALWAYS runs
+        # (existing-node placement must work even with every pool limited
+        # out); launch admission still bounds the batch's cumulative
+        # overshoot, and convergence is across provisioning loops, like
+        # the reference.
+        usage_by_pool: Dict[str, Resources] = {}
+        for sn in snapshot:
+            if sn.pool_name and not sn.marked_for_deletion():
+                cap = sn.capacity if sn.capacity else sn.allocatable
+                usage_by_pool[sn.pool_name] = (
+                    usage_by_pool.get(sn.pool_name, Resources()) + cap
+                )
+        for pool in pools:
+            inventory[pool.name] = self._headroom_types(
+                pool, inventory[pool.name],
+                usage_by_pool.get(pool.name, Resources()),
+            )
         scheduler = self.scheduler.update(
             pools,
             inventory,
@@ -163,6 +185,27 @@ class Provisioner:
             self.cluster.nominate(pod_key, node_name)
         return self._launch(result)
 
+    def _headroom_types(self, pool, types, usage: Resources) -> list:
+        """The pool's instance types that still fit inside its remaining
+        limit headroom on every limited axis.  Returns the ORIGINAL list
+        object when nothing is filtered, preserving the identity-keyed
+        catalog cache upstream."""
+        if pool.limits.is_empty():
+            return types
+        remaining = {
+            axis: limit - usage.get(axis)
+            for axis, limit in pool.limits.items()
+        }
+        out = [
+            it
+            for it in types
+            if all(
+                it.capacity.get(axis) <= room + 1e-9
+                for axis, room in remaining.items()
+            )
+        ]
+        return types if len(out) == len(types) else out
+
     def _launch(self, result: SchedulingResult) -> List[NodeClaim]:
         claims: List[tuple] = []  # (claim, vnode)
         usage: Dict[str, Resources] = {}
@@ -171,7 +214,7 @@ class Provisioner:
             claim = self._claim_from_vnode(vn)
             # pool limits (reference designs/limits.md): projected usage
             # including in-flight claims must stay inside pool.limits
-            if pool.limits and not pool.limits.is_zero():
+            if not pool.limits.is_empty():
                 current = usage.get(pool.name)
                 if current is None:
                     current = self.cluster.pool_usage(pool.name)

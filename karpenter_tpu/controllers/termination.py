@@ -129,12 +129,5 @@ class TerminationController:
         return blocking
 
     def _evict(self, pod: Pod) -> None:
-        """Eviction: controller-owned pods go back to Pending (their
-        controller recreates them); bare pods are deleted."""
         self.registry.inc("karpenter_pods_evicted")
-        if pod.has_controller:
-            pod.node_name = ""
-            pod.phase = "Pending"
-            self.kube._notify("Pod", "evict", pod)
-        else:
-            self.kube.delete_pod(pod.key())
+        self.kube.evict_pod(pod.key())

@@ -95,7 +95,9 @@ class Registry:
             )
             return False
 
-    def time(self, name: str, labels: Optional[Mapping[str, str]] = None) -> "_Timer":
+    def time(
+        self, name: str, labels: Optional[Mapping[str, str]] = None
+    ) -> "Registry._Timer":
         return Registry._Timer(self, name, labels)
 
     # ------------------------------------------------------------- reading

@@ -29,6 +29,7 @@ from karpenter_tpu.controllers.nodeclass import NodeClassController
 from karpenter_tpu.controllers.provisioning import Provisioner
 from karpenter_tpu.controllers.tagging import TaggingController
 from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.controllers.consistency import ConsistencyController
 from karpenter_tpu.controllers.metrics_state import MetricsStateController
 from karpenter_tpu.metrics.decorators import MetricsCloudProvider
 from karpenter_tpu.metrics.registry import REGISTRY, Registry
@@ -145,6 +146,9 @@ class Operator:
         self.metrics_state = MetricsStateController(
             kube, self.cluster, self.clock, registry
         )
+        self.consistency = ConsistencyController(
+            kube, self.cluster, self.cloud_provider, self.clock, registry
+        )
         self._pricing_updated_at = self.clock.now()
         self._stop = threading.Event()
 
@@ -181,6 +185,7 @@ class Operator:
         self._reconcile("garbagecollection", self.garbage_collection)
         self._reconcile("tagging", self.tagging)
         self._reconcile("metrics_state", self.metrics_state)
+        self._reconcile("consistency", self.consistency)
         # 12h pricing refresh (reference pricing/controller.go:39-41)
         if self.clock.now() - self._pricing_updated_at >= PRICING_UPDATE_PERIOD:
             if not self.settings.isolated_vpc:

@@ -91,6 +91,11 @@ class Cluster:
     def nominated_node(self, pod_key: str) -> Optional[str]:
         return self._nominations.get(pod_key)
 
+    def nominations(self) -> List[tuple]:
+        """Snapshot of (pod key, target node/claim name) entries — the
+        read API for consumers like the consistency checker."""
+        return list(self._nominations.items())
+
     def snapshot(self) -> List[StateNode]:
         nodes: Dict[str, StateNode] = {}
         claims_by_provider = {

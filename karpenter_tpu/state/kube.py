@@ -115,6 +115,20 @@ class KubeStore:
         pod.phase = "Running"
         self._notify("Pod", "bind", pod)
 
+    def evict_pod(self, key: str) -> None:
+        """Eviction semantics: a controller-owned pod re-pends (its
+        controller recreates it); a bare pod is deleted — the Eviction API
+        analogue the termination controller drains with."""
+        pod = self.pods.get(key)
+        if pod is None:
+            return
+        if pod.has_controller:
+            pod.node_name = ""
+            pod.phase = "Pending"
+            self._notify("Pod", "evict", pod)
+        else:
+            self.delete_pod(key)
+
     # -- nodes ---------------------------------------------------------------
     def put_node(self, node: Node) -> Node:
         self.nodes[node.name] = node

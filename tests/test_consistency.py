@@ -1,0 +1,108 @@
+"""Consistency checker (SURVEY §2b core-controller list): cross-object
+invariant violations surface as events + karpenter_consistency_errors."""
+
+import pytest
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.controllers.consistency import CHECK_PERIOD
+from karpenter_tpu.testing import Environment
+
+
+@pytest.fixture()
+def env():
+    return Environment()
+
+
+def _provision(env, n=2):
+    env.default_node_class()
+    env.default_node_pool()
+    pods = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(n)]
+    for p in pods:
+        env.kube.put_pod(p)
+    env.settle()
+    assert not env.kube.pending_pods()
+    return pods
+
+
+def _run_checker(env):
+    env.clock.step(CHECK_PERIOD + 1)
+    env.operator.consistency.reconcile()
+
+
+def _violations(env, check):
+    return [
+        e
+        for e in env.kube.events
+        if e[1] == "ConsistencyViolation" and e[3].startswith(check)
+    ]
+
+
+class TestConsistency:
+    def test_healthy_cluster_is_quiet(self, env):
+        _provision(env)
+        _run_checker(env)
+        assert not _violations(env, "")
+        assert (
+            env.registry.counter(
+                "karpenter_consistency_errors", {"check": "claim-instance"}
+            )
+            == 0
+        )
+
+    def test_claim_without_instance(self, env):
+        _provision(env)
+        claim = next(iter(env.kube.node_claims.values()))
+        # instance vanishes behind karpenter's back (no event, no GC yet)
+        del env.cloud.instances[claim.provider_id]
+        _run_checker(env)
+        assert _violations(env, "claim-instance")
+        assert env.registry.counter(
+            "karpenter_consistency_errors", {"check": "claim-instance"}
+        ) >= 1
+
+    def test_node_without_claim(self, env):
+        _provision(env)
+        from karpenter_tpu.state.kube import Node
+
+        env.kube.put_node(
+            Node(
+                name="rogue",
+                provider_id="i-rogue",
+                labels={},
+                taints=[],
+                allocatable=Resources(cpu=4),
+                ready=True,
+            )
+        )
+        _run_checker(env)
+        assert _violations(env, "node-claim")
+
+    def test_capacity_lie(self, env):
+        _provision(env)
+        claim = next(iter(env.kube.node_claims.values()))
+        node = env.kube.node_by_provider_id(claim.provider_id)
+        node.allocatable = node.allocatable + Resources(cpu=1000)
+        _run_checker(env)
+        assert _violations(env, "capacity")
+
+    def test_pod_bound_to_missing_node(self, env):
+        pods = _provision(env)
+        pod = env.kube.pods[pods[0].key()]
+        pod.node_name = "never-existed"
+        _run_checker(env)
+        assert _violations(env, "pod-binding")
+
+    def test_stale_nomination(self, env):
+        _provision(env)
+        ghost = Pod(requests=Resources(cpu=1))
+        env.kube.put_pod(ghost)
+        env.cluster.nominate(ghost.key(), "missing-node")
+        _run_checker(env)
+        assert _violations(env, "nomination")
+
+    def test_rate_limited(self, env):
+        _provision(env)
+        env.operator.consistency.reconcile()
+        before = env.cloud.recorder.count("DescribeInstances")
+        env.operator.consistency.reconcile()  # within CHECK_PERIOD: no-op
+        assert env.cloud.recorder.count("DescribeInstances") == before

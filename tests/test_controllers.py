@@ -78,11 +78,12 @@ class TestProvisioning:
         add_pods(env, 200, cpu=4)
         env.step(0.1)
         env.step(1.1)
-        # limits cap provisioning: at most one small claim... with cpu limit
-        # of 2 nothing that fits 4-cpu pods can launch
+        # limits cap provisioning at SOLVE time: the pool only offers
+        # types within its headroom (<=2 cpu), so nothing that fits a
+        # 4-cpu pod can launch and the pods report unschedulable
         assert not env.kube.node_claims
         assert any(
-            e[1] == "LimitExceeded" for e in env.kube.events
+            e[1] == "FailedScheduling" for e in env.kube.events
         )
 
     def test_unschedulable_pod_emits_event(self, env, ready):

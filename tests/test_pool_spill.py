@@ -1,0 +1,92 @@
+"""Pool-priority spill (round-2 VERDICT weak item: the untested case of
+the host-side pool-priority pass): when the top-weight pool's limits fill
+mid-batch, the remaining pods must spill to the next pool by weight
+instead of ping-ponging on the full pool (reference
+designs/provisioner-priority.md + designs/limits.md)."""
+
+import pytest
+
+from karpenter_tpu.api import Disruption, Pod, Resources
+from karpenter_tpu.testing import Environment
+
+# consolidation frozen so the spill outcome stays observable (it would
+# otherwise legally repack everything onto one big overflow node)
+_NO_CONSOLIDATION = Disruption(consolidation_policy="WhenEmpty")
+
+
+@pytest.fixture()
+def env():
+    return Environment()
+
+
+def test_spill_to_lower_weight_pool_when_limits_fill(env):
+    env.default_node_class()
+    # reserved: preferred but tiny — fits roughly one mid-size node
+    env.default_node_pool(
+        name="reserved", weight=100, limits=Resources(cpu=40),
+        disruption=_NO_CONSOLIDATION,
+    )
+    env.default_node_pool(name="overflow", weight=0, disruption=_NO_CONSOLIDATION)
+    pods = [Pod(requests=Resources(cpu=4, memory="8Gi")) for _ in range(40)]
+    for p in pods:
+        env.kube.put_pod(p)
+    env.settle(max_rounds=40)
+    assert not env.kube.pending_pods(), len(env.kube.pending_pods())
+
+    by_pool = {}
+    for claim in env.kube.node_claims.values():
+        by_pool.setdefault(claim.pool_name, []).append(claim)
+    # the preferred pool was used...
+    assert "reserved" in by_pool, by_pool.keys()
+    # ...within its limits...
+    reserved_cpu = sum(c.capacity.cpu for c in by_pool["reserved"])
+    assert reserved_cpu <= 40
+    # ...and the overflow landed on the lower-weight pool
+    assert "overflow" in by_pool, by_pool.keys()
+
+
+def test_zero_limit_pool_provisions_nothing(env):
+    """`limits: {cpu: 0}` means "provision nothing" (the pause idiom,
+    api/resources.py), NOT "unlimited"."""
+    env.default_node_class()
+    env.default_node_pool(name="paused", limits=Resources(cpu=0))
+    env.kube.put_pod(Pod(requests=Resources(cpu=1)))
+    for _ in range(5):
+        env.step(2.0)
+    assert not env.kube.node_claims
+    assert env.kube.pending_pods()
+
+
+def test_existing_node_placement_survives_full_pools(env):
+    """Even with every pool limited out, the solve must still run so
+    pending pods land on existing nodes with free capacity."""
+    env.default_node_class()
+    pool = env.default_node_pool(name="only", disruption=_NO_CONSOLIDATION)
+    first = Pod(requests=Resources(cpu=2, memory="4Gi"))
+    env.kube.put_pod(first)
+    env.settle()
+    assert not env.kube.pending_pods()
+    # clamp the pool shut AFTER the first node exists
+    pool.limits = Resources(cpu=0.5)
+    second = Pod(requests=Resources(cpu=1, memory="1Gi"))
+    env.kube.put_pod(second)
+    env.settle()
+    assert not env.kube.pending_pods()
+    assert len(env.kube.node_claims) == 1  # no new node: placed on existing
+    assert env.kube.pods[second.key()].node_name
+
+
+def test_all_pools_at_limit_reports_unschedulable(env):
+    env.default_node_class()
+    env.default_node_pool(name="only", weight=10, limits=Resources(cpu=1))
+    pod = Pod(requests=Resources(cpu=4))
+    env.kube.put_pod(pod)
+    for _ in range(5):
+        env.step(2.0)
+    # the limited pool offers only <=1-cpu shapes; the 4-cpu pod cannot
+    # schedule and says so instead of looping silently
+    assert env.kube.pending_pods()
+    assert any(
+        e[1] == "FailedScheduling" and e[2] == pod.key()
+        for e in env.kube.events
+    ), env.kube.events[-5:]
