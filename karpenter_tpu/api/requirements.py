@@ -203,6 +203,17 @@ class Requirement:
                 return v
         return None
 
+    def single_value(self) -> Optional[str]:
+        """The value iff exactly one is admitted (determinate requirement);
+        None otherwise.  Only determinate keys may project to node labels
+        (reference pkg/scheduling/requirements.go Labels())."""
+        if self.complement:
+            return None
+        admitted = [v for v in self.values if self._bounds_admit(v)]
+        if len(admitted) == 1:
+            return admitted[0]
+        return None
+
     # -- plumbing ------------------------------------------------------------
     def __eq__(self, other) -> bool:
         return (
@@ -353,10 +364,12 @@ class Requirements:
         return False
 
     def labels(self) -> Dict[str, str]:
-        """Project determinate (single representative value) keys to labels."""
+        """Project DETERMINATE keys (exactly one admitted value) to labels.
+        Multi-valued keys (e.g. a type offered in three zones) must not
+        invent a label — the launched instance is authoritative for those."""
         out = {}
         for key, r in self._reqs.items():
-            v = r.any_value()
+            v = r.single_value()
             if v is not None:
                 out[key] = v
         return out

@@ -105,6 +105,20 @@ class CloudProvider:
         claim.capacity_type = instance.capacity_type
         claim.image_id = instance.image_id
         claim.created_at = instance.launch_time
+        if it is not None:
+            claim.labels.update(it.requirements.labels())
+            claim.capacity = it.capacity
+            claim.allocatable = it.allocatable()
+            off = [
+                o
+                for o in it.offerings
+                if o.zone == instance.zone
+                and o.capacity_type == instance.capacity_type
+            ]
+            if off:
+                claim.price = off[0].price
+        # the launched instance is authoritative for placement labels; it
+        # must win over any type-requirement projection
         claim.labels.update(
             {
                 L.LABEL_INSTANCE_TYPE: instance.instance_type,
@@ -114,18 +128,6 @@ class CloudProvider:
             }
         )
         claim.annotations[L.ANNOTATION_NODECLASS_HASH] = node_class.static_hash()
-        if it is not None:
-            claim.capacity = it.capacity
-            claim.allocatable = it.allocatable()
-            claim.labels.update(it.requirements.labels())
-            off = [
-                o
-                for o in it.offerings
-                if o.zone == instance.zone
-                and o.capacity_type == instance.capacity_type
-            ]
-            if off:
-                claim.price = off[0].price
 
     # ----------------------------------------------------------- get/list/del
     def get(self, provider_id: str) -> NodeClaim:

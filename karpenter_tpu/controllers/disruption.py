@@ -52,6 +52,10 @@ log = logging.getLogger(__name__)
 # pass (the reference bounds its subset search the same way)
 MULTI_NODE_CANDIDATES = 10
 
+# scheduling-simulation budget per multi-node pass: the drop-one
+# refinement evaluates at most this many candidate subsets
+MULTI_NODE_SIM_BUDGET = 24
+
 # how long a consolidation replacement may take to register+initialize
 # before the action is rolled back (the reference's machine liveness bound
 # is 15m; consolidation aborts much sooner when validation fails)
@@ -515,40 +519,112 @@ class DisruptionController:
         return False
 
     def _consolidate_multi(self, ranked: Sequence[Candidate]) -> bool:
-        """Largest prefix of the cost-ranked candidates whose pods fit on
-        the remaining nodes plus at most one cheaper replacement
-        (designs/consolidation.md mechanisms:5-21)."""
-        best: Optional[List[Candidate]] = None
-        best_vnode = None
-        best_price = 0.0
+        """Bounded SUBSET search over the top cost-ranked candidates: a
+        whole candidate set whose pods fit on the remaining nodes plus at
+        most one cheaper replacement (designs/consolidation.md
+        mechanisms:5-21).
+
+        A pure prefix scan misses sets that are non-contiguous in cost
+        order (one stubborn middle-ranked node — pinned pods, a full node
+        — poisons every prefix containing it).  The search therefore
+        descends by DROP-ONE refinement: evaluate the current set, then
+        every child obtained by removing one member; take the feasible
+        child with the largest savings, else trim the costliest member
+        and repeat.  The descent is memoized and capped at
+        MULTI_NODE_SIM_BUDGET simulations; the prefix-scan floor below
+        may add up to MULTI_NODE_CANDIDATES-1 more on cache misses, so a
+        pass is bounded by the sum of the two, not the budget alone."""
+        current = list(ranked[:MULTI_NODE_CANDIDATES])
+        if len(current) < 2:
+            return False
+        sims = 0
+        evaluated: Dict[frozenset, Tuple[bool, float, Optional[object]]] = {}
+        # one inventory fetch for the whole pass: every subset simulation
+        # sees the same pools/types, so don't rebuild them per _simulate
+        pool_inventory = self._pool_inventory()
+
+        def simulate(subset: List[Candidate]):
+            nonlocal sims
+            key = frozenset(c.claim.name for c in subset)
+            out = evaluated.get(key)
+            if out is None:
+                sims += 1
+                out = self._simulate(subset, pool_inventory)
+                evaluated[key] = out
+            return out
+
+        def savings(subset: List[Candidate], rep_price: float) -> float:
+            return sum(c.price for c in subset) - rep_price
+
+        def acceptable(subset, fits, rep_price) -> bool:
+            if not fits:
+                return False
+            if rep_price > 0 and any(
+                c.claim.capacity_type == L.CAPACITY_TYPE_SPOT for c in subset
+            ):
+                return False  # spot nodes are delete-only
+            return rep_price < sum(c.price for c in subset)
+
+        while len(current) >= 2 and sims < MULTI_NODE_SIM_BUDGET:
+            fits, rep_price, vnode = simulate(current)
+            if acceptable(current, fits, rep_price):
+                return self._act_multi(current, rep_price, vnode)
+            best_child = None
+            best_gain = 0.0
+            best_result = (False, 0.0, None)
+            for i in range(len(current)):
+                if sims >= MULTI_NODE_SIM_BUDGET:
+                    break
+                child = current[:i] + current[i + 1 :]
+                if len(child) < 2:
+                    continue  # size-1 is the single-node scan's job
+                c_fits, c_price, c_vnode = simulate(child)
+                if acceptable(child, c_fits, c_price):
+                    gain = savings(child, c_price)
+                    if best_child is None or gain > best_gain:
+                        best_child = child
+                        best_gain = gain
+                        best_result = (c_fits, c_price, c_vnode)
+            if best_child is not None:
+                _, rep_price, vnode = best_result
+                return self._act_multi(best_child, rep_price, vnode)
+            current = current[:-1]  # trim the costliest-to-disrupt member
+        # guaranteed floor: the old prefix scan (<= MULTI_NODE_CANDIDATES-1
+        # sims, memoized against the descent above) so small prefixes are
+        # still found when the drop-one budget runs out at large sizes
         pool = list(ranked[:MULTI_NODE_CANDIDATES])
         for size in range(len(pool), 1, -1):
             subset = pool[:size]
-            fits, replacement_price, vnode = self._simulate(subset)
-            if not fits:
-                continue
-            combined = sum(c.price for c in subset)
-            if any(
-                c.claim.capacity_type == L.CAPACITY_TYPE_SPOT for c in subset
-            ) and replacement_price > 0:
-                continue
-            if replacement_price < combined:
-                best = subset
-                best_vnode = vnode
-                best_price = replacement_price
-                break
-        if best is None:
-            return False
-        if best_price > 0 and best_vnode is not None:
-            return self._launch_replacement(best, best_vnode, "consolidation/multi")
+            fits, rep_price, vnode = simulate(subset)
+            if acceptable(subset, fits, rep_price):
+                return self._act_multi(subset, rep_price, vnode)
+        return False
+
+    def _act_multi(
+        self, subset: List[Candidate], rep_price: float, vnode
+    ) -> bool:
+        if rep_price > 0 and vnode is not None:
+            return self._launch_replacement(
+                subset, vnode, "consolidation/multi"
+            )
         acted = False
-        for c in best:
+        for c in subset:
             if self._disrupt(c, "consolidation/multi"):
                 acted = True
         return acted
 
+    def _pool_inventory(self):
+        """(live pools, per-pool instance types) — fetched once per
+        consolidation pass so repeated subset simulations share it."""
+        pools = [p for p in self.kube.node_pools.values() if not p.deleted]
+        inventory = {
+            pool.name: self.cloud_provider.get_instance_types(pool)
+            for pool in pools
+        }
+        return pools, inventory
+
     def _simulate(
-        self, removed: Sequence[Candidate]
+        self, removed: Sequence[Candidate], pool_inventory=None
     ) -> Tuple[bool, float, Optional[object]]:
         """Scheduling simulation: do the removed nodes' pods fit on the
         remaining capacity plus at most ONE new (cheaper) node?
@@ -573,11 +649,7 @@ class DisruptionController:
         pods = [p for c in removed for p in c.reschedulable]
         if not pods:
             return True, 0.0, None
-        pools = [p for p in self.kube.node_pools.values() if not p.deleted]
-        inventory = {
-            pool.name: self.cloud_provider.get_instance_types(pool)
-            for pool in pools
-        }
+        pools, inventory = pool_inventory or self._pool_inventory()
         scheduler = self._scheduler.update(
             pools,
             inventory,

@@ -92,6 +92,31 @@ class TestCreate:
         out = env.cloud_provider.create(claim)
         assert out.zone == "zone-b"
 
+    def test_instance_labels_win_over_type_projection(self, env, setup):
+        """Regression: a type offered in several zones must not stamp an
+        arbitrary zone label over the launched instance's actual zone —
+        the claim's zone field and its labels must agree (reference
+        cloudprovider.go:348-383 projects from the instance last)."""
+        pool, nc = setup
+        claim = make_claim(
+            pool,
+            [
+                Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"]),
+                Requirement(
+                    L.LABEL_CAPACITY_TYPE, Op.IN, [L.CAPACITY_TYPE_ON_DEMAND]
+                ),
+            ],
+            requests=Resources(cpu=28, memory="48Gi"),
+        )
+        out = env.cloud_provider.create(claim)
+        assert out.zone == "zone-b"
+        assert out.labels[L.LABEL_ZONE] == "zone-b"
+        assert out.labels[L.LABEL_CAPACITY_TYPE] == L.CAPACITY_TYPE_ON_DEMAND
+        # multi-valued requirement keys must not project to labels at all
+        its = env.instance_types.list(pool, nc)
+        multi_zone = next(t for t in its if t.name == out.instance_type_name)
+        assert L.LABEL_ZONE not in multi_zone.requirements.labels()
+
     def test_accelerator_types_filtered_unless_requested(self, env, setup):
         pool, nc = setup
         out = env.cloud_provider.create(make_claim(pool))
