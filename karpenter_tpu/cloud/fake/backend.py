@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import BlockDeviceMapping
 from karpenter_tpu.utils.clock import Clock
 
 
@@ -57,6 +58,7 @@ class FakeLaunchTemplate:
     image_id: str = ""
     security_group_ids: List[str] = field(default_factory=list)
     user_data: str = ""
+    block_device_mappings: List[BlockDeviceMapping] = field(default_factory=list)
     tags: Dict[str, str] = field(default_factory=dict)
     created_at: float = 0.0
 

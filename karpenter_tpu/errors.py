@@ -18,6 +18,17 @@ class NodeClaimNotFoundError(Exception):
         self.provider_id = provider_id
 
 
+class NoImageResolvedError(Exception):
+    """Image resolution produced no launchable template for the node
+    class — bad selector terms or every candidate deprecated (the
+    reference's amifamily resolver fails the launch with "no amis exist
+    given constraints", resolver.go:118-127)."""
+
+    def __init__(self, node_class: str):
+        super().__init__(f"no image resolved for node class {node_class!r}")
+        self.node_class = node_class
+
+
 class InsufficientCapacityAggregateError(Exception):
     """Every launch candidate was capacity-constrained (the core treats
     this as retryable-later; the ICE cache keeps the failed pools masked,

@@ -15,7 +15,7 @@ again by (image, max_pods) so each group becomes one launch-template spec.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu.api import InstanceType, NodeClass, NodePool, Requirements
 from karpenter_tpu.api import labels as L
@@ -23,9 +23,57 @@ from karpenter_tpu.api.objects import BlockDeviceMapping
 from karpenter_tpu.api.requirements import Op, Requirement
 from karpenter_tpu.cache.ttl import DEFAULT_TTL, TTLCache
 from karpenter_tpu.cloud.fake.backend import FakeCloud, FakeImage
+from karpenter_tpu.providers.bootstrap import (
+    BootstrapConfig,
+    Bootstrapper,
+    CustomBootstrap,
+    ShellBootstrap,
+    TomlBootstrap,
+)
 from karpenter_tpu.utils.clock import Clock
 
-IMAGE_FAMILIES = ("standard", "accelerated", "custom")
+
+@dataclass(frozen=True)
+class ImageFamily:
+    """One AMI-family analogue: default-image query key, bootstrapper,
+    and block-device defaults (reference al2.go / bottlerocket.go /
+    custom.go each implement exactly this trio)."""
+
+    name: str
+    bootstrapper: Callable[[BootstrapConfig], Bootstrapper]
+    # default storage layout when the node class doesn't specify one
+    # (reference DefaultBlockDeviceMappings per family)
+    block_device_defaults: Tuple[BlockDeviceMapping, ...]
+
+
+FAMILIES: Dict[str, ImageFamily] = {
+    # shell/MIME boot like AL2/Ubuntu: one general-purpose root volume
+    # (al2.go:99-108)
+    "standard": ImageFamily(
+        name="standard",
+        bootstrapper=ShellBootstrap,
+        block_device_defaults=(BlockDeviceMapping(device_name="/dev/xvda"),),
+    ),
+    # settings-document boot like Bottlerocket: a small immutable OS
+    # volume plus the data volume (bottlerocket.go:112-126)
+    "accelerated": ImageFamily(
+        name="accelerated",
+        bootstrapper=TomlBootstrap,
+        block_device_defaults=(
+            BlockDeviceMapping(device_name="/dev/xvda", volume_size=4 * 2**30),
+            BlockDeviceMapping(device_name="/dev/xvdb"),
+        ),
+    ),
+    # verbatim passthrough: the user owns boot config AND storage layout
+    # (custom.go — DefaultBlockDeviceMappings is nil)
+    "custom": ImageFamily(
+        name="custom",
+        bootstrapper=CustomBootstrap,
+        block_device_defaults=(),
+    ),
+}
+
+IMAGE_FAMILIES = tuple(FAMILIES)
 
 
 def _image_requirements(im: FakeImage) -> Requirements:
@@ -73,11 +121,7 @@ class ImageProvider:
         if node_class.image_selector_terms:
             images = self.cloud.describe_images(node_class.image_selector_terms)
         else:
-            family = (
-                node_class.image_family
-                if node_class.image_family in IMAGE_FAMILIES
-                else "standard"
-            )
+            family = image_family(node_class).name
             images = []
             for arch in ("amd64", "arm64"):
                 im = self.cloud.latest_image(family, arch)
@@ -92,22 +136,30 @@ class ImageProvider:
         self._cache.flush()
 
 
+def image_family(node_class: NodeClass) -> ImageFamily:
+    return FAMILIES.get(node_class.image_family, FAMILIES["standard"])
+
+
 def generate_user_data(
-    node_class: NodeClass, pool: NodePool, cluster_name: str, cluster_endpoint: str
+    node_class: NodeClass,
+    pool: NodePool,
+    cluster_name: str,
+    cluster_endpoint: str,
+    max_pods: Optional[int] = None,
 ) -> str:
-    """Boot configuration for a node (reference
-    bootstrap/eksbootstrap.go): cluster identity, pool taints/labels, and
-    any custom user data appended."""
-    lines = [
-        "#!/usr/bin/env bash",
-        f"bootstrap --cluster {cluster_name} --endpoint {cluster_endpoint}",
-        f"--node-pool {pool.name}",
-    ]
-    for t in pool.taints + pool.startup_taints:
-        lines.append(f"--register-taint {t.key}={t.value}:{t.effect}")
-    if node_class.user_data:
-        lines.append(node_class.user_data)
-    return "\n".join(lines)
+    """Boot configuration for a node, in the node class's family format
+    (reference resolver.go:179-186 hands Options to the family's
+    UserData(); the Bootstrapper owns the document shape)."""
+    cfg = BootstrapConfig(
+        cluster_name=cluster_name,
+        cluster_endpoint=cluster_endpoint,
+        node_pool=pool.name,
+        labels={**pool.labels, L.LABEL_NODEPOOL: pool.name},
+        taints=list(pool.taints) + list(pool.startup_taints),
+        max_pods=max_pods if max_pods is not None else pool.kubelet_max_pods,
+        custom_user_data=node_class.user_data,
+    )
+    return image_family(node_class).bootstrapper(cfg).script()
 
 
 class Resolver:
@@ -135,10 +187,10 @@ class Resolver:
                 if it.requirements.intersects(cand.requirements):
                     by_image.setdefault(cand.image.id, []).append(it)
                     break
-        user_data = generate_user_data(
-            node_class, pool, cluster_name, cluster_endpoint
+        family = image_family(node_class)
+        bdms = list(node_class.block_device_mappings) or list(
+            family.block_device_defaults
         )
-        bdms = list(node_class.block_device_mappings) or [BlockDeviceMapping()]
         specs: List[LaunchSpec] = []
         for image_id, types in by_image.items():
             # group again by max-pods so kubelet config is uniform per
@@ -153,7 +205,12 @@ class Resolver:
                         image_id=image_id,
                         instance_types=group,
                         max_pods=mp,
-                        user_data=user_data,
+                        # user data is per-group: max-pods rides in the
+                        # boot document, so each group gets its own
+                        user_data=generate_user_data(
+                            node_class, pool, cluster_name,
+                            cluster_endpoint, max_pods=mp,
+                        ),
                         block_device_mappings=bdms,
                     )
                 )

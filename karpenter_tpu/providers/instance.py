@@ -38,6 +38,7 @@ from karpenter_tpu.cloud.fake.backend import (
 from karpenter_tpu.errors import (
     InsufficientCapacityAggregateError,
     NodeClaimNotFoundError,
+    NoImageResolvedError,
 )
 from karpenter_tpu.providers.launchtemplate import LaunchTemplateProvider
 from karpenter_tpu.providers.subnet import SubnetProvider
@@ -137,13 +138,18 @@ class InstanceProvider:
         templates = self.launch_templates.ensure_all(
             node_class, _pool_stub(claim), types
         )
+        if not templates:
+            # launching template-less would boot an unconfigured machine;
+            # fail the claim with an actionable error instead
+            self.subnets.update_inflight_ips(chosen_subnets, [])
+            raise NoImageResolvedError(node_class.name)
         overrides = self._overrides(
             types, chosen_subnets, capacity_type, claim
         )
         if not overrides:
             self.subnets.update_inflight_ips(chosen_subnets, [])
             raise InsufficientCapacityAggregateError([])
-        template = templates[0] if templates else None
+        template = templates[0]
         # fleet-level tags carry only POOL-level identity: claim-specific
         # tags (Name, nodeclaim) would make merged batch requests lie about
         # N-1 of the N instances (the reference's batcher hashes the whole
@@ -152,9 +158,9 @@ class InstanceProvider:
         request = {
             "overrides": overrides,
             "capacity_type": capacity_type,
-            "launch_template": template.name if template else "",
-            "image_id": template.image_id if template else "",
-            "security_group_ids": template.security_group_ids if template else [],
+            "launch_template": template.name,
+            "image_id": template.image_id,
+            "security_group_ids": list(template.security_group_ids),
             "tags": {
                 **self.base_tags,
                 **node_class.tags,

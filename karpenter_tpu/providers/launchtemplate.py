@@ -127,6 +127,7 @@ class LaunchTemplateProvider:
                         image_id=spec.image_id,
                         security_group_ids=list(sg_ids),
                         user_data=spec.user_data,
+                        block_device_mappings=list(spec.block_device_mappings),
                         tags={
                             CLUSTER_TAG: self.cluster_name,
                             OPTIONS_HASH_TAG: h,
@@ -171,7 +172,18 @@ class LaunchTemplateProvider:
             "max_pods": spec.max_pods,
             "sgs": sorted(sg_ids),
             "user_data": spec.user_data,
-            "bdm": [b.device_name for b in spec.block_device_mappings],
+            # full storage layout: resizing or re-typing a volume must
+            # rotate the template, not just renaming the device
+            "bdm": [
+                (
+                    b.device_name,
+                    b.volume_size,
+                    b.volume_type,
+                    b.encrypted,
+                    b.delete_on_termination,
+                )
+                for b in spec.block_device_mappings
+            ],
             "monitoring": node_class.detailed_monitoring,
             "tags": sorted(node_class.tags.items()),
         }

@@ -1,0 +1,215 @@
+"""Image-family bootstrappers (reference pkg/providers/amifamily/bootstrap/):
+each family emits a DISTINCT user-data format, deterministically, and the
+resolver picks format + block-device defaults from the family registry."""
+
+import pytest
+
+from karpenter_tpu.api import NodeClass, NodePool
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import BlockDeviceMapping, Taint
+from karpenter_tpu.providers.bootstrap import (
+    BootstrapConfig,
+    CustomBootstrap,
+    ShellBootstrap,
+    TomlBootstrap,
+    parse_settings,
+)
+from karpenter_tpu.providers.image import FAMILIES, image_family
+from karpenter_tpu.testing import Environment
+
+
+def _cfg(**kw):
+    base = dict(
+        cluster_name="prod",
+        cluster_endpoint="https://api.prod:443",
+        labels={"team": "ml", "tier": "batch"},
+        taints=[
+            Taint("dedicated", "gpu", "NoSchedule"),
+            Taint("boot", "pending", "NoExecute"),
+        ],
+    )
+    base.update(kw)
+    return BootstrapConfig(**base)
+
+
+class TestShellBootstrap:
+    def test_mime_structure_and_kubelet_args(self):
+        s = ShellBootstrap(_cfg(max_pods=58)).script()
+        assert s.startswith("MIME-Version: 1.0")
+        assert 'boundary="//"' in s
+        assert "/etc/node/bootstrap.sh 'prod'" in s
+        assert "--apiserver-endpoint 'https://api.prod:443'" in s
+        # explicit density disables the derived default and pins max-pods
+        assert "--use-max-pods false" in s
+        assert "--max-pods=58" in s
+        assert '--node-labels="team=ml,tier=batch"' in s
+        assert (
+            '--register-with-taints="boot=pending:NoExecute,dedicated=gpu:NoSchedule"'
+            in s
+        )
+        assert s.rstrip().endswith("--//--")
+
+    def test_custom_user_data_rides_first(self):
+        s = ShellBootstrap(_cfg(custom_user_data="echo pre-hook")).script()
+        assert s.index("echo pre-hook") < s.index("/etc/node/bootstrap.sh")
+
+    def test_premimed_custom_data_not_double_wrapped(self):
+        inner = ShellBootstrap(_cfg(custom_user_data="echo inner")).script()
+        s = ShellBootstrap(_cfg(custom_user_data=inner)).script()
+        assert s.count("MIME-Version: 1.0") == 1
+        assert "echo inner" in s
+
+    def test_foreign_boundary_mime_parts_spliced(self):
+        """Externally generated multipart docs use their own boundary;
+        their parts must be spliced through, not dropped."""
+        foreign = (
+            "MIME-Version: 1.0\n"
+            'Content-Type: multipart/mixed; boundary="==B=="\n'
+            "\n"
+            "--==B==\n"
+            'Content-Type: text/x-shellscript; charset="us-ascii"\n'
+            "\n"
+            "echo userhook\n"
+            "--==B==--\n"
+        )
+        s = ShellBootstrap(_cfg(custom_user_data=foreign)).script()
+        assert "echo userhook" in s
+        assert s.count("MIME-Version: 1.0") == 1
+
+    def test_deterministic_under_input_order(self):
+        a = _cfg(labels={"a": "1", "b": "2"})
+        b = _cfg(labels={"b": "2", "a": "1"})
+        b.taints = list(reversed(b.taints))
+        assert ShellBootstrap(a).script() == ShellBootstrap(b).script()
+
+
+class TestTomlBootstrap:
+    def test_settings_document(self):
+        s = TomlBootstrap(_cfg(max_pods=110)).script()
+        doc = parse_settings(s)
+        k8s = doc["settings.kubernetes"]
+        assert k8s["cluster-name"] == '"prod"'
+        assert k8s["api-server"] == '"https://api.prod:443"'
+        assert k8s["max-pods"] == "110"
+        assert doc["settings.kubernetes.node-labels"]['"team"'] == '"ml"'
+        taints = doc["settings.kubernetes.node-taints"]
+        assert taints['"dedicated"'] == '["gpu:NoSchedule"]'
+
+    def test_controller_settings_overwrite_custom(self):
+        custom = (
+            "[settings.host]\nmotd = \"hi\"\n"
+            "[settings.kubernetes]\ncluster-name = \"spoofed\"\n"
+        )
+        s = TomlBootstrap(_cfg(custom_user_data=custom)).script()
+        doc = parse_settings(s)
+        # user additions survive, controller-owned keys win
+        assert doc["settings.host"]["motd"] == '"hi"'
+        assert doc["settings.kubernetes"]["cluster-name"] == '"prod"'
+
+    def test_reserved_resources(self):
+        s = TomlBootstrap(
+            _cfg(kube_reserved={"cpu": "100m"}, eviction_hard={"memory.available": "5%"})
+        ).script()
+        doc = parse_settings(s)
+        assert doc["settings.kubernetes.kube-reserved"]['"cpu"'] == '"100m"'
+        assert (
+            doc["settings.kubernetes.eviction-hard"]['"memory.available"'] == '"5%"'
+        )
+
+
+class TestCustomBootstrap:
+    def test_verbatim_passthrough(self):
+        raw = "#cloud-config\nruncmd:\n  - my-own-bootstrap\n"
+        assert CustomBootstrap(_cfg(custom_user_data=raw)).script() == raw
+
+
+class TestFamilyRegistry:
+    def test_formats_are_distinct(self):
+        cfg = _cfg()
+        shell = FAMILIES["standard"].bootstrapper(cfg).script()
+        toml = FAMILIES["accelerated"].bootstrapper(cfg).script()
+        assert "MIME-Version" in shell and "MIME-Version" not in toml
+        assert "[settings.kubernetes]" in toml and "[settings" not in shell
+
+    def test_block_device_defaults_differ(self):
+        std = FAMILIES["standard"].block_device_defaults
+        acc = FAMILIES["accelerated"].block_device_defaults
+        assert len(std) == 1 and std[0].device_name == "/dev/xvda"
+        assert len(acc) == 2  # small OS volume + data volume
+        assert acc[0].volume_size < acc[1].volume_size
+        assert FAMILIES["custom"].block_device_defaults == ()
+
+    def test_unknown_family_falls_back_to_standard(self):
+        nc = NodeClass(name="x", image_family="does-not-exist")
+        assert image_family(nc).name == "standard"
+
+
+class TestResolverIntegration:
+    @pytest.fixture()
+    def env(self):
+        return Environment()
+
+    def _specs(self, env, family, **nc_kw):
+        nc = env.default_node_class()
+        nc.image_family = family
+        for k, v in nc_kw.items():
+            setattr(nc, k, v)
+        pool = env.default_node_pool()
+        its = env.instance_types.list(pool, nc)[:5]
+        return env.operator.resolver.resolve(
+            nc, pool, its, cluster_name="prod", cluster_endpoint="https://e"
+        )
+
+    def test_standard_resolves_shell_and_default_volume(self, env):
+        specs = self._specs(env, "standard")
+        assert specs
+        for sp in specs:
+            assert sp.user_data.startswith("MIME-Version")
+            assert [b.device_name for b in sp.block_device_mappings] == [
+                "/dev/xvda"
+            ]
+
+    def test_accelerated_resolves_toml_and_two_volumes(self, env):
+        specs = self._specs(env, "accelerated")
+        assert specs
+        for sp in specs:
+            assert "[settings.kubernetes]" in sp.user_data
+            assert len(sp.block_device_mappings) == 2
+
+    def test_node_class_mappings_override_family_default(self, env):
+        override = [BlockDeviceMapping(device_name="/dev/sdz")]
+        specs = self._specs(env, "accelerated", block_device_mappings=override)
+        for sp in specs:
+            assert [b.device_name for b in sp.block_device_mappings] == [
+                "/dev/sdz"
+            ]
+
+    def test_empty_image_resolution_fails_launch_loudly(self, env):
+        """No resolvable image (all deprecated) must FAIL the launch with
+        NoImageResolvedError — never boot a template-less, unconfigured
+        machine (reference resolver.go:118-127 'no amis exist given
+        constraints')."""
+        from karpenter_tpu.api import Pod, Resources
+
+        env.default_node_class()
+        env.default_node_pool()
+        for im in env.cloud.images.values():
+            im.deprecated = True
+        env.operator.images.invalidate()
+        env.kube.put_pod(Pod(requests=Resources(cpu=1, memory="1Gi")))
+        for _ in range(3):
+            env.step(2.0)
+        assert env.kube.pending_pods()  # isolated failure, pod waits
+        running = [
+            i for i in env.cloud.instances.values() if i.state == "running"
+        ]
+        assert not running
+
+    def test_max_pods_rides_in_user_data(self, env):
+        nc = env.default_node_class()
+        pool = env.default_node_pool(kubelet_max_pods=42)
+        its = env.instance_types.list(pool, nc)[:3]
+        specs = env.operator.resolver.resolve(
+            nc, pool, its, cluster_name="prod", cluster_endpoint="https://e"
+        )
+        assert specs and all("--max-pods=42" in sp.user_data for sp in specs)
