@@ -63,7 +63,7 @@ class Operator:
         self.settings.validate()
         self.clock = clock or cloud.clock
         self.registry = registry
-        self.cluster = Cluster(kube)
+        self.cluster = Cluster(kube, clock=self.clock)
 
         # ---- caches + providers, dependency order (operator.go:126-165)
         self.unavailable = UnavailableOfferings(self.clock)

@@ -11,11 +11,15 @@ that have no Node yet — those still reserve capacity against scheduling.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import NodeClaim, Pod, Resources, Taint
 from karpenter_tpu.api import labels as L
 from karpenter_tpu.state.kube import KubeStore, Node
+
+# how long a nomination holds before the pod returns to the provisionable
+# pool (reference karpenter-core state.Cluster nomination window)
+NOMINATION_TTL = 20.0
 
 
 @dataclass
@@ -78,23 +82,47 @@ class Cluster:
     state.Cluster's podNominations.
     """
 
-    def __init__(self, kube: KubeStore):
+    def __init__(self, kube: KubeStore, clock=None):
         self.kube = kube
-        self._nominations: Dict[str, str] = {}  # pod key -> node/claim name
+        self.clock = clock
+        # pod key -> (node/claim name, nomination timestamp)
+        self._nominations: Dict[str, Tuple[str, float]] = {}
+
+    def _now(self) -> float:
+        return self.clock.now() if self.clock is not None else 0.0
 
     def nominate(self, pod_key: str, node_name: str) -> None:
-        self._nominations[pod_key] = node_name
+        self._nominations[pod_key] = (node_name, self._now())
 
     def clear_nomination(self, pod_key: str) -> None:
         self._nominations.pop(pod_key, None)
 
+    def _live(self, pod_key: str) -> Optional[str]:
+        entry = self._nominations.get(pod_key)
+        if entry is None:
+            return None
+        node_name, ts = entry
+        # nominations EXPIRE: if the scheduler hasn't bound the pod within
+        # the window (taint added after nomination, kubelet wedged), the
+        # pod must return to the provisionable pool and the node must stop
+        # being charged for it — otherwise both deadlock forever (the
+        # reference's state.Cluster nomination window is ~20s)
+        if self.clock is not None and self._now() - ts > NOMINATION_TTL:
+            self._nominations.pop(pod_key, None)
+            return None
+        return node_name
+
     def nominated_node(self, pod_key: str) -> Optional[str]:
-        return self._nominations.get(pod_key)
+        return self._live(pod_key)
 
     def nominations(self) -> List[tuple]:
-        """Snapshot of (pod key, target node/claim name) entries — the
-        read API for consumers like the consistency checker."""
-        return list(self._nominations.items())
+        """Snapshot of live (pod key, target node/claim name) entries —
+        the read API for consumers like the consistency checker."""
+        return [
+            (k, node)
+            for k in list(self._nominations)
+            if (node := self._live(k)) is not None
+        ]
 
     def snapshot(self) -> List[StateNode]:
         nodes: Dict[str, StateNode] = {}
@@ -134,7 +162,10 @@ class Cluster:
                 sn.pods.append(p)
                 sn.used = sn.used + p.requests
         # charge nominated (in-flight) pods
-        for pod_key, node_name in list(self._nominations.items()):
+        for pod_key in list(self._nominations):
+            node_name = self._live(pod_key)  # drops expired entries
+            if node_name is None:
+                continue
             pod = self.kube.pods.get(pod_key)
             sn = nodes.get(node_name)
             if pod is None or pod.node_name or sn is None:

@@ -16,7 +16,7 @@ from typing import List, Optional, Sequence
 
 from karpenter_tpu.api import NodeClass, NodePool, Settings
 from karpenter_tpu.api import labels as L
-from karpenter_tpu.api.objects import SelectorTerm
+from karpenter_tpu.api.objects import SelectorTerm, tolerates_all
 from karpenter_tpu.cloud.fake.backend import FakeCloud, MachineShape, generate_catalog
 from karpenter_tpu.operator import Operator
 from karpenter_tpu.state.kube import KubeStore, Node
@@ -105,6 +105,10 @@ class FakeKubelet:
                 continue
             node = kube.nodes.get(target)
             if node is None or not node.ready or node.cordoned:
+                continue
+            # the real kubelet rejects pods that don't tolerate the node's
+            # taints — a taint added after nomination must block the bind
+            if not tolerates_all(pod.tolerations, node.taints):
                 continue
             kube.bind_pod(pod.key(), node.name)
             cluster.clear_nomination(pod.key())

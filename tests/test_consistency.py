@@ -96,9 +96,28 @@ class TestConsistency:
         _provision(env)
         ghost = Pod(requests=Resources(cpu=1))
         env.kube.put_pod(ghost)
+        # nominate AFTER stepping past the check period: nominations now
+        # expire (state/cluster.py NOMINATION_TTL), so an old one would
+        # self-heal before the checker ever saw it — the checker's job is
+        # the window where a LIVE nomination points at a missing node
+        env.clock.step(CHECK_PERIOD + 1)
         env.cluster.nominate(ghost.key(), "missing-node")
-        _run_checker(env)
+        env.operator.consistency.reconcile()
         assert _violations(env, "nomination")
+
+    def test_nomination_expires(self, env):
+        """A nomination the kubelet never converts to a bind ages out so
+        the pod returns to the provisionable pool (the deadlock guard the
+        chaos suite relies on)."""
+        from karpenter_tpu.state.cluster import NOMINATION_TTL
+
+        _provision(env)
+        ghost = Pod(requests=Resources(cpu=1))
+        env.kube.put_pod(ghost)
+        env.cluster.nominate(ghost.key(), "some-node")
+        assert env.cluster.nominated_node(ghost.key()) == "some-node"
+        env.clock.step(NOMINATION_TTL + 1)
+        assert env.cluster.nominated_node(ghost.key()) is None
 
     def test_rate_limited(self, env):
         _provision(env)

@@ -8,9 +8,17 @@ bottleneck the TPU kernel removes.
 
 import pytest
 
-from karpenter_tpu.api import Disruption, Pod, Requirement, Requirements, Resources
+from karpenter_tpu.api import (
+    Disruption,
+    NodeClass,
+    NodePool,
+    Pod,
+    Requirement,
+    Requirements,
+    Resources,
+)
 from karpenter_tpu.api import labels as L
-from karpenter_tpu.api.objects import PodAffinityTerm
+from karpenter_tpu.api.objects import PodAffinityTerm, SelectorTerm, Taint, Toleration
 from karpenter_tpu.api.requirements import Op
 from karpenter_tpu.testing import Environment
 
@@ -93,3 +101,157 @@ class TestScaleDeprovisioning:
             i for i in env.cloud.instances.values() if i.state == "running"
         ]
         assert len(running) == after
+
+    def test_four_mechanisms_simultaneously_520_pods(self):
+        """Expiration, drift, emptiness, and consolidation all active in
+        ONE run, each owning a taint-isolated pool, 520 pods total
+        (reference deprovisioning_test.go:139-320 'should run
+        consolidation, emptiness, expiration, and drift simultaneously').
+        """
+        mechs = ("consolidate", "empty", "expire", "drift")
+        pods_per_mech = 130  # 4 x 130 = 520 pods
+        env = Environment()
+        env.default_node_class()
+        drift_nc = NodeClass(
+            name="drift-nc",
+            subnet_selector_terms=[SelectorTerm.of(Name="*")],
+            security_group_selector_terms=[SelectorTerm.of(Name="*")],
+        )
+        env.kube.put_node_class(drift_nc)
+        for m in mechs:
+            env.kube.put_node_pool(
+                NodePool(
+                    name=m,
+                    node_class_ref="drift-nc" if m == "drift" else "default",
+                    taints=[Taint("mech", m, "NoSchedule")],
+                    labels={"mech": m},
+                    requirements=Requirements(
+                        [Requirement(L.LABEL_INSTANCE_CPU, Op.LT, ["17"])]
+                    ),
+                    kubelet_max_pods=25,
+                    disruption=Disruption(
+                        consolidation_policy="WhenEmpty"
+                        if m == "empty"
+                        else "WhenUnderutilized",
+                        consolidate_after=0,
+                        budgets=["100%"],
+                    ),
+                )
+            )
+        by_mech = {m: [] for m in mechs}
+        for m in mechs:
+            for _ in range(pods_per_mech):
+                p = Pod(
+                    labels={"mech": m},
+                    node_selector={"mech": m},
+                    tolerations=[Toleration(key="mech", value=m)],
+                    requests=Resources(cpu=0.7, memory="700Mi"),
+                )
+                by_mech[m].append(p)
+                env.kube.put_pod(p)
+        env.settle(max_rounds=15)
+        assert not env.kube.pending_pods()
+        claims_by_pool = lambda: {
+            m: {
+                c.name
+                for c in env.kube.node_claims.values()
+                if c.pool_name == m and c.deleted_at is None
+            }
+            for m in mechs
+        }
+        before = claims_by_pool()
+        for m in mechs:
+            assert len(before[m]) >= 6, f"{m}: {len(before[m])} nodes"
+
+        # fire all four mechanisms in the same window
+        for p in by_mech["consolidate"][int(pods_per_mech * 0.2):]:
+            env.kube.delete_pod(p.key())  # 80% shrink -> repack
+        for p in by_mech["empty"]:
+            env.kube.delete_pod(p.key())  # whole pool empties
+        env.kube.node_pools["expire"].disruption.expire_after = 1.0
+        drift_nc.user_data = "#drifted"  # static-hash change -> drift
+        env.kube.put_node_class(drift_nc)
+
+        for _ in range(120):
+            env.step(2.0)
+            if not env.kube.pending_pods():
+                after = claims_by_pool()
+                if (
+                    not after["empty"]
+                    and len(after["consolidate"]) <= len(before["consolidate"]) // 2
+                    and not (after["expire"] & before["expire"])
+                    and not (after["drift"] & before["drift"])
+                ):
+                    break
+        after = claims_by_pool()
+        # emptiness: every node of the emptied pool is gone
+        assert after["empty"] == set()
+        # consolidation: 80% fewer pods -> at most half the nodes remain
+        assert len(after["consolidate"]) <= len(before["consolidate"]) // 2
+        # expiration: full turnover, capacity still serving the pods
+        assert not (after["expire"] & before["expire"])
+        assert after["expire"], "expired nodes were not replaced"
+        # drift: full turnover onto the new node-class hash
+        assert not (after["drift"] & before["drift"])
+        assert after["drift"], "drifted nodes were not replaced"
+        assert not env.kube.pending_pods()
+        # no instance leaks across ~60+ disruption actions
+        running = [
+            i for i in env.cloud.instances.values() if i.state == "running"
+        ]
+        live = [
+            c for c in env.kube.node_claims.values() if c.deleted_at is None
+        ]
+        assert len(running) == len(live)
+
+
+class TestChaos:
+    def test_runaway_scale_up_bounded(self):
+        """Adversarial taint-adder: every node is tainted the moment it
+        registers, so the pod can never bind and every launch is wasted.
+        Emptiness must keep deleting the tainted nodes and the fleet must
+        stay BOUNDED — no runaway scale-up (reference
+        test/suites/chaos/suite_test.go:67,112)."""
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool(
+            disruption=Disruption(
+                consolidation_policy="WhenEmpty",
+                consolidate_after=0,
+                budgets=["100%"],
+            )
+        )
+        orig_put = env.kube.put_node
+
+        def tainted_put(node):
+            if not any(t.key == "chaos" for t in node.taints):
+                node.taints.append(Taint("chaos", "true", "NoSchedule"))
+            return orig_put(node)
+
+        env.kube.put_node = tainted_put
+        env.kube.put_pod(Pod(requests=Resources(cpu=1, memory="1Gi")))
+
+        max_live = 0
+        total_launched = set()
+        for _ in range(80):
+            env.step(2.0)
+            live = [
+                c
+                for c in env.kube.node_claims.values()
+                if c.deleted_at is None
+            ]
+            total_launched.update(c.name for c in live)
+            max_live = max(max_live, len(live))
+            # the reference asserts < 35 live nodes over 5 minutes; the
+            # fake loop is tighter — a handful of in-flight nodes at most
+            assert len(live) < 10, f"runaway: {len(live)} live nodes"
+        assert max_live < 10
+        # the pod never binds (every node is tainted), so its nomination
+        # must EXPIRE, the provisioner must retry, and emptiness must reap
+        # the abandoned tainted nodes: capacity CYCLES (many claims
+        # launched over time) without ever ACCUMULATING (few live at once)
+        assert env.kube.pending_pods()
+        assert len(total_launched) > max_live, (
+            f"no churn: {len(total_launched)} total vs {max_live} max live "
+            "— the pod is deadlocked on a stale nomination"
+        )
