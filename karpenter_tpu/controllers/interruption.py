@@ -17,6 +17,7 @@ controller does the graceful cordon/evict/terminate (controller.go:247-259).
 from __future__ import annotations
 
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -77,6 +78,10 @@ class InterruptionController:
         self.unavailable = unavailable
         self.registry = registry
 
+    # worker fan-out per batch (reference controller.go:108-118 runs the
+    # 10-message batch through a 10-way errgroup)
+    WORKERS = 10
+
     def reconcile(self) -> None:
         messages = self.cloud.receive_messages(max_messages=10)
         if not messages:
@@ -87,7 +92,11 @@ class InterruptionController:
             if c.provider_id
         }
         now = self.cloud.clock.now()
-        for msg in messages:
+
+        def process(msg: QueueMessage) -> None:
+            """One message end-to-end, errors ISOLATED: a failed message is
+            left on the queue (visibility timeout redelivers it) while the
+            rest of the batch completes (controller.go:120-133)."""
             if msg.enqueued_at:
                 # end-to-end reaction latency (reference
                 # interruption/metrics.go message latency histogram)
@@ -95,9 +104,19 @@ class InterruptionController:
                     "karpenter_interruption_message_latency_time_seconds",
                     max(now - msg.enqueued_at, 0.0),
                 )
-            self._handle(msg, claims_by_instance)
-            self.cloud.delete_message(msg)
+            try:
+                self._handle(msg, claims_by_instance)
+                self.cloud.delete_message(msg)
+            except Exception as exc:
+                log.warning("interruption message %s failed: %s", msg.id, exc)
+                self.registry.inc("karpenter_interruption_message_errors")
+                return  # NOT deleted -> redelivered next poll
             self.registry.inc("karpenter_interruption_deleted_messages")
+
+        with ThreadPoolExecutor(max_workers=self.WORKERS) as pool:
+            # list() propagates nothing: process() swallows per-message
+            # errors (handle AND delete), so the batch always drains
+            list(pool.map(process, messages))
 
     def _handle(self, msg: QueueMessage, claims: Dict[str, NodeClaim]) -> None:
         parsed = _parse(msg.body)

@@ -10,6 +10,7 @@ drained does the cloud instance terminate and the API objects disappear.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import List
 
 from karpenter_tpu.api import NodeClaim, Pod, Taint
@@ -39,18 +40,24 @@ class TerminationController:
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.registry = registry
+        self._mark_lock = threading.Lock()
 
     # -------------------------------------------------------------- external
     def mark_for_deletion(self, claim: NodeClaim, reason: str = "") -> None:
         """The deprovisioner/interruption entry point: start graceful
-        termination of a claim's node."""
-        if claim.deleted_at is None:
+        termination of a claim's node.  Callers may be concurrent (the
+        interruption worker pool can carry several messages for one
+        instance in a batch), so the mark is check-and-set under a lock —
+        exactly one disruption metric/event per claim."""
+        with self._mark_lock:
+            if claim.deleted_at is not None:
+                return
             claim.deleted_at = self.clock.now()
-            self.registry.inc(
-                "karpenter_nodeclaims_disrupted",
-                {"reason": reason or "unknown", "nodepool": claim.pool_name},
-            )
-            self.kube.record_event("NodeClaim", "Disrupting", claim.name, reason)
+        self.registry.inc(
+            "karpenter_nodeclaims_disrupted",
+            {"reason": reason or "unknown", "nodepool": claim.pool_name},
+        )
+        self.kube.record_event("NodeClaim", "Disrupting", claim.name, reason)
 
     # ------------------------------------------------------------- reconcile
     def reconcile(self) -> None:

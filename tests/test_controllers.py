@@ -244,6 +244,66 @@ class TestInterruption:
         env.step(1.0)
         assert not env.cloud.queue  # consumed + deleted
 
+    def test_full_batch_drained_in_one_pass(self, env, ready):
+        """A 10-message batch is processed by the worker pool in ONE
+        reconcile (reference controller.go:108-118's 10-way errgroup)."""
+        add_pods(env, 2)
+        env.settle()
+        claims = list(env.kube.node_claims.values())
+        for _ in range(10):
+            env.cloud.send_message(
+                {"kind": "rebalance_recommendation",
+                 "instance_id": claims[0].provider_id}
+            )
+        before = env.cloud.recorder.count("ReceiveMessage")
+        env.operator.interruption.reconcile()
+        assert not env.cloud.queue
+        assert env.cloud.recorder.count("ReceiveMessage") == before + 1
+        # 10 concurrent messages for ONE instance: exactly one disruption
+        # mark (check-and-set under the termination lock), not ten
+        assert (
+            env.registry.counter(
+                "karpenter_nodeclaims_disrupted",
+                {"reason": "rebalance_recommendation", "nodepool": "default"},
+            )
+            == 1
+        )
+
+    def test_failed_message_isolated_and_redelivered(self, env, ready):
+        """One poisoned message must not stop the batch, must stay on the
+        queue for redelivery, and must succeed once the fault clears
+        (controller.go:120-133 per-message error isolation)."""
+        add_pods(env, 2)
+        env.settle()
+        claim = next(iter(env.kube.node_claims.values()))
+        ic = env.operator.interruption
+        poisoned = claim.provider_id
+        orig = ic.termination.mark_for_deletion
+        calls = {"fail": True}
+
+        def flaky(c, reason=""):
+            if calls["fail"] and c.provider_id == poisoned:
+                raise RuntimeError("api throttled")
+            return orig(c, reason=reason)
+
+        ic.termination.mark_for_deletion = flaky
+        env.cloud.send_message({"kind": "mystery"})  # drops cleanly
+        env.cloud.send_message(
+            {"kind": "scheduled_change", "instance_id": poisoned}
+        )
+        ic.reconcile()
+        # the healthy message was consumed; the poisoned one remains
+        assert [m.body["kind"] for m in env.cloud.queue] == [
+            "scheduled_change"
+        ]
+        assert env.registry.counter(
+            "karpenter_interruption_message_errors"
+        ) == 1
+        calls["fail"] = False
+        ic.reconcile()  # redelivery succeeds
+        assert not env.cloud.queue
+        assert claim.deleted_at is not None
+
 
 class TestDisruption:
     def test_expiration(self, env):
