@@ -30,7 +30,7 @@ pjit wrappers used by the multi-chip dry run.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +46,9 @@ class PackResult(NamedTuple):
     node_cfg: jax.Array  # [K] int32 — config row per slot (-1 = unused)
     node_pods: jax.Array  # [K] int32 — total pods per slot
     node_used: jax.Array  # [K, R] float32 — final residual usage
+    # optional pre-bundled (take+leftover+cfg+used) flat buffer: present on
+    # the buffered path so the solver's fetch is exactly ONE transfer
+    bundle: Optional[jax.Array] = None
 
 
 def _per_node_cap(rem: jax.Array, req: jax.Array) -> jax.Array:
@@ -63,35 +66,26 @@ def _per_node_cap(rem: jax.Array, req: jax.Array) -> jax.Array:
     return jnp.maximum(cap, 0.0).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("k_slots", "objective"))
-def pack_kernel(
-    req: jax.Array,  # [G, R] float32
-    cnt: jax.Array,  # [G] int32
-    maxper: jax.Array,  # [G] int32
-    slot: jax.Array,  # [G] int32
-    feas: jax.Array,  # [G, C] bool
-    alloc: jax.Array,  # [C, R] float32
-    price: jax.Array,  # [C] float32
-    openable: jax.Array,  # [C] bool
-    used0: jax.Array,  # [K, R] float32 (existing-node prefill, zero-padded)
-    cfg0: jax.Array,  # [K] int32 (-1 where no existing node)
-    npods0: jax.Array,  # [K] int32
-    next_slot0: jax.Array,  # int32 — first free slot
-    sig0: jax.Array,  # [S, K] int32 — per-signature placement counts
-    *,
-    k_slots: int,
-    objective: str = "nodes",
+def _unpack_feas_bits(words: jax.Array, n_cols: int) -> jax.Array:
+    """Device-side inverse of host `np.packbits(..., bitorder="little")`
+    for any integer word width: bit k of word w is feasibility column
+    ``w * width + k``.  THE single bit-order contract for every packed
+    upload path (pack_kernel's uint8 rows, pack_kernel_buffered's int32
+    words) — change it here and both stay in sync."""
+    width = words.dtype.itemsize * 8
+    shifts = jnp.arange(width, dtype=words.dtype)
+    bits = (words[:, :, None] >> shifts) & words.dtype.type(1)
+    return bits.astype(bool).reshape(words.shape[0], -1)[:, :n_cols]
+
+
+def _pack_core(
+    req, cnt, maxper, slot, feas, alloc, price, openable,
+    used0, cfg0, npods0, next_slot0, sig0, *, k_slots, objective,
 ) -> PackResult:
+    """The packing math, shared by every entry point (plain, bit-packed,
+    and single-buffer).  Traced inside the callers' jits."""
     K = k_slots
     idx = jnp.arange(K, dtype=jnp.int32)
-    if feas.dtype == jnp.uint8:
-        # bit-packed rows (run_pack packs host-side): the feasibility matrix
-        # is the bulk of the per-solve host->device upload, and on a
-        # tunneled device the upload is latency that lands on the 200ms
-        # budget — ship 1 bit per entry and unpack on device
-        shifts = jnp.arange(8, dtype=jnp.uint8)
-        bits = (feas[:, :, None] >> shifts) & jnp.uint8(1)
-        feas = bits.reshape(feas.shape[0], -1).astype(bool)
     # price normalized to [0, 1) so it can serve as a pure tie-break in the
     # "nodes" objective (reference FFD fits maximal pods, then picks the
     # cheapest type — designs/bin-packing.md:18-42 + instance.go:391-408)
@@ -160,6 +154,114 @@ def pack_kernel(
     return PackResult(
         take=takes, leftover=leftovers, node_cfg=cfg, node_pods=npods,
         node_used=used,
+    )
+
+
+@partial(jax.jit, static_argnames=("k_slots", "objective"))
+def pack_kernel(
+    req: jax.Array,  # [G, R] float32
+    cnt: jax.Array,  # [G] int32
+    maxper: jax.Array,  # [G] int32
+    slot: jax.Array,  # [G] int32
+    feas: jax.Array,  # [G, C] bool (or uint8 bit-packed rows)
+    alloc: jax.Array,  # [C, R] float32
+    price: jax.Array,  # [C] float32
+    openable: jax.Array,  # [C] bool
+    used0: jax.Array,  # [K, R] float32 (existing-node prefill, zero-padded)
+    cfg0: jax.Array,  # [K] int32 (-1 where no existing node)
+    npods0: jax.Array,  # [K] int32
+    next_slot0: jax.Array,  # int32 — first free slot
+    sig0: jax.Array,  # [S, K] int32 — per-signature placement counts
+    *,
+    k_slots: int,
+    objective: str = "nodes",
+) -> PackResult:
+    if feas.dtype == jnp.uint8:
+        # bit-packed rows (parallel/mesh.py packs host-side): ship 1 bit
+        # per entry, unpack on device — the upload is latency that lands
+        # on the solve budget on a tunneled link
+        feas = _unpack_feas_bits(feas, feas.shape[1] * 8)
+    return _pack_core(
+        req, cnt, maxper, slot, feas, alloc, price, openable,
+        used0, cfg0, npods0, next_slot0, sig0,
+        k_slots=k_slots, objective=objective,
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("Gp", "Cp", "Kp", "R", "Sp", "objective")
+)
+def pack_kernel_buffered(
+    buf: jax.Array,  # ONE flat float32 buffer (see build_input_buffer)
+    alloc: jax.Array,  # [C, R] float32 (device-cached catalog constant)
+    price: jax.Array,  # [C] float32
+    openable: jax.Array,  # [C] bool
+    *,
+    Gp: int,
+    Cp: int,
+    Kp: int,
+    R: int,
+    Sp: int,
+    objective: str = "nodes",
+):
+    """Single-upload / single-dispatch / single-read solve path.
+
+    On the tunneled TPU every host<->device operation queues a round
+    trip, and the sync at fetch time drains them all — so the per-solve
+    tensors travel as ONE array (bitcast-packed by build_input_buffer),
+    one jit call does slice + unpack + pack + output-bundling, and the
+    caller reads back ONE array (`bundle`).  The PackResult device arrays
+    ride along un-fetched for the overflow fallback."""
+    off = 0
+    req = buf[off : off + Gp * R].reshape(Gp, R); off += Gp * R
+    used0 = buf[off : off + Kp * R].reshape(Kp, R); off += Kp * R
+    n_i32 = 3 * Gp + 2 * Kp + 1 + Sp * Kp
+    i32 = jax.lax.bitcast_convert_type(buf[off : off + n_i32], jnp.int32)
+    off += n_i32
+    cnt = i32[:Gp]
+    maxper = i32[Gp : 2 * Gp]
+    slot = i32[2 * Gp : 3 * Gp]
+    cfg0 = i32[3 * Gp : 3 * Gp + Kp]
+    npods0 = i32[3 * Gp + Kp : 3 * Gp + 2 * Kp]
+    next0 = i32[3 * Gp + 2 * Kp]
+    sig0 = i32[3 * Gp + 2 * Kp + 1 :].reshape(Sp, Kp)
+    # feasibility bits: 32 columns per int32 word, little-endian both ways
+    W = (Cp + 31) // 32
+    fi = jax.lax.bitcast_convert_type(buf[off:], jnp.int32).reshape(Gp, W)
+    feas = _unpack_feas_bits(fi, Cp)
+    res = _pack_core(
+        req, cnt, maxper, slot, feas, alloc, price, openable,
+        used0, cfg0, npods0, next0, sig0,
+        k_slots=Kp, objective=objective,
+    )
+    bundle = bundle_outputs(res.take, res.leftover, res.node_cfg, res.node_used)
+    return bundle, res
+
+
+def build_input_buffer(args) -> np.ndarray:
+    """Flatten the padded kernel arguments (minus the device-cached
+    catalog constants) into the ONE float32 upload buffer
+    pack_kernel_buffered expects."""
+    (req, cnt, maxper, slot, feas, _alloc, _price, _openable,
+     used0, cfg0, npods0, e0, sig0) = args
+    i32 = np.concatenate(
+        [
+            cnt, maxper, slot, cfg0, npods0,
+            np.asarray([e0], np.int32), sig0.ravel(),
+        ]
+    ).astype(np.int32)
+    packed = np.packbits(feas, axis=1, bitorder="little")
+    W4 = 4 * ((packed.shape[1] + 3) // 4)  # pad bytes to whole int32 words
+    if packed.shape[1] != W4:
+        packed = np.pad(packed, ((0, 0), (0, W4 - packed.shape[1])))
+    feas_i32 = packed.reshape(-1).view("<u4").astype(np.uint32).view(np.int32)
+    return np.concatenate(
+        [
+            req.ravel().astype(np.float32),
+            used0.ravel().astype(np.float32),
+            i32.view(np.float32),
+            feas_i32.view(np.float32),
+        ]
     )
 
 
@@ -240,9 +342,65 @@ def pad_problem(prob: CompiledProblem, k_slots: int = 0) -> Tuple[tuple, int]:
 
     args = (
         req, cnt, maxper, slot, feas, alloc, price, openable,
-        used0, cfg0, npods0, jnp.int32(E), sig0,
+        # next_slot0 stays a HOST scalar: a jnp scalar here costs a full
+        # device round trip the moment the buffered path np.asarray()s it
+        used0, cfg0, npods0, np.int32(E), sig0,
     )
     return args, Kp
+
+
+@jax.jit
+def bundle_outputs(
+    take: jax.Array,
+    leftover: jax.Array,
+    node_cfg: jax.Array,
+    node_used: jax.Array,
+) -> jax.Array:
+    """Everything decode needs, as ONE flat float32 buffer.
+
+    On the tunneled TPU link a device->host read costs a full round trip
+    PER ARRAY (jax.device_get copies pytree leaves separately), and the
+    solve's fetch moved six arrays — six round trips dominated the whole
+    solve latency.  Bitcasting the int32 pieces to float32 and
+    concatenating makes the fetch exactly one transfer; the host view()s
+    the slices back losslessly (bitcast, not cast)."""
+    vals, idx, nnz = compact_take(take)
+    as_f32 = lambda a: jax.lax.bitcast_convert_type(
+        a.astype(jnp.int32), jnp.float32
+    ).reshape(-1)
+    return jnp.concatenate(
+        [
+            as_f32(vals),
+            as_f32(idx),
+            as_f32(nnz.reshape(1)),
+            as_f32(leftover),
+            as_f32(node_cfg),
+            node_used.astype(jnp.float32).reshape(-1),
+        ]
+    )
+
+
+def unbundle_outputs(
+    buf: np.ndarray, take_dev: jax.Array, node_used_shape: Tuple[int, int]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side inverse of `bundle_outputs`: slice the flat buffer and
+    bitcast the int32 sections back.  Returns (take, leftover, node_cfg,
+    node_used); falls back to a dense take fetch iff nnz overflowed the
+    sparse buffer (same contract as expand_take)."""
+    G = take_dev.shape[0]
+    k = int(np.prod(take_dev.shape)) // G
+    ncap = G + 2 * k
+    i32 = buf.view(np.int32)
+    off = 0
+    vals = i32[off : off + ncap]; off += ncap
+    idx = i32[off : off + ncap]; off += ncap
+    nnz = int(i32[off]); off += 1
+    leftover = i32[off : off + G]; off += G
+    K = node_used_shape[0]
+    node_cfg = i32[off : off + K]; off += K
+    node_used = buf[off:].reshape(node_used_shape).copy()
+    take = expand_take(vals, idx, nnz, take_dev)
+    return take, leftover.copy(), node_cfg.copy(), node_used
 
 
 @jax.jit
@@ -318,18 +476,24 @@ def run_pack(
     (leftover pods while feasible configs remained), the caller should retry
     with a doubled bucket.
 
-    Upload hygiene for high-latency device links: the feasibility matrix is
-    shipped bit-packed (pack_kernel unpacks on device) and the config-axis
-    constants are uploaded once per catalog snapshot and reused from the
-    device cache.
+    Transfer hygiene for the high-latency device link: all per-solve
+    tensors ride in ONE flat buffer (feasibility as 32-bit words, see
+    build_input_buffer), the config-axis constants are uploaded once per
+    catalog snapshot and reused from the device cache, and the outputs
+    come back pre-bundled so the solver's fetch is a single read.
     """
     args, Kp = pad_problem(prob, k_slots)
-    (req, cnt, maxper, slot, feas, alloc, price, openable,
-     used0, cfg0, npods0, e0, sig0) = args
-    feas = np.packbits(feas, axis=1, bitorder="little")
-    alloc, price, openable = _device_constants(prob, alloc, price, openable)
-    return pack_kernel(
-        req, cnt, maxper, slot, feas, alloc, price, openable,
-        used0, cfg0, npods0, e0, sig0,
-        k_slots=Kp, objective=objective,
+    (req, _cnt, _maxper, _slot, _feas, alloc_h, price_h, openable_h,
+     _used0, _cfg0, _npods0, _e0, sig0) = args
+    alloc, price, openable = _device_constants(
+        prob, alloc_h, price_h, openable_h
     )
+    Gp, R = req.shape
+    Cp = alloc_h.shape[0]
+    Sp = sig0.shape[0]
+    buf = build_input_buffer(args)
+    bundle, res = pack_kernel_buffered(
+        buf, alloc, price, openable,
+        Gp=Gp, Cp=Cp, Kp=Kp, R=R, Sp=Sp, objective=objective,
+    )
+    return res._replace(bundle=bundle)

@@ -190,7 +190,7 @@ class TensorScheduler:
             self.pack_fn = default_pack_fn()
         result = self.pack_fn(prob, objective=self.objective)
         from karpenter_tpu.ops import pallas_packer
-        from karpenter_tpu.ops.packer import compact_take, expand_take
+        from karpenter_tpu.ops.packer import bundle_outputs, unbundle_outputs
 
         self.last_kernel = (
             pallas_packer.LAST_KERNEL
@@ -199,15 +199,22 @@ class TensorScheduler:
         )
 
         def fetch(res):
-            # ONE transfer for everything decode needs (the device link may
-            # be high-latency; per-array fetches would pay the round trip
-            # each), with the big take matrix riding along sparsely
-            if isinstance(res.take, jax.Array):
-                vals, idx, nnz = compact_take(res.take)
-                vals, idx, nnz, lo, cfg, used = jax.device_get(
-                    (vals, idx, nnz, res.leftover, res.node_cfg, res.node_used)
+            # ONE transfer — literally one device array — for everything
+            # decode needs: the tunneled link pays a full round trip per
+            # fetched array, so the kernel outputs are bitcast-bundled
+            # into a single flat buffer on device and sliced apart here
+            if getattr(res, "bundle", None) is not None:
+                # buffered path pre-bundled inside the kernel dispatch
+                return unbundle_outputs(
+                    np.asarray(res.bundle), res.take, res.node_used.shape
                 )
-                return expand_take(vals, idx, nnz, res.take), lo, cfg, used
+            if isinstance(res.take, jax.Array):
+                buf = np.asarray(
+                    bundle_outputs(
+                        res.take, res.leftover, res.node_cfg, res.node_used
+                    )
+                )
+                return unbundle_outputs(buf, res.take, res.node_used.shape)
             return jax.device_get(
                 (res.take, res.leftover, res.node_cfg, res.node_used)
             )
