@@ -80,11 +80,36 @@ class VirtualNode:
     # offering compatibility scan.  The scan result is per pod SHAPE, not
     # per pod — cleared whenever a commit narrows this node's requirements
     _fit_cache: Dict = field(default_factory=dict)
+    # per-axis max allocatable over feasible_types, keyed by list identity
+    # (commits replace the list): the O(axes) headroom gate that rejects
+    # probes against full nodes before any Requirements work
+    _headroom: Optional[Dict[str, float]] = None
+    _headroom_key: Optional[object] = None
 
     def __post_init__(self):
         if not self.name:
             self.name = f"vnode-{next(_vnode_seq)}"
         self.used = self.used + self.daemon_overhead
+
+    def _headroom_admits(self, requests: Resources) -> bool:
+        """Cheap upper-bound check: could ANY feasible type hold this
+        node's load plus `requests`?  A miss here is definitive (the full
+        scan compares against the same allocatable vectors), and in a
+        continued solve most probes hit nodes the tensor pass already
+        filled — rejecting them without touching Requirements is the
+        oracle loop's hottest shortcut."""
+        ft = self.feasible_types
+        if self._headroom_key is not ft:
+            hi = Resources()
+            for t in ft:
+                hi = hi.merge_max(t.allocatable())
+            self._headroom = dict(hi.items())
+            self._headroom_key = ft
+        hi = self._headroom
+        for axis, v in requests.items():
+            if v + self.used.get(axis) > hi.get(axis, 0.0) + 1e-9:
+                return False
+        return True
 
     # -- helpers -------------------------------------------------------------
     def zone_options(self) -> Set[str]:
@@ -137,11 +162,14 @@ class VirtualNode:
     def try_add(self, pod: Pod, topology: TopologyTracker) -> bool:
         if not tolerates_all(pod.tolerations, self.pool.taints):
             return False
-        # topology first: hostname-keyed constraints treat this node as a
+        if not self._headroom_admits(pod.requests):
+            return False
+        # topology next: hostname-keyed constraints treat this node as a
         # domain; a node with no pods yet is a fresh domain (NEW_DOMAIN).
-        # Checked before the Requirements merge because it is by far the
-        # cheapest rejection — a co-location follower probes every open
-        # node and all but its anchor fail here.
+        # Checked before the Requirements merge because, after the
+        # headroom gate, it is the cheapest remaining rejection — a
+        # co-location follower probes every open node and all but its
+        # anchor fail here.
         host_allowed = topology.allowed_domains(pod, HOSTNAME)
         if host_allowed is not None and self.name not in host_allowed:
             if not (NEW_DOMAIN in host_allowed and not self.pods):
@@ -230,6 +258,9 @@ class ExistingNode:
     state: StateNode
     used: Resources
     pods: List[Pod] = field(default_factory=list)
+    # node labels are immutable for the solve: build the Requirements view
+    # once per node instead of once per (pod, node) probe
+    _label_reqs: Optional[Requirements] = None
 
     @property
     def name(self) -> str:
@@ -240,12 +271,15 @@ class ExistingNode:
             self.state.node is not None and self.state.node.cordoned
         ):
             return False
+        # resources first: the cheapest definitive rejection, and most
+        # probes in a big solve hit already-full nodes
+        if not (self.used + pod.requests).fits(self.state.allocatable):
+            return False
         if not tolerates_all(pod.tolerations, self.state.taints):
             return False
-        node_reqs = Requirements.from_labels(self.state.labels)
-        if not node_reqs.compatible(pod.scheduling_requirements()):
-            return False
-        if not (self.used + pod.requests).fits(self.state.allocatable):
+        if self._label_reqs is None:
+            self._label_reqs = Requirements.from_labels(self.state.labels)
+        if not self._label_reqs.compatible(pod.scheduling_requirements()):
             return False
         host_allowed = topology.allowed_domains(pod, HOSTNAME)
         if host_allowed is not None and self.name not in host_allowed:
