@@ -134,6 +134,18 @@ def main(argv=None) -> int:
     operator.run(interval_s=args.interval)
     if server is not None:
         server.shutdown()
+    if operator.tracer.enabled:
+        # pprof-style hot-path table on shutdown (settings.md:18's
+        # ENABLE_PROFILING analogue); a JSON snapshot lands next to the
+        # XLA timeline when profile_dir is configured
+        print(operator.tracer.report())
+        if settings.profile_dir:
+            import os
+
+            os.makedirs(settings.profile_dir, exist_ok=True)
+            operator.tracer.dump(
+                os.path.join(settings.profile_dir, "spans.json")
+            )
     return 0
 
 

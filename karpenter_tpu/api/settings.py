@@ -22,6 +22,11 @@ class Settings:
     # pod batching window (settings.md:43-47)
     batch_idle_duration: float = 1.0
     batch_max_duration: float = 10.0
+    # span tracing / profiling, off by default (the ENABLE_PROFILING flag,
+    # settings.md:18); profile_dir additionally enables the XLA timeline
+    # for solver dispatches (TensorBoard-readable)
+    enable_profiling: bool = False
+    profile_dir: str = ""
 
     @classmethod
     def from_file(cls, path: str) -> "Settings":

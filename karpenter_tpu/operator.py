@@ -64,6 +64,17 @@ class Operator:
         self.clock = clock or cloud.clock
         self.registry = registry
         self.cluster = Cluster(kube, clock=self.clock)
+        # span tracing (the --enable-profiling analogue): the process
+        # tracer so library layers (solver) record into the same sink
+        from karpenter_tpu.utils.trace import TRACER
+
+        self.tracer = TRACER
+        # assign unconditionally: a later operator with profiling off must
+        # actually turn the process tracer off (and drop a stale dir)
+        self.tracer.enabled = self.settings.enable_profiling
+        self.tracer.profile_dir = (
+            self.settings.profile_dir if self.settings.enable_profiling else ""
+        )
 
         # ---- caches + providers, dependency order (operator.go:126-165)
         self.unavailable = UnavailableOfferings(self.clock)
@@ -159,7 +170,7 @@ class Operator:
         reference controller exports)."""
         labels = {"controller": name}
         self.registry.inc("karpenter_controller_reconcile_total", labels)
-        with self.registry.time(
+        with self.tracer.span(f"controller.{name}"), self.registry.time(
             "karpenter_controller_reconcile_time_seconds", labels
         ):
             try:

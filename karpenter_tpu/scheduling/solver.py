@@ -37,6 +37,7 @@ from karpenter_tpu.scheduling.scheduler import (
     VirtualNode,
 )
 from karpenter_tpu.state.cluster import StateNode
+from karpenter_tpu.utils.trace import TRACER, device_trace
 
 
 def default_pack_fn():
@@ -136,16 +137,20 @@ class TensorScheduler:
         exotic constraint no longer sends the whole 10k-pod batch to the
         O(pods x nodes) Python loop — only its coupled closure goes."""
         pods = list(pods)
-        sup_groups, unsupported, _reason = partition_groups(pods)
+        with TRACER.span("solver.partition"):
+            sup_groups, unsupported, _reason = partition_groups(pods)
         if not sup_groups:
-            return self._oracle(pods)
+            with TRACER.span("solver.oracle", pods=len(pods)):
+                return self._oracle(pods)
         supported = [p for _, members in sup_groups for p in members]
         result = self._solve_tensor(supported, sup_groups)
         if result is None:  # tensor compile bailed; solve everything oracle
-            return self._oracle(pods)
+            with TRACER.span("solver.oracle", pods=len(pods)):
+                return self._oracle(pods)
         if unsupported:
             self.last_path = "hybrid"
-            result = self._oracle_continue(unsupported, supported, result)
+            with TRACER.span("solver.oracle_continue", pods=len(unsupported)):
+                result = self._oracle_continue(unsupported, supported, result)
         return result
 
     def _solve_tensor(
@@ -173,22 +178,30 @@ class TensorScheduler:
                 tuple(self.daemonsets),
             )
         catalog = self._catalog
-        prob = compile_problem(
-            pods,
-            self.pools,
-            self.instance_types,
-            existing=self.existing,
-            daemonsets=self.daemonsets,
-            catalog=catalog,
-            presplit=True,
-            groups=groups,
-        )
+        with TRACER.span("solver.compile", pods=len(pods)):
+            prob = compile_problem(
+                pods,
+                self.pools,
+                self.instance_types,
+                existing=self.existing,
+                daemonsets=self.daemonsets,
+                catalog=catalog,
+                presplit=True,
+                groups=groups,
+            )
         if not prob.supported:
             return None
         self.last_path = "tensor"
         if self.pack_fn is None:
             self.pack_fn = default_pack_fn()
-        result = self.pack_fn(prob, objective=self.objective)
+        # the XLA timeline must stay open through fetch: pack_fn only
+        # ENQUEUES device work (async dispatch), the fetch's read is what
+        # forces execution — closing the profiler before it would capture
+        # dispatch overhead and miss the kernel
+        xla_trace = device_trace(TRACER)
+        xla_trace.__enter__()
+        with TRACER.span("solver.pack"):
+            result = self.pack_fn(prob, objective=self.objective)
         from karpenter_tpu.ops import pallas_packer
         from karpenter_tpu.ops.packer import bundle_outputs, unbundle_outputs
 
@@ -219,16 +232,25 @@ class TensorScheduler:
                 (res.take, res.leftover, res.node_cfg, res.node_used)
             )
 
-        take, leftover, node_cfg, node_used = fetch(result)
-        # grow the slot bucket if the solve ran out of node slots while
-        # feasible configs remained
-        k = int(node_cfg.shape[0])
-        max_k = len(prob.used0) + prob.total_pods()
-        while self._overflowed(prob, leftover) and k < max_k:
-            k *= 2
-            result = self.pack_fn(prob, k_slots=k, objective=self.objective)
-            take, leftover, node_cfg, node_used = fetch(result)
-        return self._decode(prob, take, node_cfg, node_used)
+        try:
+            with TRACER.span("solver.fetch"):
+                take, leftover, node_cfg, node_used = fetch(result)
+            # grow the slot bucket if the solve ran out of node slots
+            # while feasible configs remained
+            k = int(node_cfg.shape[0])
+            max_k = len(prob.used0) + prob.total_pods()
+            while self._overflowed(prob, leftover) and k < max_k:
+                k *= 2
+                with TRACER.span("solver.pack", retry_k=k):
+                    result = self.pack_fn(
+                        prob, k_slots=k, objective=self.objective
+                    )
+                with TRACER.span("solver.fetch", retry_k=k):
+                    take, leftover, node_cfg, node_used = fetch(result)
+        finally:
+            xla_trace.__exit__(None, None, None)
+        with TRACER.span("solver.decode"):
+            return self._decode(prob, take, node_cfg, node_used)
 
     def _oracle(self, pods: List[Pod]) -> SchedulingResult:
         self.last_path = "oracle"

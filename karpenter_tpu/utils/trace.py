@@ -1,0 +1,169 @@
+"""Span tracing + profiling (the pprof/ENABLE_PROFILING analogue).
+
+The reference exposes Go pprof handlers on the metrics endpoint behind
+``--enable-profiling`` (website v0.31 concepts/settings.md:18) and relies
+on controller-runtime's reconcile-duration series for hot-loop visibility.
+Here the equivalent is a process-local span tracer:
+
+- :class:`Tracer` records nested wall-clock spans into a bounded ring and
+  per-path aggregates (count / total / max), cheap enough to stay on in
+  production (two perf_counter calls per span when enabled, zero when
+  disabled).
+- The operator wraps every controller reconcile in a span, and the tensor
+  scheduler annotates solve phases (compile / pack / fetch / decode), so a
+  dump answers "where did the tick go" the way a pprof flame slice does.
+- :func:`device_trace` wraps ``jax.profiler.trace`` for the solver hot
+  path: when profiling is enabled the XLA-level timeline lands in a
+  TensorBoard-readable directory; otherwise it is a no-op context.
+
+Spans are threadsafe; each thread keeps its own active-span stack so
+parallel controllers (interruption workers) nest correctly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+# bounded history: enough for several reconcile ticks of every controller
+RING_SIZE = 4096
+
+
+@dataclass
+class SpanStat:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    def observe(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        if dt > self.max_s:
+            self.max_s = dt
+
+
+@dataclass
+class Span:
+    path: str  # dotted: "controller.disruption.simulate"
+    start_s: float
+    duration_s: float
+    meta: Dict[str, str] = field(default_factory=dict)
+
+
+class Tracer:
+    """Process-local span recorder.  Disabled by default: `span()` costs a
+    single attribute read when off (the reference ships profiling off by
+    default for the same reason, settings.md:18)."""
+
+    def __init__(self, enabled: bool = False, profile_dir: str = ""):
+        self.enabled = enabled
+        # when set (and enabled), device_trace additionally captures the
+        # XLA timeline for wrapped dispatches
+        self.profile_dir = profile_dir
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=RING_SIZE)
+        self._stats: Dict[str, SpanStat] = {}
+        self._local = threading.local()
+
+    # ------------------------------------------------------------- recording
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, **meta: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        stack = self._stack()
+        path = ".".join(stack + [name]) if stack else name
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            stack.pop()
+            with self._lock:
+                self._ring.append(
+                    Span(path=path, start_s=t0, duration_s=dt,
+                         meta={k: str(v) for k, v in meta.items()})
+                )
+                stat = self._stats.get(path)
+                if stat is None:
+                    stat = self._stats[path] = SpanStat()
+                stat.observe(dt)
+
+    # ---------------------------------------------------------------- output
+    def stats(self) -> Dict[str, SpanStat]:
+        with self._lock:
+            return {k: SpanStat(v.count, v.total_s, v.max_s)
+                    for k, v in self._stats.items()}
+
+    def recent(self, limit: int = 100) -> List[Span]:
+        with self._lock:
+            return list(self._ring)[-limit:]
+
+    def report(self) -> str:
+        """Human-readable hot-path table, total-time descending — the
+        text-mode `pprof -top` analogue."""
+        rows = sorted(
+            self.stats().items(), key=lambda kv: -kv[1].total_s
+        )
+        out = [f"{'span':48s} {'count':>8s} {'total_ms':>10s} {'avg_ms':>8s} {'max_ms':>8s}"]
+        for path, st in rows:
+            avg = st.total_s / st.count if st.count else 0.0
+            out.append(
+                f"{path:48s} {st.count:8d} {st.total_s * 1000:10.1f} "
+                f"{avg * 1000:8.2f} {st.max_s * 1000:8.2f}"
+            )
+        return "\n".join(out)
+
+    def dump(self, path: str) -> None:
+        """JSON snapshot (aggregates + recent spans) for offline tooling."""
+        payload = {
+            "stats": {
+                k: {"count": v.count, "total_s": v.total_s, "max_s": v.max_s}
+                for k, v in self.stats().items()
+            },
+            "recent": [
+                {"path": s.path, "duration_s": s.duration_s, "meta": s.meta}
+                for s in self.recent(500)
+            ],
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._stats.clear()
+
+
+# the default process tracer the operator wires up; tests may build their own
+TRACER = Tracer()
+
+
+@contextlib.contextmanager
+def device_trace(
+    tracer: Tracer, log_dir: Optional[str] = None
+) -> Iterator[None]:
+    """XLA-level profiling for the solver hot path: when the tracer is
+    enabled AND a log dir is configured (argument or tracer.profile_dir),
+    wraps ``jax.profiler.trace`` (the TensorBoard timeline); otherwise a
+    free no-op."""
+    log_dir = log_dir or tracer.profile_dir
+    if not tracer.enabled or not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

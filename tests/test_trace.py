@@ -1,0 +1,110 @@
+"""Span tracing / profiling (reference --enable-profiling + pprof,
+website v0.31 settings.md:18): the tracer must be free when off, nest
+correctly, aggregate per path, and wire through operator + solver."""
+
+import json
+import threading
+
+from karpenter_tpu.api import Pod, Resources, Settings
+from karpenter_tpu.testing import Environment
+from karpenter_tpu.utils.trace import TRACER, Tracer, device_trace
+
+
+class TestTracer:
+    def test_disabled_records_nothing(self):
+        t = Tracer(enabled=False)
+        with t.span("a"):
+            pass
+        assert t.stats() == {}
+        assert t.recent() == []
+
+    def test_nested_paths_and_aggregates(self):
+        t = Tracer(enabled=True)
+        for _ in range(3):
+            with t.span("tick"):
+                with t.span("inner"):
+                    pass
+        st = t.stats()
+        assert st["tick"].count == 3
+        assert st["tick.inner"].count == 3
+        assert st["tick"].total_s >= st["tick.inner"].total_s
+        assert st["tick"].max_s > 0
+
+    def test_span_records_meta_and_survives_exception(self):
+        t = Tracer(enabled=True)
+        try:
+            with t.span("boom", pods=7):
+                raise RuntimeError("x")
+        except RuntimeError:
+            pass
+        (span,) = t.recent()
+        assert span.path == "boom" and span.meta == {"pods": "7"}
+        # the stack unwound: the next span is top-level again
+        with t.span("after"):
+            pass
+        assert t.recent()[-1].path == "after"
+
+    def test_threads_have_independent_stacks(self):
+        t = Tracer(enabled=True)
+        done = threading.Event()
+
+        def worker():
+            with t.span("w"):
+                done.wait(1.0)
+
+        th = threading.Thread(target=worker)
+        with t.span("main"):
+            th.start()
+            with t.span("inner"):
+                pass
+        done.set()
+        th.join()
+        paths = {s.path for s in t.recent()}
+        assert "w" in paths  # not "main.w": thread-local stacks
+        assert "main.inner" in paths
+
+    def test_report_and_dump(self, tmp_path):
+        t = Tracer(enabled=True)
+        with t.span("x"):
+            pass
+        rep = t.report()
+        assert "x" in rep and "count" in rep
+        out = tmp_path / "spans.json"
+        t.dump(str(out))
+        payload = json.loads(out.read_text())
+        assert payload["stats"]["x"]["count"] == 1
+        assert payload["recent"][0]["path"] == "x"
+
+    def test_device_trace_noop_when_disabled(self):
+        t = Tracer(enabled=False)
+        with device_trace(t, "/nonexistent/dir"):
+            pass  # must not touch jax.profiler at all
+
+
+class TestWiring:
+    def test_operator_spans_controllers_and_solver(self):
+        env = Environment(
+            settings=Settings(cluster_name="test", enable_profiling=True)
+        )
+        TRACER.reset()
+        try:
+            env.default_node_class()
+            env.default_node_pool()
+            env.kube.put_pod(Pod(requests=Resources(cpu=1, memory="1Gi")))
+            env.settle()
+            st = env.operator.tracer.stats()
+            assert st["controller.provisioner"].count > 0
+            assert st["controller.disruption"].count > 0
+            # solver phases nested under the provisioner tick
+            solver_spans = [k for k in st if "solver.compile" in k]
+            assert solver_spans, sorted(st)
+            assert any("solver.fetch" in k for k in st)
+            assert any("solver.decode" in k for k in st)
+        finally:
+            TRACER.enabled = False
+            TRACER.profile_dir = ""
+            TRACER.reset()
+
+    def test_profiling_off_by_default(self):
+        env = Environment()
+        assert env.operator.tracer.enabled is False
