@@ -11,6 +11,8 @@ controller.go:228-235); consumed when constructing offerings
 
 from __future__ import annotations
 
+import threading
+
 from karpenter_tpu.cache.ttl import TTLCache, UNAVAILABLE_OFFERINGS_TTL
 from karpenter_tpu.utils.clock import Clock
 
@@ -19,6 +21,11 @@ class UnavailableOfferings:
     def __init__(self, clock: Clock, ttl: float = UNAVAILABLE_OFFERINGS_TTL):
         self._cache = TTLCache(clock, ttl)
         self.seq_num = 0
+        # marks arrive concurrently from the interruption worker pool; an
+        # unsynchronized += can lose updates (or regress the counter),
+        # silently skipping the seqnum-keyed instance-type cache
+        # invalidation downstream
+        self._seq_lock = threading.Lock()
 
     @staticmethod
     def _key(capacity_type: str, instance_type: str, zone: str) -> str:
@@ -31,8 +38,10 @@ class UnavailableOfferings:
         self, capacity_type: str, instance_type: str, zone: str, reason: str = ""
     ) -> None:
         self._cache.set(self._key(capacity_type, instance_type, zone), reason or True)
-        self.seq_num += 1
+        with self._seq_lock:
+            self.seq_num += 1
 
     def flush(self) -> None:
         self._cache.flush()
-        self.seq_num += 1
+        with self._seq_lock:
+            self.seq_num += 1
