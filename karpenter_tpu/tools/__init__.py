@@ -1,0 +1,1 @@
+"""Operational tooling (reference tools/): allocatable-diff and kompat."""

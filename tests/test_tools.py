@@ -1,0 +1,153 @@
+"""Operational tools (reference tools/): allocatable-diff + kompat."""
+
+import csv
+
+import pytest
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.testing import Environment
+from karpenter_tpu.tools import kompat
+from karpenter_tpu.tools.allocatable_diff import (
+    diff_rows,
+    overpromised,
+    write_csv,
+)
+
+
+class TestAllocatableDiff:
+    @pytest.fixture()
+    def fleet(self):
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool()
+        for _ in range(6):
+            env.kube.put_pod(Pod(requests=Resources(cpu=2, memory="4Gi")))
+        env.settle()
+        assert env.kube.nodes
+        return env
+
+    def test_rows_cover_every_managed_node(self, fleet):
+        report = diff_rows(fleet.operator)
+        rows = report.rows
+        assert not report.skipped
+        assert len(rows) == len(fleet.kube.nodes)
+        for r in rows:
+            assert r.instance_type
+            assert r.expected_capacity_mem_mi > 0
+            assert r.expected_alloc_mem_mi < r.expected_capacity_mem_mi
+
+    def test_fake_kubelet_matches_model_exactly(self, fleet):
+        """The fake kubelet registers nodes straight from the computed
+        claim, so expected == actual and nothing is overpromised — the
+        calibrated-baseline case the reference tool reports as all-zero
+        diff columns."""
+        rows = diff_rows(fleet.operator).rows
+        assert rows and not overpromised(rows)
+        for r in rows:
+            assert r.alloc_mem_diff_mi == 0 and r.alloc_cpu_diff_m == 0
+
+    def test_detects_overpromise(self, fleet):
+        """Shrink a node's ACTUAL allocatable (a kubelet reserving more
+        than the model assumes): the tool must flag the node."""
+        node = next(iter(fleet.kube.nodes.values()))
+        node.allocatable = Resources(
+            cpu=node.allocatable.get("cpu") - 0.5,
+            memory=f"{int(node.allocatable.get('memory')) - 2**30}",
+            pods=110,
+        )
+        bad = overpromised(diff_rows(fleet.operator).rows)
+        assert [r.node for r in bad] == [node.name]
+        assert bad[0].alloc_mem_diff_mi > 0
+
+    def test_skipped_nodes_reported(self, fleet):
+        """A node whose type left the listing is a finding, not a silent
+        omission."""
+        node = next(iter(fleet.kube.nodes.values()))
+        node.labels[L.LABEL_INSTANCE_TYPE] = "ghost.type"
+        report = diff_rows(fleet.operator)
+        assert node.name in report.skipped
+
+    def test_csv_round_trip(self, fleet, tmp_path):
+        rows = diff_rows(fleet.operator).rows
+        out = tmp_path / "diff.csv"
+        write_csv(rows, str(out))
+        with open(out) as f:
+            got = list(csv.reader(f))
+        assert len(got) == 2 + len(rows)  # two header rows
+        assert got[0][0] == "Instance Type"
+        assert got[2][0] == rows[0].instance_type
+
+
+MATRIX = {
+    "name": "karpenter-tpu",
+    "compatibility": [
+        {"appVersion": "0.30.x", "minK8sVersion": "1.23", "maxK8sVersion": "1.27"},
+        {"appVersion": "0.31.x", "minK8sVersion": "1.23", "maxK8sVersion": "1.28"},
+        {"appVersion": "0.32.0", "minK8sVersion": "1.25", "maxK8sVersion": "1.28"},
+    ],
+}
+
+
+class TestKompat:
+    def test_compatible_within_bracket(self):
+        m = kompat.parse(MATRIX)
+        assert m.compatible("0.31.4", "1.28")  # x-wildcard patch
+        assert m.compatible("0.31.0", "1.23")
+        assert not m.compatible("0.30.2", "1.28")  # above max
+        assert not m.compatible("0.32.0", "1.24")  # below min
+
+    def test_unknown_version_raises(self):
+        m = kompat.parse(MATRIX)
+        with pytest.raises(KeyError):
+            m.compatible("9.9.9", "1.27")
+
+    def test_exact_version_requires_exact_match(self):
+        m = kompat.parse(MATRIX)
+        assert m.find("0.32.0") is not None
+        assert m.find("0.32.1") is None  # no wildcard on that row
+
+    def test_last_n_and_markdown(self):
+        m = kompat.parse(MATRIX).last_n(2)
+        md = m.markdown()
+        lines = md.splitlines()
+        assert len(lines) == 4
+        assert "0.31.x" in lines[0] and "0.30.x" not in lines[0]
+        assert lines[2].startswith("| min |")
+        assert lines[3].startswith("| max |")
+
+    def test_cluster_patch_level_ignored(self):
+        """A real cluster version like 1.28.2 sits INSIDE the 1.28 max
+        bracket: the compatibility check is minor-granular."""
+        m = kompat.parse(MATRIX)
+        assert m.compatible("0.31.0", "1.28.2")
+        assert not m.compatible("0.31.0", "1.29.0")
+
+    def test_unquoted_trailing_zero_versions_survive_yaml(self, tmp_path):
+        """`maxK8sVersion: 1.30` unquoted must stay '1.30', not the float
+        1.3 (BaseLoader keeps scalars strings, like the Go decoder)."""
+        path = tmp_path / "m.yaml"
+        path.write_text(
+            "name: t\ncompatibility:\n"
+            "  - appVersion: 0.30\n"
+            "    minK8sVersion: 1.23\n"
+            "    maxK8sVersion: 1.30\n"
+        )
+        m = kompat.load(str(path))
+        assert m.rows[0].max_k8s == "1.30"
+        assert m.compatible("0.30", "1.30")
+        assert not m.compatible("0.30", "1.31")
+
+    def test_cli_round_trip(self, tmp_path):
+        import yaml
+
+        path = tmp_path / "matrix.yaml"
+        path.write_text(yaml.safe_dump(MATRIX))
+        assert kompat.main([str(path), "--app-version", "0.31.0",
+                            "--k8s-version", "1.27"]) == 0
+        assert kompat.main([str(path), "--app-version", "0.30.0",
+                            "--k8s-version", "1.28"]) == 1
+        assert kompat.main([str(path), "-n", "2"]) == 0
+        # unknown app version (e.g. trimmed by --last-n): diagnostic, rc 2
+        assert kompat.main([str(path), "-n", "1", "--app-version", "0.30.0",
+                            "--k8s-version", "1.27"]) == 2
