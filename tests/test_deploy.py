@@ -1,0 +1,128 @@
+"""Deployment story (reference charts/karpenter): the chart renders to
+valid manifests, and the rendered settings configmap actually loads as
+the controller's Settings — the chart and the binary cannot drift."""
+
+import json
+
+import pytest
+import yaml
+
+from karpenter_tpu.api import Settings
+from karpenter_tpu.tools.render_chart import render_chart, render_template
+
+CHART = "deploy/chart"
+SET = {"settings.cluster_name": "prod-cluster"}
+
+
+def _docs():
+    out = []
+    for rendered in render_chart(CHART, dict(SET)):
+        out.extend(d for d in yaml.safe_load_all(rendered) if d)
+    return {(d["kind"], d["metadata"]["name"]): d for d in out}
+
+
+class TestChart:
+    def test_renders_all_expected_kinds(self):
+        docs = _docs()
+        kinds = {k for k, _ in docs}
+        assert kinds == {
+            "Deployment", "Service", "ConfigMap",
+            "ServiceAccount", "PodDisruptionBudget",
+        }
+        # controller + solver deployments, metrics + solver services
+        assert ("Deployment", "karpenter-tpu") in docs
+        assert ("Deployment", "karpenter-tpu-solver") in docs
+
+    def test_rendered_settings_load_as_real_settings(self, tmp_path):
+        """The configmap's settings.json must be accepted verbatim by
+        Settings.from_file — unknown keys or bad types fail the test,
+        so the chart can't drift from the binary."""
+        docs = _docs()
+        cm = docs[("ConfigMap", "karpenter-tpu-global-settings")]
+        payload = cm["data"]["settings.json"]
+        path = tmp_path / "settings.json"
+        path.write_text(payload)
+        settings = Settings.from_file(str(path))
+        settings.validate()
+        assert settings.cluster_name == "prod-cluster"
+        assert settings.batch_idle_duration == 1.0
+        assert settings.enable_profiling is False
+
+    def test_controller_matches_entry_point_contract(self):
+        docs = _docs()
+        dep = docs[("Deployment", "karpenter-tpu")]
+        assert dep["spec"]["replicas"] == 2  # reference Makefile:25-28
+        (c,) = dep["spec"]["template"]["spec"]["containers"]
+        assert c["command"] == ["python", "-m", "karpenter_tpu"]
+        assert any(a.startswith("--settings-file=") for a in c["args"])
+        assert any(a.startswith("--solver-address=") for a in c["args"])
+        port = c["ports"][0]["containerPort"]
+        assert c["livenessProbe"]["httpGet"]["port"] == port
+        assert c["resources"]["requests"] == {"cpu": "1", "memory": "1Gi"}
+        # settings volume wired to the rendered configmap
+        (vol,) = dep["spec"]["template"]["spec"]["volumes"]
+        assert vol["configMap"]["name"] == "karpenter-tpu-global-settings"
+
+    def test_solver_requests_accelerator(self):
+        docs = _docs()
+        dep = docs[("Deployment", "karpenter-tpu-solver")]
+        (c,) = dep["spec"]["template"]["spec"]["containers"]
+        assert c["resources"]["limits"] == {"google.com/tpu": 1}
+        assert c["command"][-1] == "karpenter_tpu.service.server"
+
+    def test_selectors_line_up(self):
+        """Service and PDB selectors must match the deployment labels
+        (the classic copy-paste drift a chart test exists to catch)."""
+        docs = _docs()
+        dep_labels = docs[("Deployment", "karpenter-tpu")]["spec"]["template"][
+            "metadata"
+        ]["labels"]
+        svc = docs[("Service", "karpenter-tpu")]["spec"]["selector"]
+        pdb = docs[("PodDisruptionBudget", "karpenter-tpu")]["spec"][
+            "selector"
+        ]["matchLabels"]
+        assert svc.items() <= dep_labels.items()
+        assert pdb.items() <= dep_labels.items()
+        solver_dep = docs[("Deployment", "karpenter-tpu-solver")]
+        solver_svc = docs[("Service", "karpenter-tpu-solver")]["spec"][
+            "selector"
+        ]
+        assert (
+            solver_svc.items()
+            <= solver_dep["spec"]["template"]["metadata"]["labels"].items()
+        )
+
+    def test_set_overrides(self):
+        docs = {}
+        for rendered in render_chart(
+            CHART, {**SET, "replicas": "3", "solver.port": "9999"}
+        ):
+            for d in yaml.safe_load_all(rendered):
+                if d:
+                    docs[(d["kind"], d["metadata"]["name"])] = d
+        assert docs[("Deployment", "karpenter-tpu")]["spec"]["replicas"] == 3
+        c = docs[("Deployment", "karpenter-tpu")]["spec"]["template"]["spec"][
+            "containers"
+        ][0]
+        assert any(a.endswith(":9999") for a in c["args"])
+
+    def test_unknown_values_path_is_an_error(self):
+        with pytest.raises(KeyError):
+            render_template("x: {{ .Values.not.a.path }}", {"not": {}})
+
+    def test_leftover_template_expression_is_an_error(self):
+        with pytest.raises(ValueError):
+            render_template("x: {{ include \"helper\" . }}", {})
+
+    def test_solver_binds_all_interfaces(self):
+        """server.py defaults to loopback; the chart must override or the
+        solver Service can never reach the pod."""
+        docs = _docs()
+        c = docs[("Deployment", "karpenter-tpu-solver")]["spec"]["template"][
+            "spec"
+        ]["containers"][0]
+        assert "--host=0.0.0.0" in c["args"]
+
+    def test_bad_json_in_settings_fails_at_render_time(self):
+        with pytest.raises(ValueError, match="not valid JSON"):
+            render_chart(CHART, {"settings.cluster_name": 'evil"quote'})
