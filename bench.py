@@ -280,14 +280,39 @@ def build_affinity_topology():
     return [pool], {pool.name: types}, pods
 
 
-def build_hybrid():
-    """Extra: the hybrid-split cost — 9.5k tensor-path pods plus 500 pods
-    whose hostname AFFINITY (same-node co-location) only the oracle
-    understands.  partition_pods sends just their closure to the Python
-    oracle, seeded with the tensor half's placements."""
+def _coloc_pods(cross_class: bool):
+    """100 hostname co-location groups x 5 pods.  Self-selecting groups
+    compile to the tensor path (macro placement units,
+    ops/tensorize.py:class_unsupported_reason); adding a second label
+    variant per group makes the selector CROSS-CLASS, which only the
+    oracle understands — the hybrid-split stressor."""
     from karpenter_tpu.api import Pod, Resources
     from karpenter_tpu.api import labels as L
     from karpenter_tpu.api.objects import PodAffinityTerm
+
+    pods = []
+    for g in range(100):
+        term = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", f"host-{g}"),)
+        )
+        for i in range(5):
+            labels = {"pair": f"host-{g}"}
+            if cross_class:
+                labels["variant"] = str(i % 2)
+            pods.append(
+                Pod(
+                    labels=labels,
+                    requests=Resources(cpu=1, memory="2Gi"),
+                    pod_affinity=[term],
+                )
+            )
+    return pods
+
+
+def _coloc_problem(cross_class: bool):
+    """9.5k plain pods + the 500 co-location pods: ONE base problem so the
+    hybrid and tensor variants measure the same workload."""
+    from karpenter_tpu.api import Pod, Resources
 
     pool, types, _ = build_problem()
     sizes = [
@@ -296,20 +321,23 @@ def build_hybrid():
         Resources(cpu=2, memory="4Gi"),
     ]
     pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(9_500)]
-    for g in range(100):  # 100 co-location groups x 5 pods, oracle-only
-        label = {"pair": f"host-{g}"}
-        term = PodAffinityTerm(
-            topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", f"host-{g}"),)
-        )
-        for i in range(5):
-            pods.append(
-                Pod(
-                    labels=dict(label),
-                    requests=Resources(cpu=1, memory="2Gi"),
-                    pod_affinity=[term],
-                )
-            )
+    pods += _coloc_pods(cross_class=cross_class)
     return [pool], {pool.name: types}, pods
+
+
+def build_hybrid():
+    """Extra: the hybrid-split cost — the co-location pods are CROSS-CLASS
+    (two label variants under one selector), which only the oracle
+    understands.  partition_pods sends just their closure to the Python
+    oracle, seeded with the tensor half's placements."""
+    return _coloc_problem(cross_class=True)
+
+
+def build_coloc_tensor():
+    """Extra: the same workload but SELF-selecting co-location, which the
+    tensor path compiles as macro placement units — the compiled
+    speedup over the hybrid split on identical pods."""
+    return _coloc_problem(cross_class=False)
 
 
 def build_multipool_spot():
@@ -466,6 +494,12 @@ def main() -> None:
     _run_scheduler_config(
         "schedule_10k_hybrid_500_oracle_pods_p50",
         pools, inventory, pods, expect_path="hybrid", allow_unplaced=25,
+    )
+
+    pools, inventory, pods = build_coloc_tensor()
+    _run_scheduler_config(
+        "schedule_10k_coloc_500_pods_tensor_p50",
+        pools, inventory, pods, expect_path="tensor",
     )
 
     # flagship last: a single-line consumer sees the headline metric

@@ -41,10 +41,13 @@ from karpenter_tpu.ops.tensorize import CompiledProblem
 class PackResult(NamedTuple):
     """Device outputs of one packing solve."""
 
-    take: jax.Array  # [G, K] int32 — pods of class g placed on slot k
-    leftover: jax.Array  # [G] int32 — pods that fit nowhere
+    # counts are PLACEMENT UNITS, not raw pods: a hostname co-location
+    # macro class (tensorize.ClassMeta.group_size) is one unit covering
+    # its whole group — decode expands units back to pods
+    take: jax.Array  # [G, K] int32 — units of class g placed on slot k
+    leftover: jax.Array  # [G] int32 — units that fit nowhere
     node_cfg: jax.Array  # [K] int32 — config row per slot (-1 = unused)
-    node_pods: jax.Array  # [K] int32 — total pods per slot
+    node_pods: jax.Array  # [K] int32 — total placement units per slot
     node_used: jax.Array  # [K, R] float32 — final residual usage
     # optional pre-bundled (take+leftover+cfg+used) flat buffer: present on
     # the buffered path so the solver's fetch is exactly ONE transfer

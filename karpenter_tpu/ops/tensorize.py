@@ -283,6 +283,12 @@ class ClassMeta:
     track_slot: int = 0  # sig-count slot for anti-affinity/hostname-spread
     infeasible: bool = False  # compile-time-proven unschedulable
     unsched_reason: str = ""  # decode reason when infeasible
+    # hostname co-location macro: when > 0 the class is ONE placement unit
+    # covering all `pods` (requests is their SUM); a take of 1 assigns the
+    # whole group to that node, a leftover of 1 leaves the whole group
+    # unschedulable (real-scheduler bind semantics: once the first member
+    # binds, required hostname affinity forces every member to that node)
+    group_size: int = 0
 
 
 @dataclass
@@ -332,16 +338,23 @@ def class_unsupported_reason(rep: Pod) -> str:
       (the whole affinity component pins to one zone)
     - self-selecting zone-keyed anti-affinity -> per-zone singleton split
     - self-selecting hostname anti-affinity -> max-1-per-node cap
+    - self-selecting hostname AFFINITY (same-node co-location) -> one
+      macro placement unit carrying the whole group's summed requests
     - hostname/zone topology spread -> per-node caps / zone shares
 
-    Everything else (hostname affinity = same-node co-location; exotic
-    topology keys) goes to the oracle half of a hybrid solve
-    (scheduling/solver.py).
+    Everything else (cross-class selectors; exotic topology keys) goes to
+    the oracle half of a hybrid solve (scheduling/solver.py).
     """
     has_zone_aff = False
     has_zone_anti = False
+    has_host_aff = False
     for t in rep.pod_affinity:
         if not t.anti:
+            if t.topology_key == L.LABEL_HOSTNAME:
+                if not t.selects(rep):
+                    return "hostname affinity selector not matching own pods"
+                has_host_aff = True
+                continue
             if t.topology_key != L.LABEL_ZONE:
                 return f"pod affinity on topology key {t.topology_key}"
             has_zone_aff = True
@@ -364,6 +377,16 @@ def class_unsupported_reason(rep: Pod) -> str:
         return "zone affinity combined with another zone constraint"
     if has_zone_anti and zone_spread:
         return "zone anti-affinity combined with zone spread"
+    if has_host_aff and (
+        has_zone_aff
+        or has_zone_anti
+        or zone_spread
+        or rep.topology_spread
+        or any(t.anti for t in rep.pod_affinity)
+    ):
+        # the macro unit is a single opaque placement; combining it with
+        # per-pod zone/spread/anti accounting needs the oracle
+        return "hostname co-location combined with another constraint"
     for c in rep.topology_spread:
         if c.topology_key not in (L.LABEL_HOSTNAME, L.LABEL_ZONE):
             return f"topology spread on key {c.topology_key}"
@@ -398,6 +421,7 @@ def partition_pods(
 
 def partition_groups(
     pods: Sequence[Pod],
+    existing: Sequence["StateNode"] = (),
 ) -> Tuple[List[Tuple[Tuple, List[Pod]]], List[Pod], str]:
     """Split a batch into (tensor-solvable class groups, oracle-only pods,
     reason).
@@ -434,6 +458,13 @@ def partition_groups(
         sig_of.append(s)
     m = len(sig_rep)
     reasons = [class_unsupported_reason(r) for r in sig_rep]
+    # built ONCE for the live-member checks below: a selector term is a
+    # label conjunction, so frozenset subset over each live pod's label
+    # items is exact and C-speed (vs a per-signature Python rescan of
+    # every live pod)
+    live_label_sets = [
+        frozenset(bp.labels.items()) for sn in existing for bp in sn.pods
+    ]
     sel_idx = [
         i for i, r in enumerate(sig_rep) if r.pod_affinity or r.topology_spread
     ]
@@ -445,6 +476,33 @@ def partition_groups(
             reasons[i] = reasons[i] or (
                 "zone anti-affinity across multiple resource classes"
             )
+        host_aff_terms = [
+            t
+            for t in rep.pod_affinity
+            if not t.anti and t.topology_key == L.LABEL_HOSTNAME
+        ]
+        if host_aff_terms:
+            # the macro merges ONE (sig, requests) class; a sig spanning
+            # request groups, a selector reaching another sig, or live
+            # members (the group must JOIN their node, which the macro
+            # can't express) all need the oracle
+            if sig_count[i] > 1:
+                reasons[i] = reasons[i] or (
+                    "hostname co-location across multiple resource classes"
+                )
+            for j, b in enumerate(sig_rep):
+                if j != i and any(t.selects(b) for t in host_aff_terms):
+                    why = "hostname co-location coupling distinct pod classes"
+                    reasons[i] = reasons[i] or why
+                    reasons[j] = reasons[j] or why
+            if live_label_sets and any(
+                frozenset(t.label_selector) <= pairs
+                for t in host_aff_terms
+                for pairs in live_label_sets
+            ):
+                reasons[i] = reasons[i] or (
+                    "hostname co-location with members on live nodes"
+                )
         for t in rep.pod_affinity:
             if not t.anti:
                 continue
@@ -477,7 +535,10 @@ def partition_groups(
                     and c.selects(b)
                     for c in b.topology_spread
                 ) or any(
-                    tt.anti and tt.topology_key == L.LABEL_ZONE
+                    tt.topology_key == L.LABEL_ZONE
+                    and tt.anti
+                    or tt.topology_key == L.LABEL_HOSTNAME
+                    and not tt.anti
                     for tt in b.pod_affinity
                 ):
                     why = "zone affinity coupling a zone-constrained class"
@@ -510,10 +571,14 @@ def partition_groups(
     return sup_groups, unsupported, why
 
 
-def _unsupported_reason(pods: Sequence[Pod]) -> str:
+def _unsupported_reason(
+    pods: Sequence[Pod], existing: Sequence["StateNode"] = ()
+) -> str:
     """Whole-batch gate used by `compile_problem`: non-empty when ANY pod
-    needs the oracle (callers that cannot hybrid-split fall back whole)."""
-    _, unsupported, why = partition_pods(pods)
+    needs the oracle (callers that cannot hybrid-split fall back whole).
+    `existing` matters: co-location groups with members already on live
+    nodes must JOIN those nodes, which only the oracle expresses."""
+    _, unsupported, why = partition_groups(pods, existing=existing)
     return why if unsupported else ""
 
 
@@ -593,7 +658,7 @@ def compile_problem(
         groups = _class_groups(pods)
     reps = [members[0] for _, members in groups]
     axes = _axes_for(reps)
-    reason = "" if presplit else _unsupported_reason(pods)
+    reason = "" if presplit else _unsupported_reason(pods, existing)
     if catalog is None or catalog.axes != axes:
         catalog = build_catalog(pools, instance_types, daemonsets, axes)
     pools = catalog.pools
@@ -648,7 +713,28 @@ def compile_problem(
         slot = 0
         if maxper < BIG:
             slot = track_slots.setdefault(sig, len(track_slots) + 1)
-        if gi in anchor_of:
+        if any(
+            not t.anti and t.topology_key == L.LABEL_HOSTNAME
+            for t in rep.pod_affinity
+        ):
+            # self-selecting hostname co-location: the group is ONE
+            # placement unit with summed requests (partition_groups
+            # guarantees single-class, no live members).  If no single
+            # node can hold the sum, the whole group is unschedulable —
+            # real-scheduler bind semantics, where the first bound member
+            # pins every other member to its node
+            total = Resources()
+            for m in members:
+                total = total + m.requests
+            classes.append(
+                ClassMeta(
+                    pods=members,
+                    requests=total,
+                    signature=sig,
+                    group_size=len(members),
+                )
+            )
+        elif gi in anchor_of:
             zone = anchor_of[gi]
             if zone is None:
                 classes.append(
@@ -889,7 +975,11 @@ def compile_problem(
         classes=classes,
         configs=configs,
         req=req_mat,
-        cnt=np.array([len(cm.pods) for cm in classes], dtype=np.int32),
+        cnt=np.array(
+            # a co-location macro is ONE placement unit regardless of size
+            [1 if cm.group_size else len(cm.pods) for cm in classes],
+            dtype=np.int32,
+        ),
         maxper=np.array(
             [min(cm.max_per_node, BIG) for cm in classes], dtype=np.int32
         ),

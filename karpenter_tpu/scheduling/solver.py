@@ -138,7 +138,9 @@ class TensorScheduler:
         O(pods x nodes) Python loop — only its coupled closure goes."""
         pods = list(pods)
         with TRACER.span("solver.partition"):
-            sup_groups, unsupported, _reason = partition_groups(pods)
+            sup_groups, unsupported, _reason = partition_groups(
+                pods, existing=self.existing
+            )
         if not sup_groups:
             with TRACER.span("solver.oracle", pods=len(pods)):
                 return self._oracle(pods)
@@ -348,8 +350,17 @@ class TensorScheduler:
             cursor = 0
             for k in np.nonzero(take[g])[0]:
                 n = int(take[g, k])
-                batch = cm.pods[cursor : cursor + n]
-                cursor += n
+                if cm.group_size:
+                    # co-location macro: one take unit = the WHOLE group,
+                    # and cm.requests is already the group total
+                    batch = cm.pods
+                    cursor = len(cm.pods)
+                    added = cm.requests
+                else:
+                    batch = cm.pods[cursor : cursor + n]
+                    cursor += n
+                    # one scaled add per (class, node) instead of per pod
+                    added = cm.requests.scaled(len(batch))
                 cfg = prob.configs[node_cfg[k]]
                 if cfg.existing is not None:
                     for p in batch:
@@ -357,8 +368,7 @@ class TensorScheduler:
                 else:
                     vn = vnode_for(int(k))
                     vn.pods.extend(batch)
-                    # one scaled add per (class, node) instead of per pod
-                    vn.used = vn.used + cm.requests.scaled(len(batch))
+                    vn.used = vn.used + added
                     slot_classes.setdefault(int(k), []).append(g)
             for p in cm.pods[cursor:]:
                 out.unschedulable[p.key()] = self._why_unschedulable(prob, g)

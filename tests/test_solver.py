@@ -550,13 +550,15 @@ class TestCoupledConstraints:
 
 class TestHybridSolve:
     def test_one_exotic_pod_does_not_oracle_the_batch(self, setup):
-        """A hostname-affinity pod (oracle-only) rides along with a large
-        plain batch: the plain pods solve on the tensor path (round-1
-        VERDICT weak #2 / fix #8)."""
+        """A CROSS-CLASS hostname-affinity group (oracle-only: the
+        selector reaches another class) rides along with a large plain
+        batch: the plain pods solve on the tensor path (round-1 VERDICT
+        weak #2 / fix #8)."""
         pool, types = setup
         plain = [
             Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(200)
         ]
+        anchor = Pod(labels={"team": "y"}, requests=Resources(cpu=1))
         exotic = [
             Pod(
                 labels={"app": "h"},
@@ -564,25 +566,123 @@ class TestHybridSolve:
                 pod_affinity=[
                     PodAffinityTerm(
                         topology_key=L.LABEL_HOSTNAME,
-                        label_selector=(("app", "h"),),
+                        label_selector=(("team", "y"),),
                     )
                 ],
             )
             for _ in range(3)
         ]
         ts = TensorScheduler([pool], {pool.name: types})
-        r = ts.solve(plain + exotic)
+        r = ts.solve(plain + [anchor] + exotic)
         assert ts.last_path == "hybrid"
         assert not r.unschedulable
         placed = sum(len(n.pods) for n in r.new_nodes) + len(
             r.existing_placements
         )
-        assert placed == 203
-        # hostname affinity satisfied: all exotic pods on one node
+        assert placed == 204
+        # hostname affinity satisfied: followers on the anchor's node
         exotic_nodes = {
-            n.name for n in r.new_nodes for p in n.pods if p.labels.get("app") == "h"
+            n.name
+            for n in r.new_nodes
+            for p in n.pods
+            if p.labels.get("app") == "h" or p.labels.get("team") == "y"
         }
         assert len(exotic_nodes) == 1
+
+    def test_self_coloc_group_compiles_to_tensor(self, setup):
+        """Self-selecting hostname co-location now compiles (macro
+        placement unit): pure tensor path, whole group on ONE node."""
+        pool, types = setup
+        plain = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(50)]
+        group = [
+            Pod(
+                labels={"app": "co"},
+                requests=Resources(cpu=1, memory="1Gi"),
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=(("app", "co"),),
+                    )
+                ],
+            )
+            for _ in range(5)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types})
+        r = ts.solve(plain + group)
+        assert ts.last_path == "tensor"
+        assert not r.unschedulable
+        coloc_nodes = {
+            n.name for n in r.new_nodes for p in n.pods
+            if p.labels.get("app") == "co"
+        }
+        assert len(coloc_nodes) == 1
+        node = next(n for n in r.new_nodes if n.name in coloc_nodes)
+        assert sum(1 for p in node.pods if p.labels.get("app") == "co") == 5
+
+    def test_oversized_coloc_group_unschedulable(self, setup):
+        """A group whose sum fits no single node is wholly unschedulable
+        (real-scheduler bind semantics: the first bound member pins all
+        others to its node)."""
+        pool, types = setup
+        biggest = max(t.capacity.cpu for t in types)
+        n = int(biggest // 4) + 2  # 4-cpu members; sum exceeds every node
+        group = [
+            Pod(
+                labels={"app": "huge"},
+                requests=Resources(cpu=4, memory="1Gi"),
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=(("app", "huge"),),
+                    )
+                ],
+            )
+            for _ in range(n)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types})
+        r = ts.solve(group)
+        assert len(r.unschedulable) == n
+
+    def test_coloc_with_live_members_goes_oracle(self, setup):
+        """Members already running on a live node force the oracle (the
+        group must JOIN that node, which the macro can't express)."""
+        from karpenter_tpu.ops.tensorize import partition_groups
+        from karpenter_tpu.state.cluster import StateNode
+
+        pool, types = setup
+        member = Pod(labels={"app": "co"}, requests=Resources(cpu=1))
+        live = StateNode(
+            name="n1", provider_id="i-1", labels={}, taints=[],
+            allocatable=Resources(cpu=8), capacity=Resources(cpu=8),
+            pods=[member],
+        )
+        incoming = [
+            Pod(
+                labels={"app": "co"},
+                requests=Resources(cpu=1),
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=(("app", "co"),),
+                    )
+                ],
+            )
+            for _ in range(2)
+        ]
+        _, unsupported, why = partition_groups(incoming, existing=[live])
+        assert len(unsupported) == 2
+        assert "live nodes" in why
+        # without live members the same pods compile
+        sup, unsupported2, _ = partition_groups(incoming)
+        assert not unsupported2 and sup
+        # the non-presplit compile gate sees live members too (direct
+        # compile_problem callers get the same protection)
+        from karpenter_tpu.ops.tensorize import compile_problem
+
+        prob = compile_problem(
+            incoming, [pool], {pool.name: types}, existing=[live]
+        )
+        assert "live nodes" in prob.unsupported_reason
 
     def test_hybrid_closure_pulls_coupled_classes(self, setup):
         """A spread constraint whose selector reaches an oracle-only class
@@ -623,9 +723,11 @@ class TestHybridSolve:
         for i in range(120):
             pods.append(Pod(requests=Resources(cpu=random.choice([1, 2, 4]))))
         for i in range(4):
+            # two label variants sharing one selector: cross-class
+            # co-location, which stays oracle-only
             pods.append(
                 Pod(
-                    labels={"app": "co"},
+                    labels={"app": "co", "variant": str(i % 2)},
                     requests=Resources(cpu=2),
                     pod_affinity=[
                         PodAffinityTerm(
