@@ -1,0 +1,181 @@
+"""v1alpha -> v1beta1 conversion (reference pkg/apis/v1alpha5/v1alpha1 +
+the karpenter-convert migration mapping): converted objects must pass
+admission validation and drive the real controller loop."""
+
+import pytest
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.legacy import (
+    ConversionError,
+    convert_aws_node_template,
+    convert_provisioner,
+)
+from karpenter_tpu.testing import Environment
+
+PROVISIONER = {
+    "apiVersion": "karpenter.sh/v1alpha5",
+    "kind": "Provisioner",
+    "metadata": {"name": "default"},
+    "spec": {
+        "providerRef": {"name": "default"},
+        "weight": 10,
+        "labels": {"team": "ml"},
+        "taints": [{"key": "dedicated", "value": "ml", "effect": "NoSchedule"}],
+        "requirements": [
+            {"key": L.LABEL_CAPACITY_TYPE, "operator": "In", "values": ["spot"]},
+            {"key": L.LABEL_INSTANCE_CPU, "operator": "Lt", "values": ["33"]},
+        ],
+        "limits": {"resources": {"cpu": "100", "memory": "400Gi"}},
+        "ttlSecondsUntilExpired": 2592000,
+        "consolidation": {"enabled": True},
+        "kubeletConfiguration": {"maxPods": 58},
+    },
+}
+
+NODE_TEMPLATE = {
+    "apiVersion": "karpenter.k8s.aws/v1alpha1",
+    "kind": "AWSNodeTemplate",
+    "metadata": {"name": "default"},
+    "spec": {
+        "amiFamily": "Bottlerocket",
+        "subnetSelector": {"karpenter.sh/discovery": "my-cluster"},
+        "securityGroupSelector": {"Name": "my-sg"},
+        "tags": {"team": "ml"},
+        "userData": "[settings.host]\nmotd = \"hi\"",
+        "blockDeviceMappings": [
+            {"deviceName": "/dev/xvdb", "ebs": {"volumeSize": "100Gi",
+                                                "volumeType": "gp2",
+                                                "encrypted": False}}
+        ],
+    },
+}
+
+
+class TestProvisionerConversion:
+    def test_field_mapping(self):
+        pool = convert_provisioner(PROVISIONER)
+        assert pool.name == "default"
+        assert pool.weight == 10
+        assert pool.node_class_ref == "default"
+        assert pool.labels == {"team": "ml"}
+        assert pool.taints[0].key == "dedicated"
+        assert pool.kubelet_max_pods == 58
+        assert pool.limits.get("cpu") == 100
+        assert pool.disruption.consolidation_policy == "WhenUnderutilized"
+        assert pool.disruption.expire_after == 2592000.0
+        zr = pool.requirements.get(L.LABEL_CAPACITY_TYPE)
+        assert zr is not None and zr.has("spot")
+
+    def test_ttl_after_empty_maps_to_when_empty(self):
+        raw = {
+            "kind": "Provisioner",
+            "metadata": {"name": "p"},
+            "spec": {"ttlSecondsAfterEmpty": 30},
+        }
+        pool = convert_provisioner(raw)
+        assert pool.disruption.consolidation_policy == "WhenEmpty"
+        assert pool.disruption.consolidate_after == 30.0
+
+    def test_legacy_dialect_defaults_applied(self):
+        """The v1alpha5 defaulting webhook pinned capacity-type to
+        on-demand; conversion must preserve that (otherwise migration
+        silently moves workloads to spot)."""
+        pool = convert_provisioner(
+            {"kind": "Provisioner", "metadata": {"name": "p"}, "spec": {}}
+        )
+        ct = pool.requirements.get(L.LABEL_CAPACITY_TYPE)
+        assert ct is not None and ct.has("on-demand") and not ct.has("spot")
+        # and "consolidation off" must never reap empty nodes
+        assert pool.disruption.consolidate_after == float("inf")
+
+    def test_mutual_exclusion_enforced(self):
+        raw = {
+            "kind": "Provisioner",
+            "metadata": {"name": "p"},
+            "spec": {"ttlSecondsAfterEmpty": 30,
+                     "consolidation": {"enabled": True}},
+        }
+        with pytest.raises(ConversionError, match="mutually"):
+            convert_provisioner(raw)
+
+    def test_inline_provider_rejected(self):
+        raw = {
+            "kind": "Provisioner",
+            "metadata": {"name": "p"},
+            "spec": {"provider": {"subnetSelector": {}}},
+        }
+        with pytest.raises(ConversionError, match="providerRef"):
+            convert_provisioner(raw)
+
+
+class TestNodeTemplateConversion:
+    def test_field_mapping(self):
+        nc = convert_aws_node_template(NODE_TEMPLATE)
+        assert nc.name == "default"
+        assert nc.image_family == "accelerated"  # Bottlerocket analogue
+        (term,) = nc.subnet_selector_terms
+        assert term.tags == (("karpenter.sh/discovery", "my-cluster"),)
+        (sg,) = nc.security_group_selector_terms
+        assert sg.tags == (("Name", "my-sg"),)
+        assert nc.user_data.startswith("[settings.host]")
+        (bdm,) = nc.block_device_mappings
+        assert bdm.device_name == "/dev/xvdb"
+        assert bdm.volume_size == 100 * 2**30
+        assert bdm.volume_type == "gp2" and bdm.encrypted is False
+
+    def test_aws_ids_selector(self):
+        raw = {
+            "kind": "AWSNodeTemplate",
+            "metadata": {"name": "t"},
+            "spec": {"subnetSelector": {"aws-ids": "subnet-1, subnet-2"}},
+        }
+        nc = convert_aws_node_template(raw)
+        assert [t.id for t in nc.subnet_selector_terms] == [
+            "subnet-1", "subnet-2",
+        ]
+
+    def test_unknown_family_rejected(self):
+        raw = {
+            "kind": "AWSNodeTemplate",
+            "metadata": {"name": "t"},
+            "spec": {"amiFamily": "Windows2022"},
+        }
+        with pytest.raises(ConversionError, match="amiFamily"):
+            convert_aws_node_template(raw)
+
+
+class TestEndToEnd:
+    def test_converted_objects_drive_the_controller(self):
+        """A converted legacy pair must pass admission (KubeStore write
+        validation) and provision real capacity for a matching pod."""
+        env = Environment()
+        tmpl = {
+            "kind": "AWSNodeTemplate",
+            "metadata": {"name": "default"},
+            "spec": {"subnetSelector": {"Name": "*"},
+                     "securityGroupSelector": {"Name": "*"}},
+        }
+        prov = {
+            "kind": "Provisioner",
+            "metadata": {"name": "default"},
+            "spec": {
+                "providerRef": {"name": "default"},
+                "consolidation": {"enabled": True},
+                "taints": [{"key": "dedicated", "value": "ml",
+                            "effect": "NoSchedule"}],
+            },
+        }
+        env.kube.put_node_class(convert_aws_node_template(tmpl))
+        env.kube.put_node_pool(convert_provisioner(prov))
+        from karpenter_tpu.api.objects import Toleration
+
+        env.kube.put_pod(
+            Pod(
+                requests=Resources(cpu=1, memory="1Gi"),
+                tolerations=[Toleration(key="dedicated", value="ml")],
+            )
+        )
+        env.settle()
+        assert not env.kube.pending_pods()
+        assert env.kube.node_claims
