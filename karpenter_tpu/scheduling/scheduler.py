@@ -100,10 +100,15 @@ class VirtualNode:
         oracle loop's hottest shortcut."""
         ft = self.feasible_types
         if self._headroom_key is not ft:
-            hi = Resources()
+            # raw dict pass, not Resources.merge_max: the rebuild runs on
+            # every commit (feasible_types is replaced), and one Resources
+            # allocation per type measurably taxes the oracle's hot loop
+            hi: Dict[str, float] = {}
             for t in ft:
-                hi = hi.merge_max(t.allocatable())
-            self._headroom = dict(hi.items())
+                for axis, v in t.allocatable().items():
+                    if v > hi.get(axis, 0.0):
+                        hi[axis] = v
+            self._headroom = hi
             self._headroom_key = ft
         hi = self._headroom
         for axis, v in requests.items():
