@@ -138,6 +138,11 @@ class QueueMessage:
     body: dict
     receipt: str = ""
     enqueued_at: float = 0.0  # queue-side timestamp (SQS SentTimestamp)
+    # in-flight window: a received message is hidden from other consumers
+    # until this deadline; an undeleted (failed) message reappears after
+    # it — the SQS visibility-timeout contract the interruption
+    # controller's redelivery path relies on
+    invisible_until: float = 0.0
 
 
 class _CallRecorder:
@@ -469,13 +474,20 @@ class FakeCloud:
                 )
             )
 
+    # SQS default VisibilityTimeout (seconds); tests may lower it
+    visibility_timeout = 30.0
+
     def receive_messages(self, max_messages: int = 10) -> List[QueueMessage]:
         with self._lock:
             self.recorder.record("ReceiveMessage", max_messages)
-            batch = self.queue[:max_messages]
+            now = self.clock.now()
+            batch = [
+                m for m in self.queue if m.invisible_until <= now
+            ][:max_messages]
             for m in batch:
                 m.receipt = f"r-{m.id}"
-            return list(batch)
+                m.invisible_until = now + self.visibility_timeout
+            return batch
 
     def delete_message(self, message: QueueMessage) -> None:
         with self._lock:

@@ -300,6 +300,11 @@ class TestInterruption:
             "karpenter_interruption_message_errors"
         ) == 1
         calls["fail"] = False
+        # the failed message is IN FLIGHT until the visibility timeout
+        # elapses; an immediate poll must not see it (SQS contract)
+        ic.reconcile()
+        assert len(env.cloud.queue) == 1
+        env.clock.step(env.cloud.visibility_timeout + 1)
         ic.reconcile()  # redelivery succeeds
         assert not env.cloud.queue
         assert claim.deleted_at is not None
