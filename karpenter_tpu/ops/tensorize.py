@@ -823,6 +823,15 @@ def compile_problem(
             )
             zr = rep.scheduling_requirements().get(L.LABEL_ZONE)
             cand_zones = [z for z in all_zones if zr is None or zr.has(z)]
+            # ...and by the POOLS' zone admission: spread domains are the
+            # zones some pool could actually create nodes in
+            # (karpenter-core builds domains from provisioner
+            # requirements) — an all-zones universe would anchor the skew
+            # floor at zones nothing can serve
+            pool_zones = _pool_zone_domains(pools, catalog)
+            narrowed = [z for z in cand_zones if z in pool_zones]
+            if narrowed:
+                cand_zones = narrowed
             if not cand_zones:
                 cand_zones = all_zones
             # only split into zones where the class can actually land: at
@@ -1000,6 +1009,34 @@ def compile_problem(
     )
 
 
+def _memo_put(catalog: Catalog, key, value):
+    """feas_memo insert with the shared unbounded-workload backstop."""
+    if len(catalog.feas_memo) > 50_000:
+        catalog.feas_memo.clear()
+    catalog.feas_memo[key] = value
+    return value
+
+
+def _pool_zone_domains(pools: Sequence[NodePool], catalog: Catalog) -> set:
+    """Zone domain universe: offering zones admitted by some pool's
+    TEMPLATE zone requirement.  Pool-side only — no taint or type
+    filtering, matching karpenter-core's domain construction and the
+    Kubernetes default of nodeTaintsPolicy: Ignore (the oracle's
+    Scheduler.__init__ builds the identical universe).  Pod-independent,
+    so it memoizes once per catalog."""
+    out = catalog.feas_memo.get("domains")
+    if out is None:
+        out = set()
+        for pool in pools:
+            zr = pool.template_requirements().get(L.LABEL_ZONE)
+            pr = catalog.pool_rows.get(pool.name)
+            if pr is None:
+                continue
+            out.update(z for z in pr.zones if zr is None or zr.has(z))
+        _memo_put(catalog, "domains", out)
+    return out
+
+
 def _feasible_zones(
     rep: Pod,
     catalog: Catalog,
@@ -1009,25 +1046,40 @@ def _feasible_zones(
 ) -> set:
     """Zones where `rep`'s class has >=1 feasible placement: a
     label-compatible, resource-fitting openable config, or an admitting
-    existing node with room for the request."""
-    sched = rep.scheduling_requirements()
-    req_vec = _vec(requests, catalog.axes)
-    pools_by_name = {p.name: p for p in pools}
-    zones: set = set()
+    existing node with room for the request.
+
+    The OPENABLE half depends only on (signature, requests) and the
+    catalog snapshot, so it memoizes for the catalog's lifetime (the
+    same reasoning as `_pool_feas`); only the live-node half is
+    recomputed per solve."""
     sig = rep.constraint_signature()
-    for pname, pr in catalog.pool_rows.items():
-        ent = _pool_feas(catalog, rep, sig, pname, pools_by_name)
-        if ent is None:
-            continue
-        type_ok = ent[0]
-        fits = (req_vec[None, :] <= catalog.alloc[pr.rows] + 1e-6).all(axis=1)
-        ok_rows = type_ok[pr.t_of] & fits
-        zones.update(pr.zones[z] for z in set(pr.z_of[ok_rows].tolist()))
-    for sn in live:
-        if sn.zone and sn.zone not in zones and _fits_existing(rep, sched, sn):
-            if (sn.used + requests).fits(sn.allocatable):
-                zones.add(sn.zone)
-    return zones
+    memo_key = ("zones", sig, tuple(sorted(requests.items())))
+    zones = catalog.feas_memo.get(memo_key)
+    if zones is None:
+        req_vec = _vec(requests, catalog.axes)
+        pools_by_name = {p.name: p for p in pools}
+        zones = set()
+        for pname, pr in catalog.pool_rows.items():
+            ent = _pool_feas(catalog, rep, sig, pname, pools_by_name)
+            if ent is None:
+                continue
+            type_ok, zone_ok, ct_ok = ent
+            fits = (req_vec[None, :] <= catalog.alloc[pr.rows] + 1e-6).all(axis=1)
+            # the FULL admission mask, same as the feas[G, C] assembly: a
+            # pool zone-restricted to zone-a must not report b/c feasible
+            ok_rows = (
+                type_ok[pr.t_of] & zone_ok[pr.z_of] & ct_ok[pr.ct_of] & fits
+            )
+            zones.update(pr.zones[z] for z in set(pr.z_of[ok_rows].tolist()))
+        _memo_put(catalog, memo_key, zones)
+    out = set(zones)
+    if live:
+        sched = rep.scheduling_requirements()
+        for sn in live:
+            if sn.zone and sn.zone not in out and _fits_existing(rep, sched, sn):
+                if (sn.used + requests).fits(sn.allocatable):
+                    out.add(sn.zone)
+    return out
 
 
 def _anchor_zone_affinity(

@@ -332,10 +332,20 @@ class Scheduler:
         )
         self.instance_types = instance_types
         self.daemonsets = list(daemonsets)
+        # topology domains are the zones some pool could actually create
+        # nodes in — offering zones INTERSECTED with the pool's template
+        # zone requirement (karpenter-core builds spread domains from the
+        # provisioner requirements; an all-offerings universe would count
+        # zones a zone-restricted pool can never serve, wedging
+        # DoNotSchedule spreads) — plus the zones of live nodes
         zones = set(zones)
-        for types in instance_types.values():
-            for t in types:
-                zones.update(o.zone for o in t.offerings)
+        for pool in self.pools:
+            zr = pool.template_requirements().get(ZONE)
+            for t in instance_types.get(pool.name, []):
+                for o in t.offerings:
+                    if zr is None or zr.has(o.zone):
+                        zones.add(o.zone)
+        zones.update(sn.zone for sn in existing if sn.zone)
         self.topology = TopologyTracker(sorted(zones))
         self.existing = [ExistingNode(sn, used=sn.used) for sn in existing]
         # every existing node is a hostname domain even while empty

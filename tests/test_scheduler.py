@@ -107,6 +107,78 @@ def test_zone_topology_spread(env):
     assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
 
 
+def test_spread_domains_respect_pool_zone_restriction(env):
+    """Spread domains are zones some pool can actually create nodes in
+    (karpenter-core builds them from provisioner requirements): a pool
+    restricted to one zone must not wedge a DoNotSchedule spread on the
+    zones it can never serve — oracle and tensor paths both."""
+    from karpenter_tpu.api import Requirement, Requirements
+    from karpenter_tpu.api.requirements import Op
+    from karpenter_tpu.scheduling.solver import TensorScheduler
+
+    pool = env.default_node_pool(
+        requirements=Requirements(
+            [Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])]
+        )
+    )
+    env.default_node_class()
+    types = {pool.name: env.instance_types.list(pool=pool)}
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=L.LABEL_ZONE,
+        label_selector=(("app", "web"),),
+    )
+    pods = [
+        Pod(labels={"app": "web"}, requests=Resources(cpu=1),
+            topology_spread=[spread])
+        for _ in range(6)
+    ]
+    oracle = Scheduler([pool], types).solve(list(pods))
+    assert not oracle.unschedulable
+    for ts_path in (TensorScheduler([pool], types),):
+        r = ts_path.solve(list(pods))
+        assert not r.unschedulable, ts_path.last_path
+        for n in r.new_nodes:
+            assert n.zone_options() == {"zone-a"}
+
+
+def test_spread_domains_include_tainted_pools(env):
+    """nodeTaintsPolicy defaults to Ignore: a tainted pool's zones still
+    COUNT as spread domains (even though the untolerating pod can't land
+    there), and the oracle and tensor paths must agree on the outcome."""
+    from karpenter_tpu.api import Requirement, Requirements, Taint
+    from karpenter_tpu.api.requirements import Op
+    from karpenter_tpu.scheduling.solver import TensorScheduler
+
+    nc = env.default_node_class()
+    pa = env.default_node_pool(
+        name="a",
+        requirements=Requirements(
+            [Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])]
+        ),
+    )
+    pb = env.default_node_pool(
+        name="b", taints=[Taint("team", "ml", "NoSchedule")]
+    )
+    types = {p.name: env.instance_types.list(p, nc) for p in (pa, pb)}
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=L.LABEL_ZONE,
+        label_selector=(("app", "w"),),
+    )
+    pods = [
+        Pod(labels={"app": "w"}, requests=Resources(cpu=1),
+            topology_spread=[spread])
+        for _ in range(6)
+    ]
+    o = Scheduler([pa, pb], types).solve(list(pods))
+    t = TensorScheduler([pa, pb], types).solve(list(pods))
+    # domains = {a, b, c}; only zone-a has servable capacity for this
+    # pod, so DoNotSchedule caps placements at min+maxSkew = 1 — strict
+    # but CONSISTENT across both paths
+    assert len(o.unschedulable) == len(t.unschedulable) == 5
+
+
 def test_hostname_anti_affinity_one_per_node(env):
     s = make_scheduler(env)
     anti = PodAffinityTerm(
