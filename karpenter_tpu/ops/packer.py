@@ -383,6 +383,18 @@ def bundle_outputs(
     )
 
 
+def fetch_bundled(res: "PackResult"):
+    """The single-read fetch: bundle the kernel outputs on device (or use
+    the pre-bundled buffer when present), read ONE array, slice it apart
+    on the host.  Shared by the in-process solver and the sidecar so the
+    transfer-hygiene contract can't desynchronize between them.
+    Returns host (take, leftover, node_cfg, node_used)."""
+    buf = res.bundle
+    if buf is None:
+        buf = bundle_outputs(res.take, res.leftover, res.node_cfg, res.node_used)
+    return unbundle_outputs(np.asarray(buf), res.take, res.node_used.shape)
+
+
 def unbundle_outputs(
     buf: np.ndarray, take_dev: jax.Array, node_used_shape: Tuple[int, int]
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:

@@ -205,7 +205,7 @@ class TensorScheduler:
         with TRACER.span("solver.pack"):
             result = self.pack_fn(prob, objective=self.objective)
         from karpenter_tpu.ops import pallas_packer
-        from karpenter_tpu.ops.packer import bundle_outputs, unbundle_outputs
+        from karpenter_tpu.ops.packer import fetch_bundled
 
         self.last_kernel = (
             pallas_packer.LAST_KERNEL
@@ -217,19 +217,10 @@ class TensorScheduler:
             # ONE transfer — literally one device array — for everything
             # decode needs: the tunneled link pays a full round trip per
             # fetched array, so the kernel outputs are bitcast-bundled
-            # into a single flat buffer on device and sliced apart here
-            if getattr(res, "bundle", None) is not None:
-                # buffered path pre-bundled inside the kernel dispatch
-                return unbundle_outputs(
-                    np.asarray(res.bundle), res.take, res.node_used.shape
-                )
+            # into a single flat buffer on device and sliced apart on the
+            # host (fetch_bundled, shared with the sidecar server)
             if isinstance(res.take, jax.Array):
-                buf = np.asarray(
-                    bundle_outputs(
-                        res.take, res.leftover, res.node_cfg, res.node_used
-                    )
-                )
-                return unbundle_outputs(buf, res.take, res.node_used.shape)
+                return fetch_bundled(res)
             return jax.device_get(
                 (res.take, res.leftover, res.node_cfg, res.node_used)
             )

@@ -89,9 +89,7 @@ class SolverServer(socketserver.ThreadingTCPServer):
         return encode({"status": "error", "error": f"unknown method {method}"}, {})
 
     def _pack(self, header: dict, arrays: dict) -> bytes:
-        import jax
-
-        from karpenter_tpu.ops.packer import pack_kernel
+        from karpenter_tpu.ops.packer import fetch_bundled, pack_kernel
 
         missing = [n for n in PACK_ARG_ORDER if n not in arrays]
         if missing:
@@ -106,10 +104,17 @@ class SolverServer(socketserver.ThreadingTCPServer):
             k_slots=int(header["k_slots"]),
             objective=header.get("objective", "nodes"),
         )
-        out = jax.device_get(result)
+        # ONE device read (the sidecar's TPU link pays a round trip per
+        # fetched array, like the in-process solver's fetch); node_pods
+        # reconstructs exactly from the inputs: npods0 + per-slot takes
+        take, leftover, node_cfg, node_used = fetch_bundled(result)
+        node_pods = np.asarray(arrays["npods0"], dtype=np.int32) + take.sum(
+            axis=0, dtype=np.int32
+        )
+        out = (take, leftover, node_cfg, node_pods, node_used)
         return encode(
             {"status": "ok"},
-            {name: np.asarray(val) for name, val in zip(PACK_RESULT_FIELDS, out)},
+            {name: val for name, val in zip(PACK_RESULT_FIELDS, out)},
         )
 
     # ------------------------------------------------------------ lifecycle
