@@ -389,7 +389,9 @@ def fetch_bundled(res: "PackResult"):
     on the host.  Shared by the in-process solver and the sidecar so the
     transfer-hygiene contract can't desynchronize between them.
     Returns host (take, leftover, node_cfg, node_used)."""
-    buf = res.bundle
+    # getattr: duck-typed pack results (custom pack_fn namedtuples) may
+    # not carry a bundle field at all
+    buf = getattr(res, "bundle", None)
     if buf is None:
         buf = bundle_outputs(res.take, res.leftover, res.node_cfg, res.node_used)
     return unbundle_outputs(np.asarray(buf), res.take, res.node_used.shape)

@@ -78,6 +78,51 @@ class TestRemoteSolve:
         np.testing.assert_array_equal(np.asarray(local.node_cfg), remote.node_cfg)
         np.testing.assert_array_equal(np.asarray(local.leftover), remote.leftover)
 
+    def test_concurrent_clients_each_get_their_own_answer(self, server):
+        """The sidecar's stated contract: one server, many controllers,
+        requests parallelize across its thread pool — each concurrent
+        client must receive ITS problem's answer, bit-exact with a local
+        solve, never a cross-wired response."""
+        import threading
+
+        env = Environment()
+        pool = env.default_node_pool()
+        env.default_node_class()
+        types = env.instance_types.list(pool, env.kube.get_node_class("default"))
+        # distinct problems: different pod counts -> different placements
+        probs = {
+            n: compile_problem(
+                [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(n)],
+                [pool], {pool.name: types},
+            )
+            for n in (8, 16, 24, 32, 40, 48)
+        }
+        expected = {
+            n: np.asarray(run_pack(p).node_pods) for n, p in probs.items()
+        }
+        errors = []
+
+        def worker(n):
+            try:
+                c = RemoteSolver(*server.address)
+                try:
+                    for _ in range(5):
+                        out = c.pack_problem(probs[n])
+                        np.testing.assert_array_equal(
+                            np.asarray(out.node_pods), expected[n]
+                        )
+                finally:
+                    c.close()
+            except Exception as exc:  # pragma: no cover - failure path
+                errors.append((n, exc))
+
+        threads = [threading.Thread(target=worker, args=(n,)) for n in probs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
     def test_scheduler_with_remote_backend(self, client):
         env = Environment()
         pool = env.default_node_pool()
