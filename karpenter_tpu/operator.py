@@ -75,6 +75,20 @@ class Operator:
         self.tracer.profile_dir = (
             self.settings.profile_dir if self.settings.enable_profiling else ""
         )
+        # connectivity preflight (reference operator.go:190-200's dry-run
+        # DescribeInstanceTypes): an early, actionable failure beats every
+        # controller erroring on its first reconcile
+        try:
+            shapes = cloud.describe_instance_types()
+        except Exception as exc:
+            raise RuntimeError(
+                f"cloud connectivity preflight failed: {exc}"
+            ) from exc
+        if not shapes:
+            raise RuntimeError(
+                "cloud connectivity preflight: instance-type catalog is "
+                "empty — nothing could ever be provisioned"
+            )
 
         # ---- caches + providers, dependency order (operator.go:126-165)
         self.unavailable = UnavailableOfferings(self.clock)

@@ -107,3 +107,18 @@ class TestEntryPoint:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait(timeout=10)
+
+
+class TestPreflight:
+    def test_empty_catalog_fails_fast(self):
+        """Reference operator.go:190-200 dry-runs DescribeInstanceTypes at
+        startup; an unreachable/empty cloud must fail Operator
+        construction with an actionable error, not the first reconcile."""
+        from karpenter_tpu.cloud.fake.backend import FakeCloud
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.state.kube import KubeStore
+        from karpenter_tpu.utils.clock import FakeClock
+
+        cloud = FakeCloud(FakeClock(), shapes=()).with_default_topology()
+        with pytest.raises(RuntimeError, match="preflight"):
+            Operator(cloud, KubeStore())
