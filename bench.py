@@ -283,13 +283,14 @@ def build_affinity_topology():
     return [pool], {pool.name: types}, pods
 
 
-def _coloc_pods(cross_class: bool):
-    """100 hostname co-location groups x 5 pods.  Self-selecting groups
-    compile to the tensor path (macro placement units,
-    ops/tensorize.py:class_unsupported_reason); adding a second label
-    variant per group makes the selector CROSS-CLASS, which only the
-    oracle understands — the hybrid-split stressor."""
-    from karpenter_tpu.api import Pod, Resources
+def _coloc_pods(cross_class: bool, node_equiv: bool = True):
+    """100 hostname co-location groups x 5 pods.  Self-selecting groups and
+    NODE-EQUIVALENT cross-class closures both compile to the tensor path
+    (macro placement units, ops/tensorize.py:_coloc_component_mergeable);
+    making the variant class node-INEQUIVALENT (a toleration only it
+    carries) defeats the closure merge, so only the oracle understands the
+    group — the hybrid-split stressor."""
+    from karpenter_tpu.api import Pod, Resources, Toleration
     from karpenter_tpu.api import labels as L
     from karpenter_tpu.api.objects import PodAffinityTerm
 
@@ -300,19 +301,25 @@ def _coloc_pods(cross_class: bool):
         )
         for i in range(5):
             labels = {"pair": f"host-{g}"}
+            kw = {}
             if cross_class:
                 labels["variant"] = str(i % 2)
+                if not node_equiv and i % 2:
+                    kw["tolerations"] = [
+                        Toleration(key="burst", value="yes", effect="NoSchedule")
+                    ]
             pods.append(
                 Pod(
                     labels=labels,
                     requests=Resources(cpu=1, memory="2Gi"),
                     pod_affinity=[term],
+                    **kw,
                 )
             )
     return pods
 
 
-def _coloc_problem(cross_class: bool):
+def _coloc_problem(cross_class: bool, node_equiv: bool = True):
     """9.5k plain pods + the 500 co-location pods: ONE base problem so the
     hybrid and tensor variants measure the same workload."""
     from karpenter_tpu.api import Pod, Resources
@@ -324,16 +331,16 @@ def _coloc_problem(cross_class: bool):
         Resources(cpu=2, memory="4Gi"),
     ]
     pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(9_500)]
-    pods += _coloc_pods(cross_class=cross_class)
+    pods += _coloc_pods(cross_class=cross_class, node_equiv=node_equiv)
     return [pool], {pool.name: types}, pods
 
 
 def build_hybrid():
-    """Extra: the hybrid-split cost — the co-location pods are CROSS-CLASS
-    (two label variants under one selector), which only the oracle
-    understands.  partition_pods sends just their closure to the Python
+    """Extra: the hybrid-split cost — the co-location closures are
+    node-INEQUIVALENT (a toleration on one variant), which only the oracle
+    understands.  partition_groups sends just their closure to the Python
     oracle, seeded with the tensor half's placements."""
-    return _coloc_problem(cross_class=True)
+    return _coloc_problem(cross_class=True, node_equiv=False)
 
 
 def build_coloc_tensor():
@@ -341,6 +348,13 @@ def build_coloc_tensor():
     tensor path compiles as macro placement units — the compiled
     speedup over the hybrid split on identical pods."""
     return _coloc_problem(cross_class=False)
+
+
+def build_crossclass_coloc():
+    """Extra: node-equivalent CROSS-CLASS closures (two label variants
+    under one selector, same node constraints) — oracle-only before the
+    closure merge, now a compiled macro unit per group."""
+    return _coloc_problem(cross_class=True, node_equiv=True)
 
 
 def build_multipool_spot():
@@ -502,6 +516,12 @@ def main() -> None:
     pools, inventory, pods = build_coloc_tensor()
     _run_scheduler_config(
         "schedule_10k_coloc_500_pods_tensor_p50",
+        pools, inventory, pods, expect_path="tensor",
+    )
+
+    pools, inventory, pods = build_crossclass_coloc()
+    _run_scheduler_config(
+        "schedule_10k_crossclass_coloc_tensor_p50",
         pools, inventory, pods, expect_path="tensor",
     )
 

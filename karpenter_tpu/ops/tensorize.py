@@ -68,8 +68,19 @@ BIG = 2**30  # "unbounded" per-node pod cap
 
 
 def _axes_for(pods: Sequence[Pod]) -> Tuple[str, ...]:
+    return _axes_for_requests([p.requests for p in pods])
+
+
+def _axes_for_requests(requests_list: Sequence[Resources]) -> Tuple[str, ...]:
+    """Resource axes for a solve, derived from per-GROUP request vectors.
+
+    Pass each group's key requests (the SUMMED vector for merged
+    co-location closures) rather than a representative pod's — a non-rep
+    member may carry an extended resource the rep doesn't, and an axis
+    missing here would silently go uncapacitated."""
     extra = sorted(
-        {k for p in pods for k in p.requests.keys()} - set(L.WELL_KNOWN_RESOURCES)
+        {k for r in requests_list for k in r.keys()}
+        - set(L.WELL_KNOWN_RESOURCES)
     )
     return tuple(L.WELL_KNOWN_RESOURCES) + tuple(extra)
 
@@ -407,6 +418,60 @@ def _couples(a: Pod, b: Pod) -> bool:
     )
 
 
+# cross-class hostname-co-location reasons that a node-equivalent closure
+# merge cures (every other reason is structural and keeps the class oracle)
+_HOST_CURABLE = frozenset(
+    [
+        "hostname affinity selector not matching own pods",
+        "hostname co-location across multiple resource classes",
+        "hostname co-location coupling distinct pod classes",
+    ]
+)
+
+
+def _coloc_component_mergeable(
+    comp: Sequence[int],
+    sig_rep: Sequence[Pod],
+    reasons: Sequence[str],
+    live_label_sets: Sequence[frozenset],
+) -> bool:
+    """Whether a hostname-affinity coupled component compiles as ONE macro
+    placement unit: every sig carries only hostname-affinity terms, all
+    sigs are NODE-EQUIVALENT (same node selector, node affinity,
+    tolerations, namespace — they differ only in pod labels/selectors, so
+    one feasibility row represents all), every selector anchors inside the
+    component, and no selector reaches pods already bound on live nodes
+    (those groups must JOIN their node, which a macro can't express)."""
+    node_part = None
+    for s in comp:
+        if reasons[s] and reasons[s] not in _HOST_CURABLE:
+            return False
+        rep = sig_rep[s]
+        if rep.topology_spread or not rep.pod_affinity:
+            return False
+        if any(
+            t.anti or t.topology_key != L.LABEL_HOSTNAME
+            for t in rep.pod_affinity
+        ):
+            return False
+        sig = rep.constraint_signature()
+        part = (sig[0], sig[1], sig[2], rep.namespace)
+        if node_part is None:
+            node_part = part
+        elif part != node_part:
+            return False
+    for s in comp:
+        for t in sig_rep[s].pod_affinity:
+            if not any(t.selects(sig_rep[j]) for j in comp):
+                return False
+            if live_label_sets and any(
+                frozenset(t.label_selector) <= pairs
+                for pairs in live_label_sets
+            ):
+                return False
+    return True
+
+
 def partition_pods(
     pods: Sequence[Pod],
 ) -> Tuple[List[Pod], List[Pod], str]:
@@ -468,6 +533,55 @@ def partition_groups(
     sel_idx = [
         i for i, r in enumerate(sig_rep) if r.pod_affinity or r.topology_spread
     ]
+
+    # inverted label index: selector matching over unique signatures runs
+    # as set intersections (a selector is a label conjunction) instead of
+    # an O(sigs^2) python scan — the closure passes below all use it
+    pair_index: Dict[Tuple[str, str], set] = {}
+    for j, rep in enumerate(sig_rep):
+        for kv in rep.labels.items():
+            pair_index.setdefault(kv, set()).add(j)
+    _no_sigs: set = set()
+    _match_memo: Dict[int, frozenset] = {}
+
+    def matches(sel) -> frozenset:
+        """Sig indices whose pods `sel` selects (empty selector = all)."""
+        got = _match_memo.get(id(sel))
+        if got is not None:
+            return got
+        out = None
+        for kv in sel.label_selector:
+            hit = pair_index.get(kv)
+            if not hit:
+                out = _no_sigs
+                break
+            out = set(hit) if out is None else (out & hit)
+            if not out:
+                break
+        if out is None:
+            out = set(range(m))
+        ns = getattr(sel, "namespaces", ())
+        if ns:
+            out = {j for j in out if sig_rep[j].namespace in ns}
+        _match_memo[id(sel)] = got = frozenset(out)
+        return got
+
+    # union-find over hostname-affinity coupling: a connected component is
+    # one CO-LOCATION CLOSURE; node-equivalent closures compile as a single
+    # macro placement unit instead of falling to the oracle
+    coloc_parent = list(range(m))
+
+    def _find(x: int) -> int:
+        while coloc_parent[x] != x:
+            coloc_parent[x] = coloc_parent[coloc_parent[x]]
+            x = coloc_parent[x]
+        return x
+
+    def _union(a: int, b: int) -> None:
+        ra, rb = _find(a), _find(b)
+        if ra != rb:
+            coloc_parent[rb] = ra
+
     for i in sel_idx:
         rep = sig_rep[i]
         if sig_count[i] > 1 and any(
@@ -482,19 +596,21 @@ def partition_groups(
             if not t.anti and t.topology_key == L.LABEL_HOSTNAME
         ]
         if host_aff_terms:
-            # the macro merges ONE (sig, requests) class; a sig spanning
-            # request groups, a selector reaching another sig, or live
-            # members (the group must JOIN their node, which the macro
-            # can't express) all need the oracle
+            # one (sig, requests) class per macro unless the closure merge
+            # below proves the whole coupled component node-equivalent; a
+            # selector reaching live members (the group must JOIN their
+            # node, which the macro can't express) always needs the oracle
             if sig_count[i] > 1:
                 reasons[i] = reasons[i] or (
                     "hostname co-location across multiple resource classes"
                 )
-            for j, b in enumerate(sig_rep):
-                if j != i and any(t.selects(b) for t in host_aff_terms):
-                    why = "hostname co-location coupling distinct pod classes"
-                    reasons[i] = reasons[i] or why
-                    reasons[j] = reasons[j] or why
+            for t in host_aff_terms:
+                for j in matches(t):
+                    _union(i, j)
+                    if j != i:
+                        why = "hostname co-location coupling distinct pod classes"
+                        reasons[i] = reasons[i] or why
+                        reasons[j] = reasons[j] or why
             if live_label_sets and any(
                 frozenset(t.label_selector) <= pairs
                 for t in host_aff_terms
@@ -506,14 +622,14 @@ def partition_groups(
         for t in rep.pod_affinity:
             if not t.anti:
                 continue
-            for j, b in enumerate(sig_rep):
-                if j != i and t.selects(b):
+            for j in matches(t):
+                if j != i:
                     why = "anti-affinity coupling distinct pod classes"
                     reasons[i] = reasons[i] or why
                     reasons[j] = reasons[j] or why
         for c in rep.topology_spread:
-            for j, b in enumerate(sig_rep):
-                if j != i and c.selects(b):
+            for j in matches(c):
+                if j != i:
                     # the spread group counts another class's pods; the
                     # kernel's per-signature counters can't see them
                     why = "topology spread coupling distinct pod classes"
@@ -522,9 +638,10 @@ def partition_groups(
         for t in rep.pod_affinity:
             if t.anti or t.topology_key != L.LABEL_ZONE:
                 continue
-            for j, b in enumerate(sig_rep):
-                if j == i or not t.selects(b):
+            for j in matches(t):
+                if j == i:
                     continue
+                b = sig_rep[j]
                 # anchoring pins the whole component to one zone, which is
                 # only sound when the selected class has no zone-keyed
                 # constraint of its own to honor (its own zone AFFINITY
@@ -544,13 +661,44 @@ def partition_groups(
                     why = "zone affinity coupling a zone-constrained class"
                     reasons[i] = reasons[i] or why
                     reasons[j] = reasons[j] or why
-    # transitive closure over selector coupling (either direction)
+
+    # cure node-equivalent co-location closures: every sig in the component
+    # differs only in pod labels / hostname-affinity selectors, so the whole
+    # closure is ONE placement unit (summed requests) the kernel expresses
+    # exactly — the cross-class reasons above were provisional
+    comp_members: Dict[int, List[int]] = {}
+    for j in range(m):
+        comp_members.setdefault(_find(j), []).append(j)
+    merge_root: Dict[int, int] = {}
+    for root, comp in comp_members.items():
+        if len(comp) == 1 and sig_count[comp[0]] == 1:
+            continue  # the single-class macro path already handles it
+        if not any(
+            not t.anti and t.topology_key == L.LABEL_HOSTNAME
+            for s in comp
+            for t in sig_rep[s].pod_affinity
+        ):
+            continue
+        if _coloc_component_mergeable(comp, sig_rep, reasons, live_label_sets):
+            for s in comp:
+                if reasons[s] in _HOST_CURABLE:
+                    reasons[s] = ""
+                merge_root[s] = root
+
+    # transitive closure over selector coupling (either direction); a cured
+    # component re-poisons WHOLE (its sigs stay mutually connected), so a
+    # merge never splits across the tensor/oracle boundary
     edges: Dict[int, set] = {}
     for i in sel_idx:
-        for j in range(m):
-            if i != j and _couples(sig_rep[i], sig_rep[j]):
-                edges.setdefault(i, set()).add(j)
-                edges.setdefault(j, set()).add(i)
+        reach: set = set()
+        for t in sig_rep[i].pod_affinity:
+            reach |= matches(t)
+        for c in sig_rep[i].topology_spread:
+            reach |= matches(c)
+        reach.discard(i)
+        for j in reach:
+            edges.setdefault(i, set()).add(j)
+            edges.setdefault(j, set()).add(i)
     frontier = [i for i in range(m) if reasons[i]]
     while frontier:
         i = frontier.pop()
@@ -561,13 +709,23 @@ def partition_groups(
     sup_groups: List[Tuple[Tuple, List[Pod]]] = []
     unsupported: List[Pod] = []
     why = ""
+    merged_members: Dict[int, List[Pod]] = {}
     for i, group in enumerate(group_list):
-        reason = reasons[sig_of[i]]
+        s = sig_of[i]
+        reason = reasons[s]
         if reason:
             unsupported.extend(group[1])
             why = why or reason
+        elif s in merge_root:
+            merged_members.setdefault(merge_root[s], []).extend(group[1])
         else:
             sup_groups.append(group)
+    for members in merged_members.values():
+        rep = members[0]
+        total = Resources()
+        for p in members:
+            total = total + p.requests
+        sup_groups.append(((rep.constraint_signature(), total), members))
     return sup_groups, unsupported, why
 
 
@@ -655,10 +813,19 @@ def compile_problem(
     """
     if groups is None:
         pods = list(pods)
-        groups = _class_groups(pods)
-    reps = [members[0] for _, members in groups]
-    axes = _axes_for(reps)
-    reason = "" if presplit else _unsupported_reason(pods, existing)
+        # merge-aware grouping: node-equivalent co-location closures arrive
+        # as ONE macro group here exactly as they do on the solver's
+        # presplit path
+        sup_groups, unsupported, why = partition_groups(pods, existing=existing)
+        if unsupported:
+            groups = _class_groups(pods)
+            reason = "" if presplit else why
+        else:
+            groups = sup_groups
+            reason = ""
+    else:
+        reason = "" if presplit else _unsupported_reason(pods, existing)
+    axes = _axes_for_requests([key[1] for key, _ in groups])
     if catalog is None or catalog.axes != axes:
         catalog = build_catalog(pools, instance_types, daemonsets, axes)
     pools = catalog.pools

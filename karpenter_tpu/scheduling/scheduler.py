@@ -37,6 +37,10 @@ from karpenter_tpu.state.cluster import StateNode
 
 _vnode_seq = itertools.count()
 
+# sentinel `_headroom_key`: the decode attached a headroom bound computed
+# from the compiled alloc tensor while the node's widen_thunk is pending
+PENDING_WIDEN = object()
+
 
 def _zone_constrained(pod: Pod) -> bool:
     """Pod carries a zone-keyed topology constraint (spread or affinity)."""
@@ -98,6 +102,17 @@ class VirtualNode:
         continued solve most probes hit nodes the tensor pass already
         filled — rejecting them without touching Requirements is the
         oracle loop's hottest shortcut."""
+        if self.widen_thunk is not None and self._headroom_key is PENDING_WIDEN:
+            # decode attached a vectorized upper bound over the yet-unwidened
+            # type set, so a failing probe doesn't force the widen; it may
+            # only OVER-admit (the full scan still decides), and only covers
+            # the compiled axes — anything else falls through to the thunk
+            hi = self._headroom
+            if all(a in hi for a, _ in requests.items()):
+                for axis, v in requests.items():
+                    if v + self.used.get(axis) > hi[axis] + 1e-9:
+                        return False
+                return True
         ft = self.feasible_types
         if self._headroom_key is not ft:
             # raw dict pass, not Resources.merge_max: the rebuild runs on
@@ -115,6 +130,33 @@ class VirtualNode:
             if v + self.used.get(axis) > hi.get(axis, 0.0) + 1e-9:
                 return False
         return True
+
+    # (hi_cpu, hi_mem) computed once per node: a STALE upper bound (type
+    # narrowing only shrinks the true value), so the inline prefilter in
+    # _schedule_open_vnode may over-admit — try_add still decides — but
+    # never wrongly rejects
+    _hi2: Optional[Tuple[float, float]] = None
+
+    def hi_cpu_mem(self) -> Tuple[float, float]:
+        if self._hi2 is None:
+            if self._headroom:
+                hi = self._headroom
+                self._hi2 = (
+                    hi.get("cpu", float("inf")),
+                    hi.get("memory", float("inf")),
+                )
+            elif self.widen_thunk is None:
+                cpu = mem = 0.0
+                for t in self.feasible_types:
+                    a = t.allocatable()
+                    if (c := a.get("cpu")) > cpu:
+                        cpu = c
+                    if (v := a.get("memory")) > mem:
+                        mem = v
+                self._hi2 = (cpu, mem)
+            else:  # no decode hint and a pending widen: stay permissive
+                self._hi2 = (float("inf"), float("inf"))
+        return self._hi2
 
     # -- helpers -------------------------------------------------------------
     def zone_options(self) -> Set[str]:
@@ -209,20 +251,33 @@ class VirtualNode:
         if not feasible:
             return False
 
-        # commit narrows requirements/types: shape-keyed scans are stale
-        self._fit_cache.clear()
-        self.requirements = reqs
+        # commit: shape-keyed label scans go stale only when the merge
+        # actually NARROWED requirements.  Co-location followers (and any
+        # same-shape batch) merge idempotently, so keeping the cache
+        # turns their scans into dict hits; the resource narrowing of
+        # `feasible_types` below stays safe because every probe re-applies
+        # the allocatable mask against its own `used` vector.
+        if reqs != self.requirements:
+            self._fit_cache.clear()
+            self.requirements = reqs
         self.feasible_types = feasible
+        if self._headroom is not None:
+            # keep the stale headroom across the narrowing: the true bound
+            # only shrinks, and the gate needs only an upper bound to make
+            # rejects definitive — recomputing ~all-types allocatable on
+            # every commit was the continued solve's hottest loop
+            self._headroom_key = feasible
         self.used = new_used
         self.pods.append(pod)
         domains = {HOSTNAME: self.name}
         if zone_choice is not None:
             domains[ZONE] = zone_choice
-        elif (zr := reqs.get(ZONE)) is not None and (v := zr.any_value()) is not None:
-            # node already pinned to one zone: placements count against it
-            opts = self.zone_options()
-            if len(opts) == 1:
-                domains[ZONE] = next(iter(opts))
+        # pods that reach this point unpinned are neither zone-constrained
+        # nor selected by any zone-keyed group (the zone_choice branch
+        # catches both, and constrained-first sort guarantees every group
+        # that could select this pod already exists), so recording a zone
+        # domain for them would serve no group — skip the offering scan
+        # that used to compute it on every commit
         topology.record(pod, domains)
         return True
 
@@ -380,14 +435,42 @@ class Scheduler:
         return result
 
     def _schedule_existing(self, pod: Pod, result: SchedulingResult) -> bool:
+        host_allowed = self.topology.allowed_domains(pod, HOSTNAME)
         for en in self.existing:
+            if host_allowed is not None and en.name not in host_allowed:
+                continue
             if en.try_add(pod, self.topology):
                 result.existing_placements[pod.key()] = en.name
                 return True
         return False
 
     def _schedule_open_vnode(self, pod: Pod, result: SchedulingResult) -> bool:
-        return any(vn.try_add(pod, self.topology) for vn in result.new_nodes)
+        # two cheap prefilters before any try_add work: hostname-constrained
+        # pods (co-location followers, anti-affinity singletons) admit only
+        # their anchor domains, and every pod skips nodes whose cached
+        # cpu/mem upper bound can't hold it — most probes in a big solve
+        # hit already-full nodes
+        host_allowed = self.topology.allowed_domains(pod, HOSTNAME)
+        allow_new = host_allowed is None or NEW_DOMAIN in host_allowed
+        cpu_need = pod.requests.get("cpu")
+        mem_need = pod.requests.get("memory")
+        for vn in result.new_nodes:
+            if (
+                host_allowed is not None
+                and vn.name not in host_allowed
+                and not (allow_new and not vn.pods)
+            ):
+                continue
+            hi_cpu, hi_mem = vn.hi_cpu_mem()
+            used = vn.used
+            if (
+                used.get("cpu") + cpu_need > hi_cpu + 1e-9
+                or used.get("memory") + mem_need > hi_mem + 1e-9
+            ):
+                continue
+            if vn.try_add(pod, self.topology):
+                return True
+        return False
 
     def _schedule_new_vnode(self, pod: Pod, result: SchedulingResult) -> Optional[str]:
         reason = "no nodepool matched pod constraints"

@@ -160,9 +160,9 @@ class TensorScheduler:
     ) -> Optional[SchedulingResult]:
         import jax
 
-        from karpenter_tpu.ops.tensorize import _axes_for
+        from karpenter_tpu.ops.tensorize import _axes_for_requests
 
-        axes = _axes_for([members[0] for _, members in groups])
+        axes = _axes_for_requests([key[1] for key, _ in groups])
         key = (
             axes,
             tuple(id(p) for p in self.pools),
@@ -266,7 +266,7 @@ class TensorScheduler:
         halves share no constraint groups, so seeding the oracle with the
         tensor half's placements (capacity + topology domains) makes the
         sequential composition exact."""
-        from karpenter_tpu.scheduling.topology import HOSTNAME, ZONE
+        from karpenter_tpu.scheduling.topology import HOSTNAME
 
         sch = Scheduler(
             self.pools,
@@ -284,19 +284,14 @@ class TensorScheduler:
                 continue
             en.used = en.used + pod.requests
             en.pods.append(pod)
-            domains = {HOSTNAME: node_name}
-            if en.state.zone:
-                domains[ZONE] = en.state.zone
-            sch.topology.record(pod, domains)
+        # the tensor half's placements need NO topology records: the
+        # partition closure guarantees no unsupported pod's selector (nor
+        # any group it creates later) can match a supported pod, so the
+        # only cross-half interactions are capacity (the `used` updates
+        # above / the vnode state itself) and the hostname-domain universe
+        # for anti-affinity bans
         for vn in result.new_nodes:
             sch.topology.universe.setdefault(HOSTNAME, set()).add(vn.name)
-            opts = vn.zone_options()
-            zone = next(iter(opts)) if len(opts) == 1 else None
-            for pod in vn.pods:
-                domains = {HOSTNAME: vn.name}
-                if zone:
-                    domains[ZONE] = zone
-                sch.topology.record(pod, domains)
         return sch.solve(unsupported, result=result)
 
     # ------------------------------------------------------------- internals
@@ -417,6 +412,22 @@ class TensorScheduler:
 
             return thunk
 
+        from karpenter_tpu.ops.tensorize import _SCALE
+        from karpenter_tpu.scheduling.scheduler import PENDING_WIDEN
+
+        axes = prob.axes
+        # alloc rows are (a) SCALED per axis (memory in MiB — _vec) while
+        # `used`/requests are raw units, and (b) daemonset-overhead-
+        # SUBTRACTED while a vnode's `used` includes the overhead; undo the
+        # scaling and add the per-axis max overhead back so the hint is an
+        # upper bound of every type's raw allocatable
+        scale = np.array([_SCALE.get(a, 1.0) for a in axes], np.float64)
+        overhead_hi = np.zeros(len(axes), np.float64)
+        for r in prob.pool_daemon_overhead.values():
+            for ai, a in enumerate(axes):
+                v = r.get(a)
+                if v > overhead_hi[ai]:
+                    overhead_hi[ai] = v
         for k, vn in vnodes.items():
             classes = slot_classes.get(k, ())
             class_feas = (
@@ -427,6 +438,16 @@ class TensorScheduler:
             vn.widen_thunk = widen(
                 configs[node_cfg[k]], class_feas, node_used[k].copy()
             )
+            # headroom hint over the yet-unwidened type set (a superset of
+            # what widen() returns, so the bound only over-admits): lets a
+            # continued solve probe-and-reject this node without paying the
+            # widen — the hottest path when oracle pods scan full tensor
+            # nodes
+            mask = openable & class_feas
+            if mask.any():
+                hi = alloc[mask].max(axis=0) * scale + overhead_hi
+                vn._headroom = dict(zip(axes, hi.tolist()))
+                vn._headroom_key = PENDING_WIDEN
 
     @staticmethod
     def _why_unschedulable(prob: CompiledProblem, g: int) -> str:

@@ -723,12 +723,18 @@ class TestHybridSolve:
         for i in range(120):
             pods.append(Pod(requests=Resources(cpu=random.choice([1, 2, 4]))))
         for i in range(4):
-            # two label variants sharing one selector: cross-class
-            # co-location, which stays oracle-only
+            # two variants sharing one selector but NODE-INEQUIVALENT
+            # (differing tolerations): the closure merge can't prove one
+            # feasibility row represents all, so the group stays oracle-only
             pods.append(
                 Pod(
                     labels={"app": "co", "variant": str(i % 2)},
                     requests=Resources(cpu=2),
+                    tolerations=(
+                        [Toleration(key="burst", value="yes", effect="NoSchedule")]
+                        if i % 2
+                        else []
+                    ),
                     pod_affinity=[
                         PodAffinityTerm(
                             topology_key=L.LABEL_HOSTNAME,
@@ -741,3 +747,183 @@ class TestHybridSolve:
         assert ts.last_path == "hybrid"
         assert not tensor.unschedulable
         assert tensor.node_count() <= oracle.node_count()
+
+
+class TestCrossClassColocMerge:
+    """Node-equivalent hostname co-location closures compile as ONE macro
+    placement unit (ops/tensorize.py:_coloc_component_mergeable) instead of
+    falling to the oracle."""
+
+    def _group(self, g, n=5, cross=True, **pod_kw):
+        pods = []
+        term = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME,
+            label_selector=(("pair", f"host-{g}"),),
+        )
+        for i in range(n):
+            labels = {"pair": f"host-{g}"}
+            if cross:
+                labels["variant"] = str(i % 2)
+            pods.append(
+                Pod(
+                    labels=labels,
+                    requests=Resources(cpu=1, memory="2Gi"),
+                    pod_affinity=[term],
+                    **pod_kw,
+                )
+            )
+        return pods
+
+    def test_cross_class_compiles_and_colocates(self, setup):
+        pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1)) for _ in range(40)]
+        for g in range(6):
+            pods += self._group(g)
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        assert tensor.node_count() <= oracle.node_count()
+        by_group = {}
+        for vn in tensor.new_nodes:
+            for p in vn.pods:
+                if p.pod_affinity:
+                    by_group.setdefault(p.labels["pair"], set()).add(vn.name)
+        assert len(by_group) == 6
+        assert all(len(nodes) == 1 for nodes in by_group.values())
+
+    def test_one_sig_many_request_classes_merges(self, setup):
+        """A single self-selecting signature spanning several request
+        classes (previously 'across multiple resource classes' -> oracle)
+        now merges into one unit."""
+        pool, types = setup
+        term = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME, label_selector=(("app", "db"),)
+        )
+        pods = [
+            Pod(
+                labels={"app": "db"},
+                requests=Resources(cpu=c),
+                pod_affinity=[term],
+            )
+            for c in (1, 2, 4)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        assert tensor.node_count() == 1
+
+    def test_node_inequivalent_closure_stays_oracle(self, setup):
+        pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1)) for _ in range(10)]
+        group = self._group(0)
+        for i, p in enumerate(group):
+            if i % 2:
+                p.tolerations = [
+                    Toleration(key="burst", value="yes", effect="NoSchedule")
+                ]
+        pods += group
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "hybrid"
+        assert not tensor.unschedulable
+        # the tensor half may right-size its node for the plain pods before
+        # the oracle continuation sees the group, costing at most the one
+        # node the co-located group needs
+        assert tensor.node_count() <= oracle.node_count() + 1
+
+    def test_closure_with_spread_member_stays_oracle(self, setup):
+        """A closure member carrying a topology spread is not mergeable."""
+        pool, types = setup
+        group = self._group(0)
+        group[0].topology_spread = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=L.LABEL_ZONE,
+                label_selector=(("pair", "host-0"),),
+            )
+        ]
+        pods = [Pod(requests=Resources(cpu=1)) for _ in range(10)] + group
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "hybrid"
+
+    def test_closure_with_live_members_stays_oracle(self, setup, env):
+        """Selector reaching a pod bound on a live node: the group must
+        JOIN that node, which the macro can't express."""
+        from karpenter_tpu.ops.tensorize import partition_groups
+        from karpenter_tpu.state.cluster import StateNode
+
+        pool, types = setup
+        bound = Pod(labels={"pair": "host-0"}, requests=Resources(cpu=1))
+        live = StateNode(
+            name="live-1",
+            provider_id="fake://live-1",
+            labels={L.LABEL_ZONE: "zone-a"},
+            taints=[],
+            allocatable=Resources(cpu=8, memory="32Gi"),
+            pods=[bound],
+        )
+        group = self._group(0)
+        sup, unsup, why = partition_groups(group, existing=[live])
+        assert len(unsup) == len(group)
+        assert why  # whole closure stays oracle
+        # the SELF-selecting single-class shape reports the live-member
+        # reason directly
+        solo = [p for p in self._group(0, cross=False)]
+        sup2, unsup2, why2 = partition_groups(solo, existing=[live])
+        assert len(unsup2) == len(solo)
+        assert "live nodes" in why2
+
+    def test_merged_closure_nonrep_extended_resource_capacitated(self, setup):
+        """An extended resource requested only by a NON-rep member must get
+        a capacity axis: no fake type carries it, so the merged group is
+        unschedulable — not silently placed."""
+        pool, types = setup
+        term = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "fpga"),)
+        )
+        a = Pod(
+            labels={"pair": "fpga", "variant": "0"},
+            requests=Resources(cpu=1),
+            pod_affinity=[term],
+        )
+        b = Pod(
+            labels={"pair": "fpga", "variant": "1"},
+            requests=Resources({"cpu": 1, "example.com/fpga": 1}),
+            pod_affinity=[term],
+        )
+        ts = TensorScheduler([pool], {pool.name: types})
+        res = ts.solve([a, b])
+        assert ts.last_path == "tensor"
+        assert len(res.unschedulable) == 2
+        assert not res.new_nodes
+
+    def test_hybrid_memory_pod_joins_tensor_node(self, setup):
+        """A continued (oracle-half) pod with a MEMORY request must join a
+        tensor-decoded node that has room — the decode headroom hint is in
+        raw units, not the compiled MiB scale."""
+        pool, types = setup
+        plain = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(6)]
+        # node-inequivalent closure (differing tolerations): oracle-only
+        term = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "mem"),)
+        )
+        group = [
+            Pod(
+                labels={"pair": "mem", "variant": str(i % 2)},
+                requests=Resources(cpu=0.25, memory="512Mi"),
+                tolerations=(
+                    [Toleration(key="burst", value="yes", effect="NoSchedule")]
+                    if i % 2
+                    else []
+                ),
+                pod_affinity=[term],
+            )
+            for i in range(2)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types})
+        res = ts.solve(plain + group)
+        assert ts.last_path == "hybrid"
+        assert not res.unschedulable
+        oracle = Scheduler([pool], {pool.name: types}).solve(plain + group)
+        # the group fits beside the plain pods on the tensor node(s):
+        # no extra node vs the pure-oracle pack
+        assert res.node_count() <= oracle.node_count()
