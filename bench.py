@@ -55,8 +55,8 @@ def _emit(metric: str, p50_ms: float, path: str, kernel: str, nodes: int) -> Non
     )
 
 
-def _measure(solve, warmup: int = 3, iters: int = 15) -> float:
-    """p50 over 15 samples after 3 warmups: the tunneled device's
+def _measure(solve, warmup: int = 3, iters: int = 21) -> float:
+    """p50 over 21 samples after 3 warmups: the tunneled device's
     round-trip latency jitters by tens of ms, and a small sample lets a
     single spike move the reported median."""
     for _ in range(warmup):

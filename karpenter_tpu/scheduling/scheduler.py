@@ -139,13 +139,9 @@ class VirtualNode:
 
     def hi_cpu_mem(self) -> Tuple[float, float]:
         if self._hi2 is None:
-            if self._headroom:
-                hi = self._headroom
-                self._hi2 = (
-                    hi.get("cpu", float("inf")),
-                    hi.get("memory", float("inf")),
-                )
-            elif self.widen_thunk is None:
+            if self.widen_thunk is None:
+                # materialized list: the tight bound (and commits narrow
+                # it, so rebuilding here is what invalidation buys)
                 cpu = mem = 0.0
                 for t in self.feasible_types:
                     a = t.allocatable()
@@ -154,6 +150,12 @@ class VirtualNode:
                     if (v := a.get("memory")) > mem:
                         mem = v
                 self._hi2 = (cpu, mem)
+            elif self._headroom:
+                hi = self._headroom
+                self._hi2 = (
+                    hi.get("cpu", float("inf")),
+                    hi.get("memory", float("inf")),
+                )
             else:  # no decode hint and a pending widen: stay permissive
                 self._hi2 = (float("inf"), float("inf"))
         return self._hi2
