@@ -874,6 +874,12 @@ def compile_problem(
 
     classes: List[ClassMeta] = []
     track_slots: Dict[Tuple, int] = {}
+    # per-SPREAD-GROUP shares already handed out in this compile: a
+    # service whose pods span several request classes splits each class
+    # against the group's accumulated counts, not a fresh zero — per-class
+    # splits are individually balanced but their sum can skew past
+    # maxSkew (e.g. three classes each putting their remainder in zone-a)
+    spread_assigned: Dict[Tuple, Dict[str, int]] = {}
     for gi, ((sig, requests), members) in enumerate(group_list):
         rep = members[0]
         maxper = _max_per_node(rep)
@@ -1012,8 +1018,11 @@ def compile_problem(
                 split_zones = cand_zones
             # seed with bound pods the constraint's SELECTOR matches (the
             # oracle replays placements the same way, topology.py:91-93)
-            zcounts = {z: 0 for z in split_zones}
-            all_counts = {z: 0 for z in cand_zones}
+            # plus the shares sibling classes of this group already took
+            selkey = (tuple(sorted(c0.label_selector)), c0.max_skew)
+            assigned = spread_assigned.setdefault(selkey, {})
+            zcounts = {z: assigned.get(z, 0) for z in split_zones}
+            all_counts = {z: assigned.get(z, 0) for z in cand_zones}
             for sn in live:
                 if sn.zone in zcounts:
                     zcounts[sn.zone] += sum(
@@ -1024,6 +1033,9 @@ def compile_problem(
                         1 for bp in sn.pods if c0.selects(bp)
                     )
             share = _balanced_split(len(members), zcounts)
+            for z, take in share.items():
+                if take:
+                    assigned[z] = assigned.get(z, 0) + take
             if len(split_zones) < len(cand_zones) and not reason:
                 # skew is measured against ALL candidate domains: if an
                 # infeasible zone anchors the global minimum and the split

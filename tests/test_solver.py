@@ -927,3 +927,31 @@ class TestCrossClassColocMerge:
         # the group fits beside the plain pods on the tensor node(s):
         # no extra node vs the pure-oracle pack
         assert res.node_count() <= oracle.node_count()
+
+    def test_spread_group_spanning_request_classes_balances_sum(self, setup):
+        """A service whose pods span several REQUEST classes must balance
+        the GROUP total across zones, not each class independently — three
+        per-class remainders stacking on zone-a would breach maxSkew."""
+        pool, types = setup
+        sel = (("svc", "multi"),)
+        c = TopologySpreadConstraint(
+            max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+        )
+        pods = []
+        for n, cpu in ((8, 0.25), (6, 1), (14, 2)):  # 28 pods, 3 classes
+            for _ in range(n):
+                pods.append(
+                    Pod(
+                        labels={"svc": "multi"},
+                        requests=Resources(cpu=cpu, memory="1Gi"),
+                        topology_spread=[c],
+                    )
+                )
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        counts = {}
+        for vn in tensor.new_nodes:
+            zone = vn.requirements.get(L.LABEL_ZONE).any_value()
+            counts[zone] = counts.get(zone, 0) + len(vn.pods)
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
