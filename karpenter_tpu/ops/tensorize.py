@@ -628,13 +628,37 @@ def partition_groups(
                     reasons[i] = reasons[i] or why
                     reasons[j] = reasons[j] or why
         for c in rep.topology_spread:
+            # zone-keyed DoNotSchedule spread across classes is exact on
+            # the tensor path when the coupling is MUTUAL: every selected
+            # class carries the identical constraint and self-selects, so
+            # each splits itself against the shared per-group accumulator
+            # (compile_problem's spread_assigned) and the summed shares
+            # stay within maxSkew.  Anything one-sided (a class counted by
+            # the group but not constrained by it, or vice versa) still
+            # needs the oracle's runtime counts.
+            zone_mutual = (
+                c.topology_key == L.LABEL_ZONE
+                and c.when_unsatisfiable == "DoNotSchedule"
+                and c.selects(rep)
+            )
             for j in matches(c):
-                if j != i:
-                    # the spread group counts another class's pods; the
-                    # kernel's per-signature counters can't see them
-                    why = "topology spread coupling distinct pod classes"
-                    reasons[i] = reasons[i] or why
-                    reasons[j] = reasons[j] or why
+                if j == i:
+                    continue
+                if (
+                    zone_mutual
+                    and c in sig_rep[j].topology_spread
+                    # both classes must split over the SAME candidate
+                    # zones, or the shared accumulator can't reconcile
+                    # their shares
+                    and sig_rep[j].scheduling_requirements().get(L.LABEL_ZONE)
+                    == rep.scheduling_requirements().get(L.LABEL_ZONE)
+                ):
+                    continue
+                # the spread group counts another class's pods; the
+                # kernel's per-signature counters can't see them
+                why = "topology spread coupling distinct pod classes"
+                reasons[i] = reasons[i] or why
+                reasons[j] = reasons[j] or why
         for t in rep.pod_affinity:
             if t.anti or t.topology_key != L.LABEL_ZONE:
                 continue

@@ -64,7 +64,8 @@ def _workload(rng: random.Random):
                 node_selector={L.LABEL_NODEPOOL: "tainted"},
             )
         )
-    # spread services
+    # spread services; some span label variants (cross-class mutual
+    # spread, compiled via the shared split accumulator)
     for s in range(rng.randint(0, 3)):
         sel = (("svc", f"s{s}"),)
         c = TopologySpreadConstraint(
@@ -72,10 +73,14 @@ def _workload(rng: random.Random):
             topology_key=L.LABEL_ZONE,
             label_selector=sel,
         )
+        cross = rng.random() < 0.5
         for i in range(rng.randint(3, 30)):
+            labels = {"svc": f"s{s}"}
+            if cross:
+                labels["variant"] = str(i % 2)
             pods.append(
                 Pod(
-                    labels={"svc": f"s{s}"},
+                    labels=labels,
                     requests=rng.choice(SIZES[:3]),
                     topology_spread=[c],
                 )

@@ -955,3 +955,55 @@ class TestCrossClassColocMerge:
             zone = vn.requirements.get(L.LABEL_ZONE).any_value()
             counts[zone] = counts.get(zone, 0) + len(vn.pods)
         assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_cross_class_mutual_zone_spread_compiles(self, setup):
+        """Pods of one service differing in a variant label (distinct
+        signatures) mutually carrying the identical zone spread compile to
+        the tensor path with the group total balanced."""
+        pool, types = setup
+        sel = (("svc", "web"),)
+        c = TopologySpreadConstraint(
+            max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+        )
+        pods = [
+            Pod(
+                labels={"svc": "web", "variant": str(i % 2)},
+                requests=Resources(cpu=1, memory="2Gi"),
+                topology_spread=[c],
+            )
+            for i in range(28)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        counts = {}
+        for vn in tensor.new_nodes:
+            zone = vn.requirements.get(L.LABEL_ZONE).any_value()
+            counts[zone] = counts.get(zone, 0) + len(vn.pods)
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_one_sided_spread_coupling_stays_oracle(self, setup):
+        """A class counted by the group but not carrying the constraint
+        (one-sided coupling) still needs the oracle's runtime counts."""
+        from karpenter_tpu.ops.tensorize import partition_groups
+
+        pool, types = setup
+        sel = (("svc", "web2"),)
+        c = TopologySpreadConstraint(
+            max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+        )
+        carriers = [
+            Pod(
+                labels={"svc": "web2"},
+                requests=Resources(cpu=1),
+                topology_spread=[c],
+            )
+            for _ in range(6)
+        ]
+        counted_only = [
+            Pod(labels={"svc": "web2", "variant": "x"}, requests=Resources(cpu=1))
+            for _ in range(3)
+        ]
+        sup, unsup, why = partition_groups(carriers + counted_only)
+        assert len(unsup) == 9
+        assert "spread" in why
