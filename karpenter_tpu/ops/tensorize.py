@@ -622,11 +622,28 @@ def partition_groups(
         for t in rep.pod_affinity:
             if not t.anti:
                 continue
+            # mutual cross-class HOSTNAME anti-affinity (variant labels
+            # under one selector) compiles exactly: classes with identical
+            # hostname fingerprints share one per-node counter slot
+            # (compile_problem keys track_slots by _track_key), enforcing
+            # <=1 of the union per node.  Anything asymmetric — the other
+            # class missing the term, or carrying extra hostname
+            # constraints — still needs the oracle.
+            host_mutual = (
+                t.topology_key == L.LABEL_HOSTNAME and t.selects(rep)
+            )
             for j in matches(t):
-                if j != i:
-                    why = "anti-affinity coupling distinct pod classes"
-                    reasons[i] = reasons[i] or why
-                    reasons[j] = reasons[j] or why
+                if j == i:
+                    continue
+                if (
+                    host_mutual
+                    and t in sig_rep[j].pod_affinity
+                    and _track_key(sig_rep[j]) == _track_key(rep)
+                ):
+                    continue
+                why = "anti-affinity coupling distinct pod classes"
+                reasons[i] = reasons[i] or why
+                reasons[j] = reasons[j] or why
         for c in rep.topology_spread:
             # zone-keyed DoNotSchedule spread across classes is exact on
             # the tensor path when the coupling is MUTUAL: every selected
@@ -791,6 +808,42 @@ def _max_per_node(pod: Pod) -> int:
     return cap
 
 
+def _track_key(pod: Pod) -> Tuple:
+    """Fingerprint of a class's hostname-keyed tracked constraints.
+
+    Classes with EQUAL fingerprints share one per-node counter slot; the
+    counter counts every placed pod of those classes, which matches the
+    selector semantics because partition_groups only admits cross-class
+    sharing when the classes mutually carry identical selectors.  A class
+    with several terms gets one OR-counter — exact for anti-affinity
+    (any match bans), conservative for hostname spread."""
+    sels = {
+        ("a", t.label_selector, t.namespaces)
+        for t in pod.pod_affinity
+        if t.anti and t.topology_key == L.LABEL_HOSTNAME
+    } | {
+        ("s", c.label_selector)
+        for c in pod.topology_spread
+        if c.topology_key == L.LABEL_HOSTNAME
+        and c.selects(pod)
+        and c.when_unsatisfiable == "DoNotSchedule"
+    }
+    return tuple(sorted(sels))
+
+
+def _track_matches(key: Tuple, pod: Pod) -> bool:
+    """Whether a bound pod counts against a tracking slot: any selector in
+    the slot's fingerprint matches its labels (kube counts label matches,
+    whether or not the bound pod carries the constraint itself)."""
+    for entry in key:
+        sel = entry[1]
+        if entry[0] == "a" and entry[2] and pod.namespace not in entry[2]:
+            continue
+        if all(pod.labels.get(k) == v for k, v in sel):
+            return True
+    return False
+
+
 def _zone_spread_zones(pod: Pod) -> bool:
     return any(
         c.topology_key == L.LABEL_ZONE
@@ -909,7 +962,15 @@ def compile_problem(
         maxper = _max_per_node(rep)
         slot = 0
         if maxper < BIG:
-            slot = track_slots.setdefault(sig, len(track_slots) + 1)
+            # slot key = the hostname-constraint FINGERPRINT, not the pod
+            # signature: mutually-coupled classes carrying the identical
+            # anti-affinity selector (variant labels under one selector)
+            # share one per-node counter, which is exactly the <=1-of-the-
+            # union semantics (partition_groups admits them only when the
+            # fingerprints match)
+            slot = track_slots.setdefault(
+                _track_key(rep), len(track_slots) + 1
+            )
         if any(
             not t.anti and t.topology_key == L.LABEL_HOSTNAME
             for t in rep.pod_affinity
@@ -1178,9 +1239,12 @@ def compile_problem(
     if track_slots:
         for e, sn in enumerate(live):
             for bound in sn.pods:
-                s = track_slots.get(bound.constraint_signature())
-                if s is not None:
-                    sig_used0[s, e] += 1
+                # count by SELECTOR match, not signature equality: a bound
+                # pod with matching labels blocks an anti-affinity class
+                # even when it carries no constraint itself
+                for key, s in track_slots.items():
+                    if _track_matches(key, bound):
+                        sig_used0[s, e] += 1
 
     return CompiledProblem(
         axes=axes,

@@ -110,11 +110,16 @@ def _workload(rng: random.Random):
                     **kw,
                 )
             )
-    # anti-affinity singletons
+    # anti-affinity singletons; sometimes cross-class (variant labels
+    # under one selector, compiled via the shared tracking slot)
+    anti_cross = rng.random() < 0.5
     for i in range(rng.randint(0, 12)):
+        labels = {"app": "solo"}
+        if anti_cross:
+            labels["variant"] = str(i % 2)
         pods.append(
             Pod(
-                labels={"app": "solo"},
+                labels=labels,
                 requests=SIZES[0],
                 pod_affinity=[
                     PodAffinityTerm(

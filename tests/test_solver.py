@@ -1007,3 +1007,90 @@ class TestCrossClassColocMerge:
         sup, unsup, why = partition_groups(carriers + counted_only)
         assert len(unsup) == 9
         assert "spread" in why
+
+    def test_mutual_cross_class_anti_affinity_compiles(self, setup):
+        """Variant classes mutually carrying the identical hostname
+        anti-affinity selector compile to the tensor path and never share
+        a node across the union."""
+        pool, types = setup
+        term = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME,
+            label_selector=(("app", "solo2"),),
+            anti=True,
+        )
+        pods = [
+            Pod(
+                labels={"app": "solo2", "variant": str(i % 2)},
+                requests=Resources(cpu=0.25),
+                pod_affinity=[term],
+            )
+            for i in range(12)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        assert tensor.node_count() == oracle.node_count() == 12
+        assert all(len(n.pods) == 1 for n in tensor.new_nodes)
+
+    def test_one_sided_anti_affinity_stays_oracle(self, setup):
+        """A class counted by the selector but not carrying the term
+        (asymmetric coupling) still needs the oracle."""
+        from karpenter_tpu.ops.tensorize import partition_groups
+
+        carriers = [
+            Pod(
+                labels={"app": "solo3"},
+                requests=Resources(cpu=0.25),
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=(("app", "solo3"),),
+                        anti=True,
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        counted = [Pod(labels={"app": "solo3", "v": "x"}, requests=Resources(cpu=1))]
+        sup, unsup, why = partition_groups(carriers + counted)
+        assert len(unsup) == 4
+        assert "anti-affinity" in why
+
+    def test_live_unconstrained_matching_pod_blocks_anti(self, setup):
+        """A bound pod with matching labels blocks an anti-affinity class
+        on its node even though the bound pod carries no constraint."""
+        from karpenter_tpu.state.cluster import StateNode
+
+        pool, types = setup
+        bound = Pod(labels={"app": "solo4"}, requests=Resources(cpu=1))
+        live = StateNode(
+            name="live-anti",
+            provider_id="fake://live-anti",
+            labels={L.LABEL_ZONE: "zone-a"},
+            taints=[],
+            allocatable=Resources(cpu=64, memory="256Gi"),
+            pods=[bound],
+            used=Resources(cpu=1),
+        )
+        incoming = [
+            Pod(
+                labels={"app": "solo4"},
+                requests=Resources(cpu=0.25),
+                pod_affinity=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=(("app", "solo4"),),
+                        anti=True,
+                    )
+                ],
+            )
+            for _ in range(2)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types}, existing=[live])
+        res = ts.solve(incoming)
+        assert ts.last_path == "tensor"
+        assert not res.unschedulable
+        # neither incoming pod may land on the live node (it already holds
+        # a matching pod); each opens its own node
+        assert not res.existing_placements
+        assert res.node_count() == 2
