@@ -211,3 +211,99 @@ def test_random_workload_invariants(setup, seed):
     # 7. the oracle, as semantics definition, must also place everything
     #    the tensor path placed (sanity on the generator, not the solver)
     assert len(res.unschedulable) <= len(oracle.unschedulable) + 2, seed
+
+
+def _existing_cluster(rng: random.Random):
+    """Random live nodes, some holding bound pods that incoming selectors
+    can reach (anti-affinity blocks, co-location live-members -> oracle)."""
+    from karpenter_tpu.state.cluster import StateNode
+
+    nodes = []
+    for n in range(rng.randint(2, 6)):
+        cap = rng.choice([8, 16, 32])
+        bound = []
+        used = Resources()
+        for b in range(rng.randint(0, 3)):
+            labels = {}
+            r = rng.random()
+            if r < 0.2:
+                labels = {"app": "solo"}  # blocks anti-affinity singletons
+            elif r < 0.3:
+                labels = {"pair": "g0"}  # live co-location member
+            p = Pod(labels=labels, requests=Resources(cpu=1, memory="2Gi"))
+            bound.append(p)
+            used = used + p.requests
+        nodes.append(
+            StateNode(
+                name=f"live-{n}",
+                provider_id=f"fake://live-{n}",
+                labels={
+                    L.LABEL_ZONE: rng.choice(["zone-a", "zone-b", "zone-c"]),
+                    L.LABEL_NODEPOOL: "general",
+                },
+                taints=[],
+                allocatable=Resources(cpu=cap, memory=f"{cap * 4}Gi", pods=110),
+                pods=bound,
+                used=used,
+            )
+        )
+    return nodes
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_workload_with_existing_nodes(setup, seed):
+    """Continuation over a live cluster: capacity on existing nodes is
+    respected, anti-affinity sees bound pods, and nothing is dropped."""
+    pools, inventory = setup
+    rng = random.Random(1000 + seed)
+    existing = _existing_cluster(rng)
+    pods = _workload(rng)
+    ts = TensorScheduler(pools, inventory, existing=existing)
+    res = ts.solve(pods)
+
+    placed = _placements(res)
+    assert len(placed) + len(res.existing_placements) + len(res.unschedulable) == len(pods)
+
+    by_key = {p.key(): p for p in pods}
+    # existing-node capacity: bound + newly placed fits allocatable
+    for en in existing:
+        add = Resources()
+        for key, name in res.existing_placements.items():
+            if name == en.name:
+                add = add + by_key[key].requests
+        total = en.used + add
+        assert total.fits(en.allocatable), (seed, en.name)
+
+    # anti-affinity: a live node holding an app=solo pod never receives a
+    # solo singleton, and no two singletons share any node
+    solo_on = {}
+    for key, name in res.existing_placements.items():
+        p = by_key[key]
+        if p.pod_affinity and p.pod_affinity[0].anti:
+            solo_on.setdefault(name, []).append(key)
+            en = next(e for e in existing if e.name == name)
+            assert not any(
+                bp.labels.get("app") == "solo" for bp in en.pods
+            ), (seed, name)
+    for name, keys in solo_on.items():
+        assert len(keys) == 1, (seed, name)
+    solo_new = [
+        placed[p.key()][0]
+        for p in pods
+        if p.pod_affinity and p.pod_affinity[0].anti and p.key() in placed
+    ]
+    assert len(solo_new) == len(set(solo_new)), seed
+
+    # co-location groups stay whole (one node, new or existing)
+    groups = {}
+    for p in pods:
+        if p.pod_affinity and not p.pod_affinity[0].anti and "pair" in p.labels:
+            k = p.key()
+            if k in placed:
+                groups.setdefault(p.labels["pair"], set()).add(placed[k][0])
+            elif k in res.existing_placements:
+                groups.setdefault(p.labels["pair"], set()).add(
+                    res.existing_placements[k]
+                )
+    for gname, where in groups.items():
+        assert len(where) == 1, (seed, gname, where)
