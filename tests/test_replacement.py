@@ -250,3 +250,76 @@ class TestReplacementPreSpin:
         for p in kept:
             assert env.kube.pods[p.key()].node_name
         assert not env.kube.pending_pods()
+
+
+class TestPerPoolPreSpin:
+    def test_second_pool_consolidates_during_first_pool_prespin(self, env):
+        """A slow-registering replacement in pool A must not freeze
+        consolidation in pool B: the in-flight gate is per TARGET pool
+        (disruption.py pending_pools), not cluster-wide."""
+        env.default_node_class()
+        for name in ("pool-a", "pool-b"):
+            env.default_node_pool(
+                name=name,
+                requirements=Requirements(
+                    [
+                        Requirement(L.LABEL_NODEPOOL, Op.IN, [name]),
+                        Requirement(
+                            L.LABEL_CAPACITY_TYPE,
+                            Op.IN,
+                            [L.CAPACITY_TYPE_ON_DEMAND],
+                        ),
+                        Requirement(L.LABEL_INSTANCE_CPU, Op.GT, ["31"]),
+                    ]
+                ),
+                disruption=Disruption(consolidation_policy="WhenUnderutilized"),
+            )
+        pods = {}
+        for name in ("pool-a", "pool-b"):
+            pods[name] = [
+                Pod(
+                    requests=Resources(cpu=4, memory="8Gi"),
+                    node_selector={L.LABEL_NODEPOOL: name},
+                )
+                for _ in range(16)
+            ]
+            for p in pods[name]:
+                env.kube.put_pod(p)
+        env.settle()
+        assert not env.kube.pending_pods()
+        # shrink both pools' workloads so each big node is oversized
+        for name in ("pool-a", "pool-b"):
+            for p in pods[name][2:]:
+                env.kube.delete_pod(p.key())
+            pool = env.kube.node_pools[name]
+            pool.requirements = Requirements(
+                [
+                    Requirement(L.LABEL_NODEPOOL, Op.IN, [name]),
+                    Requirement(
+                        L.LABEL_CAPACITY_TYPE,
+                        Op.IN,
+                        [L.CAPACITY_TYPE_ON_DEMAND],
+                    ),
+                ]
+            )
+        env.kubelet.startup_delay = 6.0  # replacements register slowly
+        for _ in range(120):
+            env.step(2.0)
+            claims = env.kube.node_claims.values()
+            by_pool = {}
+            for c in claims:
+                by_pool.setdefault(c.pool_name, []).append(c)
+            if (
+                not env.kube.pending_pods()
+                and len(by_pool.get("pool-a", [])) == 1
+                and len(by_pool.get("pool-b", [])) == 1
+            ):
+                break
+        by_pool = {}
+        for c in env.kube.node_claims.values():
+            by_pool.setdefault(c.pool_name, []).append(c)
+        # BOTH pools consolidated to their single cheap replacement —
+        # neither waited on the other's in-flight registration
+        assert len(by_pool.get("pool-a", [])) == 1, by_pool
+        assert len(by_pool.get("pool-b", [])) == 1, by_pool
+        assert not env.kube.pending_pods()
