@@ -17,6 +17,8 @@ Configs (BASELINE.md "Benchmark configs to reproduce"):
 5. multi-pool weighted priority + spot price-aware selection.
 6. (extra) hybrid split cost: 9.5k tensor pods + 500 oracle-only pods in
    one batch — the mixed-path price of ops/tensorize.py:partition_pods.
+7. (extra) the flagship through the solver sidecar (socket RPC) — the
+   distributed-backend boundary's overhead (SURVEY.md §5).
 
 Each line: {"metric", "value", "unit", "vs_baseline", "path", "kernel",
 "nodes"}.  ``vs_baseline`` is the speedup vs the 200 ms north-star budget
@@ -524,6 +526,30 @@ def main() -> None:
         "schedule_10k_crossclass_coloc_tensor_p50",
         pools, inventory, pods, expect_path="tensor",
     )
+
+    # extra: the flagship solved THROUGH the solver sidecar (socket RPC,
+    # SURVEY.md §5 distributed backend) — the controller half's view of a
+    # remote device owner, measuring codec+framing overhead on top of the
+    # solve
+    from karpenter_tpu.service import RemoteSolver, SolverServer
+
+    srv = SolverServer(port=0).start_background()
+    try:
+        remote = RemoteSolver(*srv.address)
+
+        def sidecar_pack(prob, k_slots: int = 0, objective: str = "nodes"):
+            return remote.pack_problem(prob, k_slots, objective)
+
+        sidecar_pack.kernel_name = "sidecar"
+        pool, types, pods = build_problem()
+        _run_scheduler_config(
+            "schedule_10k_pods_500_types_sidecar_p50",
+            [pool], {pool.name: types}, pods,
+            pack_fn=sidecar_pack,
+        )
+        remote.close()
+    finally:
+        srv.stop()
 
     # flagship last: a single-line consumer sees the headline metric
     pool, types, pods = build_problem()
