@@ -129,6 +129,7 @@ class Pod:
             "namespace",
             "node_selector",
             "required_affinity",
+            "preferred_affinity",
             "tolerations",
             "topology_spread",
             "pod_affinity",
@@ -151,11 +152,20 @@ class Pod:
             self.requests = self.requests + Resources({L.RESOURCE_PODS: 1})
 
     # -- derived scheduling state -------------------------------------------
-    def scheduling_requirements(self) -> Requirements:
-        """nodeSelector + required node affinity as one conjunction."""
+    def scheduling_requirements(self, preferred: bool = False) -> Requirements:
+        """nodeSelector + required node affinity as one conjunction.
+
+        With ``preferred`` the preferred-affinity terms merge in too:
+        karpenter treats preferences as REQUIRED while simulating and
+        relaxes them only when the pod proves unschedulable (reference
+        website v0.31 concepts/scheduling.md "preferences"; the relaxation
+        here is all-or-nothing rather than term-by-term)."""
         reqs = Requirements.from_labels(self.node_selector)
         for r in self.required_affinity:
             reqs.add(r)
+        if preferred:
+            for r in self.preferred_affinity:
+                reqs.add(r)
         return reqs
 
     def do_not_evict(self) -> bool:
@@ -191,6 +201,8 @@ class Pod:
             tuple(sorted(self.pod_affinity, key=repr)),
             tuple(sorted(self.labels.items())),
             self.namespace,
+            # appended LAST so consumers indexing sig[0..6] stay valid
+            tuple(sorted(map(repr, self.preferred_affinity))),
         )
         return sig
 

@@ -161,7 +161,7 @@ def _pool_feas(
     ent = memo.get(key, _MEMO_MISS)
     if ent is _MEMO_MISS:
         pr = catalog.pool_rows[pname]
-        merged = _merge_pool(rep, rep.scheduling_requirements(), pools_by_name[pname])
+        merged = _merge_pool(rep, rep.scheduling_requirements(preferred=True), pools_by_name[pname])
         if merged is None:
             ent = None
         else:
@@ -455,7 +455,9 @@ def _coloc_component_mergeable(
         ):
             return False
         sig = rep.constraint_signature()
-        part = (sig[0], sig[1], sig[2], rep.namespace)
+        # node_selector, required AND preferred node affinity, tolerations,
+        # namespace — preferences are node-affecting while unrelaxed
+        part = (sig[0], sig[1], sig[2], sig[7], rep.namespace)
         if node_part is None:
             node_part = part
         elif part != node_part:
@@ -667,8 +669,8 @@ def partition_groups(
                     # both classes must split over the SAME candidate
                     # zones, or the shared accumulator can't reconcile
                     # their shares
-                    and sig_rep[j].scheduling_requirements().get(L.LABEL_ZONE)
-                    == rep.scheduling_requirements().get(L.LABEL_ZONE)
+                    and sig_rep[j].scheduling_requirements(preferred=True).get(L.LABEL_ZONE)
+                    == rep.scheduling_requirements(preferred=True).get(L.LABEL_ZONE)
                 ):
                     continue
                 # the spread group counts another class's pods; the
@@ -1035,7 +1037,7 @@ def compile_problem(
                 if sn.zone
                 and any(t.selects(bp) for t in terms for bp in sn.pods)
             }
-            zr = rep.scheduling_requirements().get(L.LABEL_ZONE)
+            zr = rep.scheduling_requirements(preferred=True).get(L.LABEL_ZONE)
             allowed = [
                 z
                 for z in all_zones
@@ -1079,7 +1081,7 @@ def compile_problem(
                 and c.selects(rep)
                 and c.when_unsatisfiable == "DoNotSchedule"
             )
-            zr = rep.scheduling_requirements().get(L.LABEL_ZONE)
+            zr = rep.scheduling_requirements(preferred=True).get(L.LABEL_ZONE)
             cand_zones = [z for z in all_zones if zr is None or zr.has(z)]
             # ...and by the POOLS' zone admission: spread domains are the
             # zones some pool could actually create nodes in
@@ -1191,7 +1193,7 @@ def compile_problem(
     pools_by_name = {p.name: p for p in pools}
     for (sig, zone_pin), g_idx in classes_by_sig.items():
         rep = classes[g_idx[0]].pods[0]
-        sched = rep.scheduling_requirements()
+        sched = rep.scheduling_requirements(preferred=True)
         if zone_pin:
             sched = Requirements(iter(sched))
             sched.add(Requirement(L.LABEL_ZONE, Op.IN, [zone_pin]))
@@ -1341,7 +1343,7 @@ def _feasible_zones(
         _memo_put(catalog, memo_key, zones)
     out = set(zones)
     if live:
-        sched = rep.scheduling_requirements()
+        sched = rep.scheduling_requirements(preferred=True)
         for sn in live:
             if sn.zone and sn.zone not in out and _fits_existing(rep, sched, sn):
                 if (sn.used + requests).fits(sn.allocatable):
@@ -1410,7 +1412,7 @@ def _anchor_zone_affinity(
         # candidates: intersection of every member's own zone requirements
         cand = set(all_zones)
         for gi in idxs:
-            zr = reps[gi].scheduling_requirements().get(L.LABEL_ZONE)
+            zr = reps[gi].scheduling_requirements(preferred=True).get(L.LABEL_ZONE)
             if zr is not None:
                 cand &= {z for z in all_zones if zr.has(z)}
         # existing matching placements anchor the domain (followers must

@@ -208,7 +208,9 @@ class VirtualNode:
             return list(cand)
         return [t for t, ok in zip(cand, mask) if ok]
 
-    def try_add(self, pod: Pod, topology: TopologyTracker) -> bool:
+    def try_add(
+        self, pod: Pod, topology: TopologyTracker, preferred: bool = True
+    ) -> bool:
         if not tolerates_all(pod.tolerations, self.pool.taints):
             return False
         if not self._headroom_admits(pod.requests):
@@ -224,7 +226,7 @@ class VirtualNode:
             if not (NEW_DOMAIN in host_allowed and not self.pods):
                 return False
         reqs = Requirements(iter(self.requirements))
-        for r in pod.scheduling_requirements():
+        for r in pod.scheduling_requirements(preferred=preferred):
             reqs.add(r)
         if reqs.is_unsatisfiable():
             return False
@@ -248,7 +250,7 @@ class VirtualNode:
         new_used = self.used + pod.requests
         sig = pod.constraint_signature()
         feasible = self._fits_some_type(
-            reqs, new_used, cache_key=(sig[0], sig[1], zone_choice)
+            reqs, new_used, cache_key=(sig[0], sig[1], sig[7], preferred, zone_choice)
         )
         if not feasible:
             return False
@@ -328,7 +330,9 @@ class ExistingNode:
     def name(self) -> str:
         return self.state.name
 
-    def try_add(self, pod: Pod, topology: TopologyTracker) -> bool:
+    def try_add(
+        self, pod: Pod, topology: TopologyTracker, preferred: bool = True
+    ) -> bool:
         if self.state.marked_for_deletion() or (
             self.state.node is not None and self.state.node.cordoned
         ):
@@ -341,7 +345,9 @@ class ExistingNode:
             return False
         if self._label_reqs is None:
             self._label_reqs = Requirements.from_labels(self.state.labels)
-        if not self._label_reqs.compatible(pod.scheduling_requirements()):
+        if not self._label_reqs.compatible(
+            pod.scheduling_requirements(preferred=preferred)
+        ):
             return False
         host_allowed = topology.allowed_domains(pod, HOSTNAME)
         if host_allowed is not None and self.name not in host_allowed:
@@ -427,26 +433,42 @@ class Scheduler:
         if result is None:
             result = SchedulingResult()
         for pod in sorted(pods, key=pod_sort_key):
-            if self._schedule_existing(pod, result):
-                continue
-            if self._schedule_open_vnode(pod, result):
-                continue
-            reason = self._schedule_new_vnode(pod, result)
+            # preferences are REQUIRED on the first attempt and relaxed
+            # (all at once) only when the pod proves unschedulable —
+            # karpenter-core's preference relaxation (reference website
+            # v0.31 concepts/scheduling.md)
+            reason = self._place(pod, result, preferred=True)
+            if reason is not None and pod.preferred_affinity:
+                reason = self._place(pod, result, preferred=False)
             if reason is not None:
                 result.unschedulable[pod.key()] = reason
         return result
 
-    def _schedule_existing(self, pod: Pod, result: SchedulingResult) -> bool:
+    def _place(
+        self, pod: Pod, result: SchedulingResult, preferred: bool
+    ) -> Optional[str]:
+        """One placement attempt; None on success, else the reason."""
+        if self._schedule_existing(pod, result, preferred):
+            return None
+        if self._schedule_open_vnode(pod, result, preferred):
+            return None
+        return self._schedule_new_vnode(pod, result, preferred)
+
+    def _schedule_existing(
+        self, pod: Pod, result: SchedulingResult, preferred: bool = True
+    ) -> bool:
         host_allowed = self.topology.allowed_domains(pod, HOSTNAME)
         for en in self.existing:
             if host_allowed is not None and en.name not in host_allowed:
                 continue
-            if en.try_add(pod, self.topology):
+            if en.try_add(pod, self.topology, preferred):
                 result.existing_placements[pod.key()] = en.name
                 return True
         return False
 
-    def _schedule_open_vnode(self, pod: Pod, result: SchedulingResult) -> bool:
+    def _schedule_open_vnode(
+        self, pod: Pod, result: SchedulingResult, preferred: bool = True
+    ) -> bool:
         # two cheap prefilters before any try_add work: hostname-constrained
         # pods (co-location followers, anti-affinity singletons) admit only
         # their anchor domains, and every pod skips nodes whose cached
@@ -470,11 +492,13 @@ class Scheduler:
                 or used.get("memory") + mem_need > hi_mem + 1e-9
             ):
                 continue
-            if vn.try_add(pod, self.topology):
+            if vn.try_add(pod, self.topology, preferred):
                 return True
         return False
 
-    def _schedule_new_vnode(self, pod: Pod, result: SchedulingResult) -> Optional[str]:
+    def _schedule_new_vnode(
+        self, pod: Pod, result: SchedulingResult, preferred: bool = True
+    ) -> Optional[str]:
         reason = "no nodepool matched pod constraints"
         for pool in self.pools:
             types = self.instance_types.get(pool.name, [])
@@ -482,7 +506,7 @@ class Scheduler:
                 reason = f"nodepool {pool.name} has no instance types"
                 continue
             vn = self._new_vnode(pool, types)
-            if vn.try_add(pod, self.topology):
+            if vn.try_add(pod, self.topology, preferred):
                 result.new_nodes.append(vn)
                 return None
             reason = "pod incompatible with every instance type / offering"

@@ -153,7 +153,118 @@ class TensorScheduler:
             self.last_path = "hybrid"
             with TRACER.span("solver.oracle_continue", pods=len(unsupported)):
                 result = self._oracle_continue(unsupported, supported, result)
+        # preference relaxation: the tensor path compiles preferred node
+        # affinity as REQUIRED (objects.py scheduling_requirements), so a
+        # pod whose preferences can't be met decodes unschedulable — give
+        # it the oracle's relax-and-retry (which first re-tries WITH
+        # preferences against the open nodes, then drops them), seeded
+        # with full topology records because relaxed pods may share spread
+        # groups with their tensor-placed siblings
+        relax = [
+            p
+            for p in pods
+            if p.preferred_affinity and p.key() in result.unschedulable
+        ]
+        if relax:
+            relax_keys = {p.key() for p in relax}
+            for k in relax_keys:
+                del result.unschedulable[k]
+            others = [p for p in pods if p.key() not in relax_keys]
+            self.last_path = "hybrid"
+            with TRACER.span("solver.relax", pods=len(relax)):
+                result = self._oracle_continue(
+                    relax, others, result, seed_topology=True
+                )
+        # an EMPTY label selector matches every pod, including unlabeled
+        # ones — with one in the batch, no pod is safely untracked
+        if not any(
+            (not c.label_selector)
+            for p in pods
+            for c in (*p.topology_spread, *p.pod_affinity)
+        ):
+            with TRACER.span("solver.compact"):
+                self._compact_small_nodes(result)
         return result
+
+    def _compact_small_nodes(self, result: SchedulingResult) -> None:
+        """Decode post-pass: re-home topology-free pods off nearly-empty
+        new nodes into other new nodes, dropping nodes that empty out.
+
+        The class-granular kernel can strand a handful of pods on small
+        right-sized nodes that per-pod FFD would have filled elsewhere
+        (constrained classes open nodes first, then plain mass doesn't fit
+        their leftover).  Consolidation would clean this up minutes later;
+        doing it at decode keeps node counts at the oracle's level.  Only
+        pods with no labels and no pod-level topology constraints move —
+        anything labeled could be counted by another pod's spread/affinity
+        selector, which this pass has no tracker for."""
+        from karpenter_tpu.scheduling.topology import HOSTNAME, TopologyTracker
+
+        def plain(p: Pod) -> bool:
+            # a satisfiable preference must not be silently traded away by
+            # a move — preference carriers stay put
+            return not (
+                p.labels or p.pod_affinity or p.topology_spread
+                or p.preferred_affinity
+            )
+
+        def singleton(p: Pod) -> bool:
+            """Hostname anti-affinity only: movable under an exact ban
+            check against the seeded tracker (labels allowed — they're
+            what the bans match on)."""
+            return (
+                not p.topology_spread
+                and not p.preferred_affinity
+                and bool(p.pod_affinity)
+                and all(
+                    t.anti and t.topology_key == L.LABEL_HOSTNAME
+                    for t in p.pod_affinity
+                )
+            )
+
+        donors = sorted(
+            (
+                vn
+                for vn in result.new_nodes
+                if len(vn.pods) <= 8
+                and all(plain(p) or singleton(p) for p in vn.pods)
+            ),
+            key=lambda vn: len(vn.pods),
+        )
+        if not donors:
+            return
+        donor_ids = {id(d) for d in donors}
+        scratch = TopologyTracker(self.zones)
+        # seed hostname domains so anti-affinity bans are exact for moved
+        # singletons; zone domains are irrelevant to what may move (no
+        # spread carriers among donor pods)
+        for o in result.new_nodes:
+            scratch.universe.setdefault(HOSTNAME, set()).add(o.name)
+            for p in o.pods:
+                if p.labels:
+                    scratch.record(p, {HOSTNAME: o.name})
+        for vn in donors:
+            targets = [
+                o
+                for o in result.new_nodes
+                if o is not vn and id(o) not in donor_ids
+            ] + [o for o in donors if o is not vn and o.pods]
+            remaining = []
+            for p in vn.pods:
+                moved = False
+                for o in sorted(targets, key=lambda o: -len(o.pods)):
+                    if o.try_add(p, scratch):
+                        moved = True
+                        break
+                if not moved:
+                    remaining.append(p)
+            if remaining and len(remaining) != len(vn.pods):
+                # partial move: rebuild the donor's used vector
+                vn.used = vn.daemon_overhead
+                for p in remaining:
+                    vn.used = vn.used + p.requests
+            vn.pods = remaining
+        result.new_nodes = [vn for vn in result.new_nodes if vn.pods]
 
     def _solve_tensor(
         self, pods: List[Pod], groups
@@ -260,13 +371,20 @@ class TensorScheduler:
         unsupported: List[Pod],
         supported: List[Pod],
         result: SchedulingResult,
+        seed_topology: bool = False,
     ) -> SchedulingResult:
         """Continue the tensor result with the oracle for the oracle-only
         pods.  `partition_pods`'s transitive closure guarantees the two
         halves share no constraint groups, so seeding the oracle with the
         tensor half's placements (capacity + topology domains) makes the
-        sequential composition exact."""
-        from karpenter_tpu.scheduling.topology import HOSTNAME
+        sequential composition exact.
+
+        ``seed_topology`` replays every prior placement into the topology
+        tracker — needed ONLY by the preference-relaxation pass, whose
+        pods may share spread/affinity groups with already-placed
+        siblings (the partition closure covers the plain continuation, so
+        it skips the replay)."""
+        from karpenter_tpu.scheduling.topology import HOSTNAME, ZONE
 
         sch = Scheduler(
             self.pools,
@@ -284,14 +402,27 @@ class TensorScheduler:
                 continue
             en.used = en.used + pod.requests
             en.pods.append(pod)
-        # the tensor half's placements need NO topology records: the
-        # partition closure guarantees no unsupported pod's selector (nor
-        # any group it creates later) can match a supported pod, so the
-        # only cross-half interactions are capacity (the `used` updates
-        # above / the vnode state itself) and the hostname-domain universe
-        # for anti-affinity bans
+            if seed_topology:
+                domains = {HOSTNAME: node_name}
+                if en.state.zone:
+                    domains[ZONE] = en.state.zone
+                sch.topology.record(pod, domains)
+        # without seed_topology, the tensor half's placements need NO
+        # topology records: the partition closure guarantees no
+        # unsupported pod's selector (nor any group it creates later) can
+        # match a supported pod, so the only cross-half interactions are
+        # capacity (the `used` updates above / the vnode state itself)
+        # and the hostname-domain universe for anti-affinity bans
         for vn in result.new_nodes:
             sch.topology.universe.setdefault(HOSTNAME, set()).add(vn.name)
+            if seed_topology:
+                opts = vn.zone_options()
+                zone = next(iter(opts)) if len(opts) == 1 else None
+                for pod in vn.pods:
+                    domains = {HOSTNAME: vn.name}
+                    if zone:
+                        domains[ZONE] = zone
+                    sch.topology.record(pod, domains)
         return sch.solve(unsupported, result=result)
 
     # ------------------------------------------------------------- internals

@@ -22,9 +22,10 @@ import random
 
 import pytest
 
-from karpenter_tpu.api import Pod, Resources, Taint, Toleration
+from karpenter_tpu.api import Pod, Requirement, Resources, Taint, Toleration
 from karpenter_tpu.api import labels as L
 from karpenter_tpu.api.objects import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.api.requirements import Op
 from karpenter_tpu.scheduling import Scheduler, TensorScheduler
 from karpenter_tpu.testing import Environment
 
@@ -55,6 +56,16 @@ def _workload(rng: random.Random):
     pods = []
     for i in range(rng.randint(40, 120)):
         pods.append(Pod(requests=rng.choice(SIZES)))
+    # preference carriers: some satisfiable (a real zone), some requiring
+    # relaxation (an impossible zone)
+    for i in range(rng.randint(0, 15)):
+        zone = rng.choice(["zone-a", "zone-b", "zone-nowhere"])
+        pods.append(
+            Pod(
+                requests=rng.choice(SIZES[:3]),
+                preferred_affinity=[Requirement(L.LABEL_ZONE, Op.IN, [zone])],
+            )
+        )
     # tainted-pool pods
     for i in range(rng.randint(0, 20)):
         pods.append(

@@ -1094,3 +1094,118 @@ class TestCrossClassColocMerge:
         # a matching pod); each opens its own node
         assert not res.existing_placements
         assert res.node_count() == 2
+
+
+class TestPreferredAffinity:
+    """Preferred node affinity: honored when feasible (treated as required
+    while simulating), relaxed all-at-once when the pod would otherwise be
+    unschedulable — karpenter-core's preference relaxation (reference
+    website v0.31 concepts/scheduling.md)."""
+
+    def test_preference_honored_on_tensor_path(self, setup):
+        pool, types = setup
+        pods = [
+            Pod(
+                requests=Resources(cpu=1, memory="2Gi"),
+                preferred_affinity=[
+                    Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"])
+                ],
+            )
+            for _ in range(20)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        for vn in tensor.new_nodes:
+            assert vn.requirements.get(L.LABEL_ZONE).has("zone-b")
+        for vn in oracle.new_nodes:
+            assert vn.requirements.get(L.LABEL_ZONE).has("zone-b")
+
+    def test_unsatisfiable_preference_relaxes(self, setup):
+        pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(10)]
+        pods += [
+            Pod(
+                requests=Resources(cpu=1, memory="2Gi"),
+                preferred_affinity=[
+                    Requirement(L.LABEL_ZONE, Op.IN, ["zone-nowhere"])
+                ],
+            )
+            for _ in range(5)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        # the preference can't be met; pods schedule anyway
+        assert not tensor.unschedulable
+        assert not oracle.unschedulable
+        assert ts.last_path == "hybrid"  # relaxation rode the oracle pass
+        placed = sum(len(n.pods) for n in tensor.new_nodes)
+        assert placed == 15
+
+    def test_preferences_split_classes(self, setup):
+        """Pods differing only in preferences are distinct classes."""
+        pool, types = setup
+        a = Pod(requests=Resources(cpu=1))
+        b = Pod(
+            requests=Resources(cpu=1),
+            preferred_affinity=[Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"])],
+        )
+        assert a.constraint_signature() != b.constraint_signature()
+        prob = compile_problem([a, b], [pool], {pool.name: types})
+        assert len(prob.classes) == 2
+
+    def test_relaxed_pod_respects_spread_of_placed_siblings(self, setup):
+        """A relaxing pod sharing a spread group with tensor-placed
+        siblings must see their zone counts (the seed_topology replay)."""
+        pool, types = setup
+        sel = (("svc", "pref"),)
+        c = TopologySpreadConstraint(
+            max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+        )
+        plain = [
+            Pod(
+                labels={"svc": "pref"},
+                requests=Resources(cpu=1, memory="2Gi"),
+                topology_spread=[c],
+            )
+            for _ in range(8)
+        ]
+        pref = Pod(
+            labels={"svc": "pref"},
+            requests=Resources(cpu=1, memory="2Gi"),
+            topology_spread=[c],
+            preferred_affinity=[
+                Requirement(L.LABEL_INSTANCE_CATEGORY, Op.IN, ["no-such"])
+            ],
+        )
+        ts = TensorScheduler([pool], {pool.name: types})
+        res = ts.solve(plain + [pref])
+        assert not res.unschedulable
+        counts = {}
+        for vn in res.new_nodes:
+            zone = vn.requirements.get(L.LABEL_ZONE).any_value()
+            for p in vn.pods:
+                counts[zone] = counts.get(zone, 0) + 1
+        assert sum(counts.values()) == 9
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_compaction_never_trades_away_satisfiable_preference(self, setup):
+        """The decode compaction pass must not move preference carriers off
+        the node that honors their preference."""
+        pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(12)]
+        pods += [
+            Pod(
+                requests=Resources(cpu=0.25, memory="512Mi"),
+                preferred_affinity=[
+                    Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"])
+                ],
+            )
+            for _ in range(2)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types})
+        res = ts.solve(pods)
+        assert not res.unschedulable
+        for vn in res.new_nodes:
+            for p in vn.pods:
+                if p.preferred_affinity:
+                    assert vn.requirements.get(L.LABEL_ZONE).has("zone-b")
