@@ -23,5 +23,7 @@ from karpenter_tpu.api.objects import (  # noqa: F401
     NodeClaim,
     NodeClaimCondition,
     NodeClass,
+    PersistentVolumeClaim,
+    StorageClass,
 )
 from karpenter_tpu.api.settings import Settings  # noqa: F401

@@ -111,6 +111,15 @@ class Pod:
     node_selector: Dict[str, str] = field(default_factory=dict)
     required_affinity: List[Requirement] = field(default_factory=list)
     preferred_affinity: List[Requirement] = field(default_factory=list)
+    # names of PersistentVolumeClaims (same namespace) the pod mounts; the
+    # provisioner resolves them into `volume_requirements` before solving
+    # (reference website v0.31 concepts/scheduling.md "persistent volume
+    # topology": nodes must land where the volumes can live)
+    volume_claims: List[str] = field(default_factory=list)
+    # zone requirements derived from the claims (bound PV zone, or the
+    # storage class's allowed topologies for unbound WaitForFirstConsumer
+    # claims) — injected/refreshed per provisioning pass, REQUIRED while set
+    volume_requirements: List[Requirement] = field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
     pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
@@ -130,6 +139,7 @@ class Pod:
             "node_selector",
             "required_affinity",
             "preferred_affinity",
+            "volume_requirements",
             "tolerations",
             "topology_spread",
             "pod_affinity",
@@ -162,6 +172,8 @@ class Pod:
         here is all-or-nothing rather than term-by-term)."""
         reqs = Requirements.from_labels(self.node_selector)
         for r in self.required_affinity:
+            reqs.add(r)
+        for r in self.volume_requirements:
             reqs.add(r)
         if preferred:
             for r in self.preferred_affinity:
@@ -203,6 +215,7 @@ class Pod:
             self.namespace,
             # appended LAST so consumers indexing sig[0..6] stay valid
             tuple(sorted(map(repr, self.preferred_affinity))),
+            tuple(sorted(map(repr, self.volume_requirements))),
         )
         return sig
 
@@ -538,3 +551,28 @@ class NodeClass:
         return hashlib.sha256(json.dumps(spec, sort_keys=True).encode()).hexdigest()[
             :16
         ]
+
+
+@dataclass
+class StorageClass:
+    """Zonal storage topology (reference website v0.31
+    concepts/scheduling.md:387-411: a StorageClass's allowedTopologies +
+    volumeBindingMode constrain where a consuming pod's node may land)."""
+
+    name: str
+    zones: Tuple[str, ...] = ()  # allowedTopologies; empty = any zone
+    binding_mode: str = "WaitForFirstConsumer"  # or "Immediate"
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """The scheduling-relevant projection of a PVC: which storage class
+    provisions it and, once provisioned, which zone the volume lives in."""
+
+    name: str
+    namespace: str = "default"
+    storage_class: str = ""
+    bound_zone: str = ""  # set when the volume provisions / first consumer binds
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"

@@ -649,6 +649,14 @@ class DisruptionController:
         pods = [p for c in removed for p in c.reschedulable]
         if not pods:
             return True, 0.0, None
+        # a claim that bound since the pod last provisioned pins its zone;
+        # the repack must not move the pod away from its volume
+        from karpenter_tpu.controllers.provisioning import (
+            resolve_volume_requirements,
+        )
+
+        for p in pods:
+            resolve_volume_requirements(p, self.kube)
         pools, inventory = pool_inventory or self._pool_inventory()
         scheduler = self._scheduler.update(
             pools,

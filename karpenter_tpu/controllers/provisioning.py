@@ -136,6 +136,8 @@ class Provisioner:
         pools = [p for p in self.kube.node_pools.values() if not p.deleted]
         if not pools or not pods:
             return []
+        for p in pods:
+            resolve_volume_requirements(p, self.kube)
         inventory: Dict[str, list] = {}
         for pool in pools:
             try:
@@ -278,6 +280,41 @@ class Provisioner:
     def _claim_capacity_estimate(vn: VirtualNode) -> Resources:
         it = next(iter(vn.final_instance_types()), None)
         return it.capacity if it is not None else vn.used
+
+
+def resolve_volume_requirements(pod: Pod, kube) -> None:
+    """Refresh a pod's volume-derived zone requirements before a solve.
+
+    Bound claims pin the volume's zone; unbound WaitForFirstConsumer
+    claims admit the storage class's allowed topologies (reference website
+    v0.31 concepts/scheduling.md:387-411).  Idempotent — the field is
+    REPLACED each pass, so a claim that bound since the last solve
+    tightens the requirement instead of stacking."""
+    from karpenter_tpu.api.requirements import Op, Requirement
+
+    if not pod.volume_claims:
+        return
+    zones = None
+    for cname in pod.volume_claims:
+        pvc = kube.pvcs.get(f"{pod.namespace}/{cname}")
+        if pvc is None:
+            continue  # claim not created yet: kubelet would block, not us
+        if pvc.bound_zone:
+            z = {pvc.bound_zone}
+        else:
+            sc = kube.storage_classes.get(pvc.storage_class)
+            if sc is None or not sc.zones:
+                continue  # topology-unconstrained storage
+            z = set(sc.zones)
+        zones = z if zones is None else zones & z
+    if zones is None:
+        new = []
+    else:
+        # an empty intersection compiles to an unsatisfiable requirement,
+        # surfacing the conflict as an unschedulable pod with a reason
+        new = [Requirement(L.LABEL_ZONE, Op.IN, sorted(zones))]
+    if new != pod.volume_requirements:
+        pod.volume_requirements = new
 
 
 def claim_from_vnode(vn: VirtualNode) -> NodeClaim:

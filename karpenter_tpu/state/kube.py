@@ -14,7 +14,17 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from karpenter_tpu.api import NodeClaim, NodeClass, NodePool, Pod, Resources, Taint
+from karpenter_tpu.api import (
+    NodeClaim,
+    NodeClass,
+    NodePool,
+    PersistentVolumeClaim,
+    Pod,
+    Resources,
+    StorageClass,
+    Taint,
+)
+from karpenter_tpu.api import labels as L
 
 
 @dataclass
@@ -77,6 +87,8 @@ class KubeStore:
         self.node_pools: Dict[str, NodePool] = {}
         self.node_classes: Dict[str, NodeClass] = {}
         self.pdbs: Dict[str, PodDisruptionBudget] = {}
+        self.storage_classes: Dict[str, StorageClass] = {}
+        self.pvcs: Dict[str, PersistentVolumeClaim] = {}  # key: ns/name
         self.events: List[tuple] = []  # (kind, reason, obj_name, message)
         self._watchers: List[Callable[[str, str, object], None]] = []
         self._seq = itertools.count(1)
@@ -113,6 +125,18 @@ class KubeStore:
         pod = self.pods[key]
         pod.node_name = node_name
         pod.phase = "Running"
+        # the first consumer anchors WaitForFirstConsumer volumes: the
+        # volume provisions in the bound node's zone, pinning every later
+        # consumer of the claim there (scheduling.md:387-411)
+        if pod.volume_claims:
+            node = self.nodes.get(node_name)
+            zone = node.labels.get(L.LABEL_ZONE, "") if node else ""
+            if zone:
+                for cname in pod.volume_claims:
+                    pvc = self.pvcs.get(f"{pod.namespace}/{cname}")
+                    if pvc is not None and not pvc.bound_zone:
+                        pvc.bound_zone = zone
+                        self._notify("PersistentVolumeClaim", "bind", pvc)
         self._notify("Pod", "bind", pod)
 
     def evict_pod(self, key: str) -> None:
@@ -189,6 +213,26 @@ class KubeStore:
 
     def get_node_class(self, name: str) -> Optional[NodeClass]:
         return self.node_classes.get(name)
+
+    def put_storage_class(self, sc: StorageClass) -> StorageClass:
+        self.storage_classes[sc.name] = sc
+        self._notify("StorageClass", "put", sc)
+        return sc
+
+    def put_pvc(self, pvc: PersistentVolumeClaim) -> PersistentVolumeClaim:
+        # Immediate-mode claims provision as soon as they exist — the fake
+        # PV controller picks the storage class's first allowed zone
+        sc = self.storage_classes.get(pvc.storage_class)
+        if (
+            not pvc.bound_zone
+            and sc is not None
+            and sc.binding_mode == "Immediate"
+            and sc.zones
+        ):
+            pvc.bound_zone = sc.zones[0]
+        self.pvcs[pvc.key()] = pvc
+        self._notify("PersistentVolumeClaim", "put", pvc)
+        return pvc
 
     def put_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
         self.pdbs[pdb.name] = pdb
