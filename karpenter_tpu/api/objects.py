@@ -110,6 +110,12 @@ class Pod:
     requests: Resources = field(default_factory=Resources)
     node_selector: Dict[str, str] = field(default_factory=dict)
     required_affinity: List[Requirement] = field(default_factory=list)
+    # OR-of-AND nodeSelectorTerms (reference scheduling.md:230-259):
+    # karpenter goes through the terms in order and takes the first that
+    # works.  When set, this supersedes `required_affinity` (the
+    # single-term convenience); the tensor path compiles term 0 and the
+    # oracle fallback iterates the rest.
+    affinity_terms: List[Tuple[Requirement, ...]] = field(default_factory=list)
     preferred_affinity: List[Requirement] = field(default_factory=list)
     # names of PersistentVolumeClaims (same namespace) the pod mounts; the
     # provisioner resolves them into `volume_requirements` before solving
@@ -138,6 +144,7 @@ class Pod:
             "namespace",
             "node_selector",
             "required_affinity",
+            "affinity_terms",
             "preferred_affinity",
             "volume_requirements",
             "tolerations",
@@ -162,8 +169,20 @@ class Pod:
             self.requests = self.requests + Resources({L.RESOURCE_PODS: 1})
 
     # -- derived scheduling state -------------------------------------------
-    def scheduling_requirements(self, preferred: bool = False) -> Requirements:
-        """nodeSelector + required node affinity as one conjunction.
+    def node_affinity_terms(self) -> List[Tuple[Requirement, ...]]:
+        """The OR-terms in karpenter's try-in-order semantics; the
+        single-term convenience field maps to one term."""
+        if self.affinity_terms:
+            return self.affinity_terms
+        if self.required_affinity:
+            return [tuple(self.required_affinity)]
+        return [()]
+
+    def scheduling_requirements(
+        self, preferred: bool = False, term: int = 0
+    ) -> Requirements:
+        """nodeSelector + the ``term``-th node-affinity OR-term as one
+        conjunction.
 
         With ``preferred`` the preferred-affinity terms merge in too:
         karpenter treats preferences as REQUIRED while simulating and
@@ -171,7 +190,8 @@ class Pod:
         website v0.31 concepts/scheduling.md "preferences"; the relaxation
         here is all-or-nothing rather than term-by-term)."""
         reqs = Requirements.from_labels(self.node_selector)
-        for r in self.required_affinity:
+        terms = self.node_affinity_terms()
+        for r in terms[min(term, len(terms) - 1)]:
             reqs.add(r)
         for r in self.volume_requirements:
             reqs.add(r)
@@ -216,6 +236,7 @@ class Pod:
             # appended LAST so consumers indexing sig[0..6] stay valid
             tuple(sorted(map(repr, self.preferred_affinity))),
             tuple(sorted(map(repr, self.volume_requirements))),
+            tuple(tuple(map(repr, t)) for t in self.affinity_terms),
         )
         return sig
 

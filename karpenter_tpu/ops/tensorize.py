@@ -455,9 +455,10 @@ def _coloc_component_mergeable(
         ):
             return False
         sig = rep.constraint_signature()
-        # node_selector, required AND preferred node affinity, tolerations,
-        # namespace — preferences are node-affecting while unrelaxed
-        part = (sig[0], sig[1], sig[2], sig[7], rep.namespace)
+        # node_selector, required/preferred node affinity, volume-derived
+        # requirements, tolerations, namespace — preferences are
+        # node-affecting while unrelaxed
+        part = (sig[0], sig[1], sig[2], sig[7], sig[8], sig[9], rep.namespace)
         if node_part is None:
             node_part = part
         elif part != node_part:

@@ -209,7 +209,11 @@ class VirtualNode:
         return [t for t, ok in zip(cand, mask) if ok]
 
     def try_add(
-        self, pod: Pod, topology: TopologyTracker, preferred: bool = True
+        self,
+        pod: Pod,
+        topology: TopologyTracker,
+        preferred: bool = True,
+        term: int = 0,
     ) -> bool:
         if not tolerates_all(pod.tolerations, self.pool.taints):
             return False
@@ -226,7 +230,7 @@ class VirtualNode:
             if not (NEW_DOMAIN in host_allowed and not self.pods):
                 return False
         reqs = Requirements(iter(self.requirements))
-        for r in pod.scheduling_requirements(preferred=preferred):
+        for r in pod.scheduling_requirements(preferred=preferred, term=term):
             reqs.add(r)
         if reqs.is_unsatisfiable():
             return False
@@ -249,8 +253,16 @@ class VirtualNode:
 
         new_used = self.used + pod.requests
         sig = pod.constraint_signature()
+        # the key must cover every sig component that feeds the merged
+        # requirements: node_selector, required affinity, preferences,
+        # volume-derived reqs, OR-terms — plus which attempt this is
         feasible = self._fits_some_type(
-            reqs, new_used, cache_key=(sig[0], sig[1], sig[7], preferred, zone_choice)
+            reqs,
+            new_used,
+            cache_key=(
+                sig[0], sig[1], sig[7], sig[8], sig[9],
+                preferred, term, zone_choice,
+            ),
         )
         if not feasible:
             return False
@@ -331,7 +343,11 @@ class ExistingNode:
         return self.state.name
 
     def try_add(
-        self, pod: Pod, topology: TopologyTracker, preferred: bool = True
+        self,
+        pod: Pod,
+        topology: TopologyTracker,
+        preferred: bool = True,
+        term: int = 0,
     ) -> bool:
         if self.state.marked_for_deletion() or (
             self.state.node is not None and self.state.node.cordoned
@@ -346,7 +362,7 @@ class ExistingNode:
         if self._label_reqs is None:
             self._label_reqs = Requirements.from_labels(self.state.labels)
         if not self._label_reqs.compatible(
-            pod.scheduling_requirements(preferred=preferred)
+            pod.scheduling_requirements(preferred=preferred, term=term)
         ):
             return False
         host_allowed = topology.allowed_domains(pod, HOSTNAME)
@@ -433,41 +449,56 @@ class Scheduler:
         if result is None:
             result = SchedulingResult()
         for pod in sorted(pods, key=pod_sort_key):
+            # node-affinity OR-terms go in order, first that works
+            # (reference scheduling.md:230-259); within each term,
             # preferences are REQUIRED on the first attempt and relaxed
             # (all at once) only when the pod proves unschedulable —
-            # karpenter-core's preference relaxation (reference website
-            # v0.31 concepts/scheduling.md)
-            reason = self._place(pod, result, preferred=True)
-            if reason is not None and pod.preferred_affinity:
-                reason = self._place(pod, result, preferred=False)
+            # karpenter-core's preference relaxation
+            reason = None
+            for ti in range(len(pod.node_affinity_terms())):
+                reason = self._place(pod, result, preferred=True, term=ti)
+                if reason is None:
+                    break
+                if pod.preferred_affinity:
+                    reason = self._place(pod, result, preferred=False, term=ti)
+                    if reason is None:
+                        break
             if reason is not None:
                 result.unschedulable[pod.key()] = reason
         return result
 
     def _place(
-        self, pod: Pod, result: SchedulingResult, preferred: bool
+        self, pod: Pod, result: SchedulingResult, preferred: bool, term: int = 0
     ) -> Optional[str]:
         """One placement attempt; None on success, else the reason."""
-        if self._schedule_existing(pod, result, preferred):
+        if self._schedule_existing(pod, result, preferred, term):
             return None
-        if self._schedule_open_vnode(pod, result, preferred):
+        if self._schedule_open_vnode(pod, result, preferred, term):
             return None
-        return self._schedule_new_vnode(pod, result, preferred)
+        return self._schedule_new_vnode(pod, result, preferred, term)
 
     def _schedule_existing(
-        self, pod: Pod, result: SchedulingResult, preferred: bool = True
+        self,
+        pod: Pod,
+        result: SchedulingResult,
+        preferred: bool = True,
+        term: int = 0,
     ) -> bool:
         host_allowed = self.topology.allowed_domains(pod, HOSTNAME)
         for en in self.existing:
             if host_allowed is not None and en.name not in host_allowed:
                 continue
-            if en.try_add(pod, self.topology, preferred):
+            if en.try_add(pod, self.topology, preferred, term):
                 result.existing_placements[pod.key()] = en.name
                 return True
         return False
 
     def _schedule_open_vnode(
-        self, pod: Pod, result: SchedulingResult, preferred: bool = True
+        self,
+        pod: Pod,
+        result: SchedulingResult,
+        preferred: bool = True,
+        term: int = 0,
     ) -> bool:
         # two cheap prefilters before any try_add work: hostname-constrained
         # pods (co-location followers, anti-affinity singletons) admit only
@@ -492,12 +523,16 @@ class Scheduler:
                 or used.get("memory") + mem_need > hi_mem + 1e-9
             ):
                 continue
-            if vn.try_add(pod, self.topology, preferred):
+            if vn.try_add(pod, self.topology, preferred, term):
                 return True
         return False
 
     def _schedule_new_vnode(
-        self, pod: Pod, result: SchedulingResult, preferred: bool = True
+        self,
+        pod: Pod,
+        result: SchedulingResult,
+        preferred: bool = True,
+        term: int = 0,
     ) -> Optional[str]:
         reason = "no nodepool matched pod constraints"
         for pool in self.pools:
@@ -506,7 +541,7 @@ class Scheduler:
                 reason = f"nodepool {pool.name} has no instance types"
                 continue
             vn = self._new_vnode(pool, types)
-            if vn.try_add(pod, self.topology, preferred):
+            if vn.try_add(pod, self.topology, preferred, term):
                 result.new_nodes.append(vn)
                 return None
             reason = "pod incompatible with every instance type / offering"

@@ -153,17 +153,19 @@ class TensorScheduler:
             self.last_path = "hybrid"
             with TRACER.span("solver.oracle_continue", pods=len(unsupported)):
                 result = self._oracle_continue(unsupported, supported, result)
-        # preference relaxation: the tensor path compiles preferred node
-        # affinity as REQUIRED (objects.py scheduling_requirements), so a
-        # pod whose preferences can't be met decodes unschedulable — give
-        # it the oracle's relax-and-retry (which first re-tries WITH
-        # preferences against the open nodes, then drops them), seeded
+        # preference/OR-term relaxation: the tensor path compiles preferred
+        # node affinity as REQUIRED and only a pod's FIRST nodeSelectorTerm
+        # (objects.py scheduling_requirements), so a pod whose preferences
+        # or first term can't be met decodes unschedulable — give it the
+        # oracle's relax-and-retry (which re-tries WITH preferences against
+        # the open nodes, then drops them / walks the later terms), seeded
         # with full topology records because relaxed pods may share spread
         # groups with their tensor-placed siblings
         relax = [
             p
             for p in pods
-            if p.preferred_affinity and p.key() in result.unschedulable
+            if (p.preferred_affinity or len(p.node_affinity_terms()) > 1)
+            and p.key() in result.unschedulable
         ]
         if relax:
             relax_keys = {p.key() for p in relax}

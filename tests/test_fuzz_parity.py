@@ -66,6 +66,18 @@ def _workload(rng: random.Random):
                 preferred_affinity=[Requirement(L.LABEL_ZONE, Op.IN, [zone])],
             )
         )
+    # OR-term carriers: first term sometimes impossible, second real
+    for i in range(rng.randint(0, 10)):
+        first = rng.choice(["zone-a", "zone-nowhere"])
+        pods.append(
+            Pod(
+                requests=rng.choice(SIZES[:3]),
+                affinity_terms=[
+                    (Requirement(L.LABEL_ZONE, Op.IN, [first]),),
+                    (Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"]),),
+                ],
+            )
+        )
     # tainted-pool pods
     for i in range(rng.randint(0, 20)):
         pods.append(

@@ -1209,3 +1209,72 @@ class TestPreferredAffinity:
             for p in vn.pods:
                 if p.preferred_affinity:
                     assert vn.requirements.get(L.LABEL_ZONE).has("zone-b")
+
+
+class TestNodeAffinityOrTerms:
+    """nodeSelectorTerms OR semantics (reference scheduling.md:230-259):
+    karpenter goes through the terms in order and takes the first that
+    works; the tensor path compiles term 0, the oracle walks the rest."""
+
+    def test_first_term_wins_when_feasible(self, setup):
+        pool, types = setup
+        pods = [
+            Pod(
+                requests=Resources(cpu=1, memory="2Gi"),
+                affinity_terms=[
+                    (Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"]),),
+                    (Requirement(L.LABEL_ZONE, Op.IN, ["zone-c"]),),
+                ],
+            )
+            for _ in range(10)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        for vn in tensor.new_nodes:
+            assert vn.requirements.get(L.LABEL_ZONE).has("zone-b")
+
+    def test_falls_through_to_second_term(self, setup):
+        pool, types = setup
+        pods = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(6)]
+        pods += [
+            Pod(
+                requests=Resources(cpu=1, memory="2Gi"),
+                affinity_terms=[
+                    (Requirement(L.LABEL_ZONE, Op.IN, ["zone-nowhere"]),),
+                    (Requirement(L.LABEL_ZONE, Op.IN, ["zone-c"]),),
+                ],
+            )
+            for _ in range(4)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert not tensor.unschedulable
+        assert not oracle.unschedulable
+        assert ts.last_path == "hybrid"  # term walk rode the oracle pass
+        for res in (tensor, oracle):
+            for vn in res.new_nodes:
+                for p in vn.pods:
+                    if p.affinity_terms:
+                        assert vn.requirements.get(L.LABEL_ZONE).has("zone-c")
+
+    def test_all_terms_fail_unschedulable(self, setup):
+        pool, types = setup
+        pod = Pod(
+            requests=Resources(cpu=1),
+            affinity_terms=[
+                (Requirement(L.LABEL_ZONE, Op.IN, ["zone-x"]),),
+                (Requirement(L.LABEL_ZONE, Op.IN, ["zone-y"]),),
+            ],
+        )
+        oracle, tensor, ts = both(pool, types, [pod])
+        assert pod.key() in tensor.unschedulable
+        assert pod.key() in oracle.unschedulable
+
+    def test_terms_split_classes(self, setup):
+        pool, types = setup
+        a = Pod(requests=Resources(cpu=1))
+        b = Pod(
+            requests=Resources(cpu=1),
+            affinity_terms=[(Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"]),)],
+        )
+        assert a.constraint_signature() != b.constraint_signature()
