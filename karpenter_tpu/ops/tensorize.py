@@ -28,14 +28,20 @@ The resulting `CompiledProblem` is pure numpy; `ops/packer.py` moves it to
 device and runs the packing scan under jit.
 
 Constraint coverage: the tensor path handles resource requests, node
-selectors/affinity, taints/tolerations, zonal offerings, capacity types,
-self-selecting hostname anti-affinity (max 1 per node), hostname topology
-spread (max `maxSkew` per node while any empty node exists — exact in the
-scale-out regime), and zone topology spread (classes split across allowed
-zones, balanced against already-placed counts).  Anything else — inter-class
-pod affinity, zone-keyed anti-affinity — is reported via
-``unsupported_reason`` and the caller falls back to the pure-Python oracle
-(scheduling/scheduler.py).
+selectors/affinity (first OR-term; preferences compiled as required),
+volume-derived zone requirements, taints/tolerations, zonal offerings,
+capacity types, hostname anti-affinity — self-selecting AND mutual
+cross-class (shared `_track_key` counter slots), hostname co-location —
+self-selecting AND node-equivalent cross-class closures (macro units),
+hostname topology spread (max `maxSkew` per node while any empty node
+exists — exact in the scale-out regime), zone topology spread — incl.
+mutual cross-class, split across allowed zones against the shared
+per-group accumulator — and zone-keyed pod affinity (compile-time domain
+anchoring).  Anything else — one-sided cross-class couplings,
+node-inequivalent closures, zone-affinity+spread combos, exotic topology
+keys, live-member co-location — is reported via ``unsupported_reason``
+and routed to the pure-Python oracle (scheduling/scheduler.py), whole or
+as the hybrid continuation of a split batch.
 """
 
 from __future__ import annotations
@@ -353,7 +359,12 @@ def class_unsupported_reason(rep: Pod) -> str:
       macro placement unit carrying the whole group's summed requests
     - hostname/zone topology spread -> per-node caps / zone shares
 
-    Everything else (cross-class selectors; exotic topology keys) goes to
+    Cross-class shapes are cured at partition level when they are MUTUAL:
+    node-equivalent co-location closures merge into one macro unit
+    (_coloc_component_mergeable), identical-fingerprint hostname
+    anti-affinity shares a counter slot (_track_key), and identical
+    mutual zone spreads split against the shared group accumulator.
+    Everything else (one-sided couplings; exotic topology keys) goes to
     the oracle half of a hybrid solve (scheduling/solver.py).
     """
     has_zone_aff = False

@@ -151,3 +151,24 @@ class TestKompat:
         # unknown app version (e.g. trimmed by --last-n): diagnostic, rc 2
         assert kompat.main([str(path), "-n", "1", "--app-version", "0.30.0",
                             "--k8s-version", "1.27"]) == 2
+
+
+class TestMetricsDocGen:
+    def test_doc_matches_source(self):
+        """docs/metrics.md is generated; regeneration must be a no-op
+        (the reference's codegen-freshness contract, hack/codegen.sh)."""
+        import pathlib
+
+        from karpenter_tpu.tools.gen_metrics_doc import render
+
+        pkg = pathlib.Path(__file__).resolve().parent.parent / "karpenter_tpu"
+        doc = pkg.parent / "docs" / "metrics.md"
+        assert doc.read_text() == render(pkg)
+
+    def test_families_documented(self):
+        import pathlib
+
+        from karpenter_tpu.tools.gen_metrics_doc import collect
+
+        pkg = pathlib.Path(__file__).resolve().parent.parent / "karpenter_tpu"
+        assert len(collect(pkg)) >= 40
