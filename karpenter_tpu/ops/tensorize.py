@@ -392,8 +392,7 @@ def class_unsupported_reason(rep: Pod) -> str:
     zone_spread = any(
         c.topology_key == L.LABEL_ZONE
         and c.selects(rep)
-        and c.when_unsatisfiable == "DoNotSchedule"
-        for c in rep.topology_spread
+                for c in rep.topology_spread
     )
     if has_zone_aff and (zone_spread or has_zone_anti):
         return "zone affinity combined with another zone constraint"
@@ -669,8 +668,7 @@ def partition_groups(
             # needs the oracle's runtime counts.
             zone_mutual = (
                 c.topology_key == L.LABEL_ZONE
-                and c.when_unsatisfiable == "DoNotSchedule"
-                and c.selects(rep)
+                                and c.selects(rep)
             )
             for j in matches(c):
                 if j == i:
@@ -703,8 +701,7 @@ def partition_groups(
                 # merges into the same component and is fine)
                 if any(
                     c.topology_key == L.LABEL_ZONE
-                    and c.when_unsatisfiable == "DoNotSchedule"
-                    and c.selects(b)
+                                        and c.selects(b)
                     for c in b.topology_spread
                 ) or any(
                     tt.topology_key == L.LABEL_ZONE
@@ -816,8 +813,7 @@ def _max_per_node(pod: Pod) -> int:
         if (
             c.topology_key == L.LABEL_HOSTNAME
             and c.selects(pod)
-            and c.when_unsatisfiable == "DoNotSchedule"
-        ):
+                    ):
             cap = min(cap, c.max_skew)
     return cap
 
@@ -840,8 +836,7 @@ def _track_key(pod: Pod) -> Tuple:
         for c in pod.topology_spread
         if c.topology_key == L.LABEL_HOSTNAME
         and c.selects(pod)
-        and c.when_unsatisfiable == "DoNotSchedule"
-    }
+            }
     return tuple(sorted(sels))
 
 
@@ -862,8 +857,7 @@ def _zone_spread_zones(pod: Pod) -> bool:
     return any(
         c.topology_key == L.LABEL_ZONE
         and c.selects(pod)
-        and c.when_unsatisfiable == "DoNotSchedule"
-        for c in pod.topology_spread
+                for c in pod.topology_spread
     )
 
 
@@ -1091,8 +1085,7 @@ def compile_problem(
                 for c in rep.topology_spread
                 if c.topology_key == L.LABEL_ZONE
                 and c.selects(rep)
-                and c.when_unsatisfiable == "DoNotSchedule"
-            )
+                            )
             zr = rep.scheduling_requirements(preferred=True).get(L.LABEL_ZONE)
             cand_zones = [z for z in all_zones if zr is None or zr.has(z)]
             # ...and by the POOLS' zone admission: spread domains are the

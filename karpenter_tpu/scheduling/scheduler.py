@@ -42,12 +42,16 @@ _vnode_seq = itertools.count()
 PENDING_WIDEN = object()
 
 
-def _zone_constrained(pod: Pod) -> bool:
-    """Pod carries a zone-keyed topology constraint (spread or affinity)."""
+def _zone_constrained(pod: Pod, include_soft: bool = True) -> bool:
+    """Pod carries a zone-keyed topology constraint (spread or affinity).
+
+    ScheduleAnyway spreads count only while ``include_soft`` — karpenter
+    honors them as required until the pod proves unschedulable, then
+    relaxes (the same two-phase walk preferences ride)."""
     return any(
         c.topology_key == ZONE
         and c.selects(pod)
-        and c.when_unsatisfiable == "DoNotSchedule"
+        and (include_soft or c.when_unsatisfiable == "DoNotSchedule")
         for c in pod.topology_spread
     ) or any(t.topology_key == ZONE for t in pod.pod_affinity)
 
@@ -225,7 +229,7 @@ class VirtualNode:
         # headroom gate, it is the cheapest remaining rejection — a
         # co-location follower probes every open node and all but its
         # anchor fail here.
-        host_allowed = topology.allowed_domains(pod, HOSTNAME)
+        host_allowed = topology.allowed_domains(pod, HOSTNAME, preferred)
         if host_allowed is not None and self.name not in host_allowed:
             if not (NEW_DOMAIN in host_allowed and not self.pods):
                 return False
@@ -238,8 +242,8 @@ class VirtualNode:
         # carrying one must PIN a zone so the placement is counted/anchored
         # (first affinity pod anchors the domain for followers)
         zone_choice: Optional[str] = None
-        if _zone_constrained(pod) or topology.selected_by_group(pod, ZONE):
-            zone_allowed = topology.allowed_domains(pod, ZONE)
+        if _zone_constrained(pod, preferred) or topology.selected_by_group(pod, ZONE):
+            zone_allowed = topology.allowed_domains(pod, ZONE, preferred)
             options = self.zone_options()
             if zone_allowed is not None:
                 options &= zone_allowed
@@ -365,10 +369,10 @@ class ExistingNode:
             pod.scheduling_requirements(preferred=preferred, term=term)
         ):
             return False
-        host_allowed = topology.allowed_domains(pod, HOSTNAME)
+        host_allowed = topology.allowed_domains(pod, HOSTNAME, preferred)
         if host_allowed is not None and self.name not in host_allowed:
             return False
-        zone_allowed = topology.allowed_domains(pod, ZONE)
+        zone_allowed = topology.allowed_domains(pod, ZONE, preferred)
         zone = self.state.zone
         if zone_allowed is not None and zone and zone not in zone_allowed:
             return False
@@ -451,15 +455,19 @@ class Scheduler:
         for pod in sorted(pods, key=pod_sort_key):
             # node-affinity OR-terms go in order, first that works
             # (reference scheduling.md:230-259); within each term,
-            # preferences are REQUIRED on the first attempt and relaxed
-            # (all at once) only when the pod proves unschedulable —
-            # karpenter-core's preference relaxation
+            # preferences AND ScheduleAnyway spreads are REQUIRED on the
+            # first attempt and relaxed (all at once) only when the pod
+            # proves unschedulable — karpenter-core's relaxation
+            relaxable = bool(pod.preferred_affinity) or any(
+                c.when_unsatisfiable != "DoNotSchedule"
+                for c in pod.topology_spread
+            )
             reason = None
             for ti in range(len(pod.node_affinity_terms())):
                 reason = self._place(pod, result, preferred=True, term=ti)
                 if reason is None:
                     break
-                if pod.preferred_affinity:
+                if relaxable:
                     reason = self._place(pod, result, preferred=False, term=ti)
                     if reason is None:
                         break
@@ -484,7 +492,7 @@ class Scheduler:
         preferred: bool = True,
         term: int = 0,
     ) -> bool:
-        host_allowed = self.topology.allowed_domains(pod, HOSTNAME)
+        host_allowed = self.topology.allowed_domains(pod, HOSTNAME, preferred)
         for en in self.existing:
             if host_allowed is not None and en.name not in host_allowed:
                 continue
@@ -505,7 +513,7 @@ class Scheduler:
         # their anchor domains, and every pod skips nodes whose cached
         # cpu/mem upper bound can't hold it — most probes in a big solve
         # hit already-full nodes
-        host_allowed = self.topology.allowed_domains(pod, HOSTNAME)
+        host_allowed = self.topology.allowed_domains(pod, HOSTNAME, preferred)
         allow_new = host_allowed is None or NEW_DOMAIN in host_allowed
         cpu_need = pod.requests.get("cpu")
         mem_need = pod.requests.get("memory")

@@ -164,7 +164,14 @@ class TensorScheduler:
         relax = [
             p
             for p in pods
-            if (p.preferred_affinity or len(p.node_affinity_terms()) > 1)
+            if (
+                p.preferred_affinity
+                or len(p.node_affinity_terms()) > 1
+                or any(
+                    c.when_unsatisfiable != "DoNotSchedule"
+                    for c in p.topology_spread
+                )
+            )
             and p.key() in result.unschedulable
         ]
         if relax:

@@ -137,20 +137,39 @@ class TopologyTracker:
         return g
 
     # -- queries -------------------------------------------------------------
-    def allowed_domains(self, pod: Pod, key: str) -> Optional[Set[str]]:
+    def allowed_domains(
+        self, pod: Pod, key: str, include_soft: bool = True
+    ) -> Optional[Set[str]]:
         """Intersection of all constraints' allowed domains for `pod` on
         topology `key`.  None = unconstrained.  NEW_DOMAIN membership means a
-        fresh domain (a new node, for hostname keys) is acceptable."""
+        fresh domain (a new node, for hostname keys) is acceptable.
+
+        ScheduleAnyway spreads participate while ``include_soft`` (the
+        strict first attempt); a relaxing caller passes False to drop
+        them, keeping hard constraints in force."""
         allow_new = key == HOSTNAME
         universe = self.universe.get(key, set())
         result: Optional[Set[str]] = None
 
+        spread_universe: Optional[Set[str]] = None
         for c in pod.topology_spread:
             if c.topology_key != key or not c.selects(pod):
                 continue
-            if c.when_unsatisfiable != "DoNotSchedule":
-                continue  # ScheduleAnyway is soft; best-effort only
-            allowed = self._spread_group(c).allowed(universe, allow_new)
+            if not include_soft and c.when_unsatisfiable != "DoNotSchedule":
+                continue  # relaxed attempt: soft spreads drop away
+            if spread_universe is None:
+                # kube's default nodeAffinityPolicy=Honor: skew is counted
+                # only over domains the pod itself can schedule into — a
+                # pod pinned to one zone has a one-domain universe, not a
+                # wedged global minimum
+                spread_universe = universe
+                if key == ZONE:
+                    zr = pod.scheduling_requirements().get(key)
+                    if zr is not None:
+                        spread_universe = {
+                            z for z in universe if zr.has(z)
+                        }
+            allowed = self._spread_group(c).allowed(spread_universe, allow_new)
             result = allowed if result is None else (result & allowed)
 
         for t in pod.pod_affinity:

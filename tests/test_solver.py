@@ -1278,3 +1278,88 @@ class TestNodeAffinityOrTerms:
             affinity_terms=[(Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"]),)],
         )
         assert a.constraint_signature() != b.constraint_signature()
+
+
+class TestScheduleAnywaySpread:
+    """ScheduleAnyway topology spread: honored as required until the pod
+    proves unschedulable, then relaxed (karpenter's best-effort semantics,
+    reference scheduling.md:319-331)."""
+
+    def test_soft_spread_balances_when_feasible(self, setup):
+        pool, types = setup
+        sel = (("svc", "soft"),)
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=L.LABEL_ZONE,
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=sel,
+        )
+        pods = [
+            Pod(
+                labels={"svc": "soft"},
+                requests=Resources(cpu=1, memory="2Gi"),
+                topology_spread=[c],
+            )
+            for _ in range(30)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        for res in (tensor, oracle):
+            counts = {}
+            for vn in res.new_nodes:
+                zone = vn.requirements.get(L.LABEL_ZONE).any_value()
+                counts[zone] = counts.get(zone, 0) + len(vn.pods)
+            assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_soft_spread_relaxes_instead_of_failing(self, setup):
+        """Pods restricted to one zone with a soft spread still schedule
+        (the spread would demand zones the selector forbids)."""
+        pool, types = setup
+        sel = (("svc", "soft2"),)
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=L.LABEL_ZONE,
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=sel,
+        )
+        pods = [
+            Pod(
+                labels={"svc": "soft2"},
+                requests=Resources(cpu=1, memory="2Gi"),
+                node_selector={L.LABEL_ZONE: "zone-a"},
+                topology_spread=[c],
+            )
+            for _ in range(9)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert not tensor.unschedulable
+        assert not oracle.unschedulable
+        # everything in zone-a: the spread relaxed rather than blocking
+        for res in (tensor, oracle):
+            for vn in res.new_nodes:
+                assert vn.requirements.get(L.LABEL_ZONE).has("zone-a")
+
+    def test_hard_spread_still_blocks(self, setup):
+        """The same shape with DoNotSchedule keeps its hard semantics."""
+        pool, types = setup
+        sel = (("svc", "hard2"),)
+        c = TopologySpreadConstraint(
+            max_skew=1, topology_key=L.LABEL_ZONE, label_selector=sel
+        )
+        pods = [
+            Pod(
+                labels={"svc": "hard2"},
+                requests=Resources(cpu=1, memory="2Gi"),
+                node_selector={L.LABEL_ZONE: "zone-a"},
+                topology_spread=[c],
+            )
+            for _ in range(9)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        # kube semantics: skew counts only zones the pods can use, so a
+        # one-zone universe... the reference treats domains from the
+        # PROVISIONER's requirements — pods restricted by nodeSelector to
+        # one zone can all land there (skew over candidate domains = 1)
+        # OR be held pending; either way both paths must AGREE
+        assert bool(tensor.unschedulable) == bool(oracle.unschedulable)
