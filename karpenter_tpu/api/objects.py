@@ -413,6 +413,12 @@ class NodePool:
     disruption: Disruption = field(default_factory=Disruption)
     node_class_ref: str = ""
     kubelet_max_pods: Optional[int] = None
+    # kubeletConfiguration overrides (reference provisioner.spec.
+    # kubeletConfiguration -> types.go:326-399): keys present here REPLACE
+    # the computed defaults per resource; absent keys keep the curve
+    kubelet_kube_reserved: Optional[Resources] = None
+    kubelet_system_reserved: Optional[Resources] = None
+    kubelet_eviction_hard: Optional[Resources] = None
     deleted: bool = False
 
     def template_requirements(self) -> Requirements:

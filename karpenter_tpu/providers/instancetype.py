@@ -42,6 +42,17 @@ from karpenter_tpu.providers.subnet import SubnetProvider
 from karpenter_tpu.utils.clock import Clock
 
 
+def _overlay(base: Resources, override) -> Resources:
+    """Per-key override merge: keys present in `override` replace the
+    computed default; absent keys keep it."""
+    if override is None:
+        return base
+    q = {a: v for a, v in base.items()}
+    for a, v in override.items():
+        q[a] = v
+    return Resources(q)
+
+
 def kube_reserved_cpu(cpu_cores: float) -> float:
     """Piecewise kubelet CPU reservation (reference types.go:343-362):
     6% of the first core, 1% of the second, 0.5% of cores 3-4, 0.25% of the
@@ -96,9 +107,19 @@ class InstanceTypeProvider:
         resolved subnets' zones (reference instancetype.go:85-121)."""
         zones = self._zones(node_class)
         max_pods = pool.kubelet_max_pods if pool is not None else None
+        reserved = (
+            (
+                pool.kubelet_kube_reserved,
+                pool.kubelet_system_reserved,
+                pool.kubelet_eviction_hard,
+            )
+            if pool is not None
+            else (None, None, None)
+        )
         key = (
             tuple(sorted(zones)),
             max_pods,
+            tuple(None if r is None else tuple(sorted(r.items())) for r in reserved),
             self.catalog_seq,
             self.unavailable.seq_num,
         )
@@ -112,7 +133,7 @@ class InstanceTypeProvider:
             if z in zones:
                 zones_by_type.setdefault(t, []).append(z)
         out = [
-            self._build(shape, zones_by_type.get(name, []), max_pods)
+            self._build(shape, zones_by_type.get(name, []), max_pods, reserved)
             for name, shape in sorted(shapes.items())
         ]
         self._cache.set(key, out)
@@ -173,19 +194,33 @@ class InstanceTypeProvider:
 
     # ----------------------------------------------------------------- build
     def _build(
-        self, shape: MachineShape, zones: Sequence[str], max_pods_override: Optional[int]
+        self,
+        shape: MachineShape,
+        zones: Sequence[str],
+        max_pods_override: Optional[int],
+        reserved_overrides: tuple = (None, None, None),
     ) -> InstanceType:
         max_pods = (
             max_pods_override if max_pods_override is not None else shape.max_pods
         )
         capacity = self._capacity(shape, max_pods)
+        kube_o, system_o, evict_o = reserved_overrides
         overhead = Overhead(
-            kube_reserved=Resources(
-                cpu=kube_reserved_cpu(shape.cpu),
-                memory=kube_reserved_memory(max_pods),
+            # kubeletConfiguration overrides replace the computed default
+            # PER RESOURCE KEY; absent keys keep the curve (reference
+            # types.go:326-399 merges the provisioner's kubeReserved /
+            # systemReserved / evictionHard the same way)
+            kube_reserved=_overlay(
+                Resources(
+                    cpu=kube_reserved_cpu(shape.cpu),
+                    memory=kube_reserved_memory(max_pods),
+                ),
+                kube_o,
             ),
-            system_reserved=Resources(),
-            eviction_threshold=Resources(memory=100 * 2**20),
+            system_reserved=_overlay(Resources(), system_o),
+            eviction_threshold=_overlay(
+                Resources(memory=100 * 2**20), evict_o
+            ),
         )
         return InstanceType(
             name=shape.name,

@@ -72,3 +72,31 @@ def test_kubelet_max_pods_override(env):
     pool = env.default_node_pool(kubelet_max_pods=42)
     types = env.instance_types.list(pool=pool)
     assert all(t.capacity.get(L.RESOURCE_PODS) == 42 for t in types)
+
+
+class TestKubeletReservedOverrides:
+    def test_kube_reserved_override_replaces_per_key(self, env):
+        """kubeletConfiguration kubeReserved/systemReserved/evictionHard
+        override the computed defaults per resource key (reference
+        types.go:326-399)."""
+        from karpenter_tpu.api import Resources
+
+        nc = env.default_node_class()
+        pool = env.default_node_pool(
+            name="tuned",
+            kubelet_kube_reserved=Resources(cpu=1),
+            kubelet_system_reserved=Resources(memory="256Mi"),
+            kubelet_eviction_hard=Resources(memory="512Mi"),
+        )
+        default_pool = env.default_node_pool(name="plain")
+        tuned = env.instance_types.list(pool, nc)
+        plain = env.instance_types.list(default_pool, nc)
+        t, p = tuned[0], plain[0]
+        assert t.name == p.name
+        # cpu reserve replaced; memory reserve kept from the curve
+        assert t.overhead.kube_reserved.get("cpu") == 1.0
+        assert t.overhead.kube_reserved.get("memory") == p.overhead.kube_reserved.get("memory")
+        assert t.overhead.system_reserved.get("memory") == 256 * 2**20
+        assert t.overhead.eviction_threshold.get("memory") == 512 * 2**20
+        # allocatable shrinks accordingly
+        assert t.allocatable().get("cpu") < p.allocatable().get("cpu")
