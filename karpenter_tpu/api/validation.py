@@ -85,8 +85,28 @@ def validate_node_pool(pool: NodePool) -> None:
                 errs.append(f"invalid budget {b!r}")
     if pool.kubelet_max_pods is not None and pool.kubelet_max_pods <= 0:
         errs.append("kubelet maxPods must be > 0")
+    for fname, res in (
+        ("kubeReserved", pool.kubelet_kube_reserved),
+        ("systemReserved", pool.kubelet_system_reserved),
+        ("evictionHard", pool.kubelet_eviction_hard),
+    ):
+        if res is not None and any(v < 0 for _, v in res.items()):
+            errs.append(f"kubelet {fname} values must be >= 0")
     if errs:
         raise ValidationError(f"NodePool {pool.name!r}: " + "; ".join(errs))
+
+
+VALID_BINDING_MODES = frozenset(["WaitForFirstConsumer", "Immediate"])
+
+
+def validate_storage_class(sc) -> None:
+    errs: List[str] = []
+    if not sc.name:
+        errs.append("name is required")
+    if sc.binding_mode not in VALID_BINDING_MODES:
+        errs.append(f"invalid volumeBindingMode {sc.binding_mode!r}")
+    if errs:
+        raise ValidationError(f"StorageClass {sc.name!r}: " + "; ".join(errs))
 
 
 def default_node_pool(pool: NodePool, legacy_defaults: bool = False) -> NodePool:

@@ -215,6 +215,9 @@ class KubeStore:
         return self.node_classes.get(name)
 
     def put_storage_class(self, sc: StorageClass) -> StorageClass:
+        from karpenter_tpu.api.validation import validate_storage_class
+
+        validate_storage_class(sc)
         self.storage_classes[sc.name] = sc
         self._notify("StorageClass", "put", sc)
         return sc

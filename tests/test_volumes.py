@@ -126,3 +126,13 @@ class TestVolumeTopology:
         env.settle(max_rounds=20)
         assert not env.kube.pending_pods()
         assert _node_zone(env, pods[-1].key()) == "zone-c"
+
+
+class TestStorageClassValidation:
+    def test_invalid_binding_mode_rejected(self, env):
+        from karpenter_tpu.api.validation import ValidationError
+
+        with pytest.raises(ValidationError):
+            env.kube.put_storage_class(
+                StorageClass(name="bad", binding_mode="Sometimes")
+            )
