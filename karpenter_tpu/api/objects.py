@@ -70,15 +70,48 @@ def tolerates_all(tolerations: Sequence[Toleration], taints: Sequence[Taint]) ->
 # ---------------------------------------------------------------------------
 
 
+def _expr_matches(labels: Mapping[str, str], expr: Tuple) -> bool:
+    """One matchExpressions entry — (key, operator, values) with kube's
+    label-selector operators (In/NotIn/Exists/DoesNotExist)."""
+    key, op, values = expr
+    v = labels.get(key)
+    if op == "In":
+        return v is not None and v in values
+    if op == "NotIn":
+        return v is None or v not in values
+    if op == "Exists":
+        return v is not None
+    if op == "DoesNotExist":
+        return v is None
+    raise ValueError(f"unknown selector operator {op!r}")
+
+
+def selector_matches(
+    labels: Mapping[str, str],
+    match_labels: Tuple[Tuple[str, str], ...],
+    match_expressions: Tuple[Tuple, ...] = (),
+) -> bool:
+    """Full kube label-selector semantics: matchLabels AND every
+    matchExpressions entry (reference scheduling.md:360-373 uses
+    matchExpressions selectors for pod affinity)."""
+    return all(labels.get(k) == v for k, v in match_labels) and all(
+        _expr_matches(labels, e) for e in match_expressions
+    )
+
+
 @dataclass(frozen=True)
 class TopologySpreadConstraint:
     max_skew: int
     topology_key: str
     when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
     label_selector: Tuple[Tuple[str, str], ...] = ()  # matchLabels, sorted
+    # (key, operator, values) triples; operator: In/NotIn/Exists/DoesNotExist
+    match_expressions: Tuple[Tuple, ...] = ()
 
     def selects(self, pod: "Pod") -> bool:
-        return all(pod.labels.get(k) == v for k, v in self.label_selector)
+        return selector_matches(
+            pod.labels, self.label_selector, self.match_expressions
+        )
 
 
 @dataclass(frozen=True)
@@ -89,11 +122,15 @@ class PodAffinityTerm:
     label_selector: Tuple[Tuple[str, str], ...] = ()  # matchLabels, sorted
     anti: bool = False
     namespaces: Tuple[str, ...] = ()
+    # (key, operator, values) triples; operator: In/NotIn/Exists/DoesNotExist
+    match_expressions: Tuple[Tuple, ...] = ()
 
     def selects(self, pod: "Pod") -> bool:
         if self.namespaces and pod.namespace not in self.namespaces:
             return False
-        return all(pod.labels.get(k) == v for k, v in self.label_selector)
+        return selector_matches(
+            pod.labels, self.label_selector, self.match_expressions
+        )
 
 
 _pod_seq = itertools.count()

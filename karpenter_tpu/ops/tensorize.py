@@ -60,7 +60,7 @@ from karpenter_tpu.api import (
     Resources,
 )
 from karpenter_tpu.api import labels as L
-from karpenter_tpu.api.objects import tolerates_all
+from karpenter_tpu.api.objects import selector_matches, tolerates_all
 from karpenter_tpu.api.requirements import Op
 from karpenter_tpu.state.cluster import StateNode
 
@@ -443,7 +443,7 @@ def _coloc_component_mergeable(
     comp: Sequence[int],
     sig_rep: Sequence[Pod],
     reasons: Sequence[str],
-    live_label_sets: Sequence[frozenset],
+    live_labels: Sequence[dict],
 ) -> bool:
     """Whether a hostname-affinity coupled component compiles as ONE macro
     placement unit: every sig carries only hostname-affinity terms, all
@@ -477,9 +477,9 @@ def _coloc_component_mergeable(
         for t in sig_rep[s].pod_affinity:
             if not any(t.selects(sig_rep[j]) for j in comp):
                 return False
-            if live_label_sets and any(
-                frozenset(t.label_selector) <= pairs
-                for pairs in live_label_sets
+            if live_labels and any(
+                selector_matches(lbl, t.label_selector, t.match_expressions)
+                for lbl in live_labels
             ):
                 return False
     return True
@@ -536,13 +536,24 @@ def partition_groups(
         sig_of.append(s)
     m = len(sig_rep)
     reasons = [class_unsupported_reason(r) for r in sig_rep]
-    # built ONCE for the live-member checks below: a selector term is a
-    # label conjunction, so frozenset subset over each live pod's label
-    # items is exact and C-speed (vs a per-signature Python rescan of
-    # every live pod)
-    live_label_sets = [
-        frozenset(bp.labels.items()) for sn in existing for bp in sn.pods
+    # built ONCE for the live-member checks below
+    live_labels = [dict(bp.labels) for sn in existing for bp in sn.pods]
+    # symmetric anti-affinity from LIVE carriers: a bound pod's anti term
+    # repels incoming matching pods from its node — only the oracle's
+    # per-node ban sets express that, so any selected class goes oracle
+    live_anti = [
+        t
+        for sn in existing
+        for bp in sn.pods
+        for t in bp.pod_affinity
+        if t.anti
     ]
+    if live_anti:
+        for i, r in enumerate(sig_rep):
+            if any(t.selects(r) for t in live_anti):
+                reasons[i] = reasons[i] or (
+                    "repelled by a live pod's anti-affinity"
+                )
     sel_idx = [
         i for i, r in enumerate(sig_rep) if r.pod_affinity or r.topology_spread
     ]
@@ -571,11 +582,22 @@ def partition_groups(
             out = set(hit) if out is None else (out & hit)
             if not out:
                 break
+        # In-expressions narrow too (union of their value pairs); other
+        # operators can't narrow and rely on the verify pass below
+        if out is not _no_sigs:
+            for expr in getattr(sel, "match_expressions", ()):
+                if expr[1] != "In":
+                    continue
+                hit = set()
+                for v in expr[2]:
+                    hit |= pair_index.get((expr[0], v), _no_sigs)
+                out = hit if out is None else (out & hit)
+                if not out:
+                    break
         if out is None:
             out = set(range(m))
-        ns = getattr(sel, "namespaces", ())
-        if ns:
-            out = {j for j in out if sig_rep[j].namespace in ns}
+        # full-selector verify: expressions and namespaces are exact here
+        out = {j for j in out if sel.selects(sig_rep[j])}
         _match_memo[id(sel)] = got = frozenset(out)
         return got
 
@@ -624,10 +646,10 @@ def partition_groups(
                         why = "hostname co-location coupling distinct pod classes"
                         reasons[i] = reasons[i] or why
                         reasons[j] = reasons[j] or why
-            if live_label_sets and any(
-                frozenset(t.label_selector) <= pairs
+            if live_labels and any(
+                selector_matches(lbl, t.label_selector, t.match_expressions)
                 for t in host_aff_terms
-                for pairs in live_label_sets
+                for lbl in live_labels
             ):
                 reasons[i] = reasons[i] or (
                     "hostname co-location with members on live nodes"
@@ -731,7 +753,7 @@ def partition_groups(
             for t in sig_rep[s].pod_affinity
         ):
             continue
-        if _coloc_component_mergeable(comp, sig_rep, reasons, live_label_sets):
+        if _coloc_component_mergeable(comp, sig_rep, reasons, live_labels):
             for s in comp:
                 if reasons[s] in _HOST_CURABLE:
                     reasons[s] = ""
@@ -828,15 +850,14 @@ def _track_key(pod: Pod) -> Tuple:
     with several terms gets one OR-counter — exact for anti-affinity
     (any match bans), conservative for hostname spread."""
     sels = {
-        ("a", t.label_selector, t.namespaces)
+        ("a", t.label_selector, t.match_expressions, t.namespaces)
         for t in pod.pod_affinity
         if t.anti and t.topology_key == L.LABEL_HOSTNAME
     } | {
-        ("s", c.label_selector)
+        ("s", c.label_selector, c.match_expressions)
         for c in pod.topology_spread
-        if c.topology_key == L.LABEL_HOSTNAME
-        and c.selects(pod)
-            }
+        if c.topology_key == L.LABEL_HOSTNAME and c.selects(pod)
+    }
     return tuple(sorted(sels))
 
 
@@ -845,10 +866,10 @@ def _track_matches(key: Tuple, pod: Pod) -> bool:
     the slot's fingerprint matches its labels (kube counts label matches,
     whether or not the bound pod carries the constraint itself)."""
     for entry in key:
-        sel = entry[1]
-        if entry[0] == "a" and entry[2] and pod.namespace not in entry[2]:
+        sel, exprs = entry[1], entry[2]
+        if entry[0] == "a" and entry[3] and pod.namespace not in entry[3]:
             continue
-        if all(pod.labels.get(k) == v for k, v in sel):
+        if selector_matches(pod.labels, sel, exprs):
             return True
     return False
 
@@ -1111,7 +1132,11 @@ def compile_problem(
             # seed with bound pods the constraint's SELECTOR matches (the
             # oracle replays placements the same way, topology.py:91-93)
             # plus the shares sibling classes of this group already took
-            selkey = (tuple(sorted(c0.label_selector)), c0.max_skew)
+            selkey = (
+                tuple(sorted(c0.label_selector)),
+                c0.match_expressions,
+                c0.max_skew,
+            )
             assigned = spread_assigned.setdefault(selkey, {})
             zcounts = {z: assigned.get(z, 0) for z in split_zones}
             all_counts = {z: assigned.get(z, 0) for z in cand_zones}

@@ -21,6 +21,7 @@ import numpy as np
 
 from karpenter_tpu.api import InstanceType, NodePool, Pod, Requirement
 from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import selector_matches
 from karpenter_tpu.api.requirements import Op
 from karpenter_tpu.api.resources import Resources
 from karpenter_tpu.ops.pallas_packer import auto_pack
@@ -184,10 +185,11 @@ class TensorScheduler:
                 result = self._oracle_continue(
                     relax, others, result, seed_topology=True
                 )
-        # an EMPTY label selector matches every pod, including unlabeled
-        # ones — with one in the batch, no pod is safely untracked
+        # a selector that matches UNLABELED pods (empty matchLabels, or
+        # only negative expressions) leaves no pod safely untracked —
+        # with one in the batch, skip compaction
         if not any(
-            (not c.label_selector)
+            selector_matches({}, c.label_selector, c.match_expressions)
             for p in pods
             for c in (*p.topology_spread, *p.pod_affinity)
         ):
@@ -250,7 +252,10 @@ class TensorScheduler:
         for o in result.new_nodes:
             scratch.universe.setdefault(HOSTNAME, set()).add(o.name)
             for p in o.pods:
-                if p.labels:
+                # labeled pods feed ban/selection sets; UNLABELED carriers
+                # must record too — their anti term's carrier_domains ban
+                # is what keeps a moved matcher off their node
+                if p.labels or p.pod_affinity:
                     scratch.record(p, {HOSTNAME: o.name})
         for vn in donors:
             targets = [

@@ -67,6 +67,10 @@ class _AffinityGroup:
 
     term: PodAffinityTerm
     domains: Set[str] = field(default_factory=set)
+    # domains holding pods that CARRY this (anti-)term: kube anti-affinity
+    # is SYMMETRIC — an existing carrier repels incoming pods its selector
+    # matches, whether or not they carry any term themselves
+    carrier_domains: Set[str] = field(default_factory=set)
 
 
 class TopologyTracker:
@@ -113,7 +117,8 @@ class TopologyTracker:
 
     # -- group creation (lazy, replaying history) ----------------------------
     def _spread_group(self, c: TopologySpreadConstraint) -> _SpreadGroup:
-        key = ("s", c.topology_key, _selector_key(c.label_selector), c.max_skew)
+        key = ("s", c.topology_key, _selector_key(c.label_selector),
+               c.match_expressions, c.max_skew)
         g = self._spread.get(key)
         if g is None:
             g = _SpreadGroup(c)
@@ -125,7 +130,8 @@ class TopologyTracker:
         return g
 
     def _affinity_group(self, t: PodAffinityTerm) -> _AffinityGroup:
-        key = ("a", t.topology_key, _selector_key(t.label_selector), t.namespaces)
+        key = ("a", t.topology_key, _selector_key(t.label_selector),
+               t.match_expressions, t.namespaces)
         g = self._affinity.get(key)
         if g is None:
             g = _AffinityGroup(t)
@@ -189,6 +195,24 @@ class TopologyTracker:
                     result = set(g.domains) if result is None else (result & g.domains)
                 # else: no matching pod anywhere yet — first pod anchors the
                 # domain, unconstrained on this term.
+
+        # symmetric anti-affinity: domains holding a CARRIER whose selector
+        # matches this pod are banned even when the pod carries no term
+        banned: Set[str] = set()
+        for g in self._candidate_groups(pod):
+            if (
+                isinstance(g, _AffinityGroup)
+                and g.term.anti
+                and g.term.topology_key == key
+                and g.carrier_domains
+                and g.term.selects(pod)
+            ):
+                banned |= g.carrier_domains
+        if banned:
+            cand = (self.universe.get(key, set()) - banned) | (
+                {NEW_DOMAIN} if allow_new else set()
+            )
+            result = cand if result is None else (result - banned)
         return result
 
     def selected_by_group(self, pod: Pod, key: str) -> bool:
@@ -247,3 +271,11 @@ class TopologyTracker:
                 t = g.term
                 if t.selects(pod) and t.topology_key in domains:
                     g.domains.add(domains[t.topology_key])
+        # symmetric anti-affinity: a recorded CARRIER's domain repels
+        # future matching pods — materialize the carrier's group now (a
+        # seeded bound pod never queries for itself) and mark its domain
+        for t in pod.pod_affinity:
+            if t.anti and t.topology_key in domains:
+                self._affinity_group(t).carrier_domains.add(
+                    domains[t.topology_key]
+                )

@@ -1363,3 +1363,142 @@ class TestScheduleAnywaySpread:
         # one zone can all land there (skew over candidate domains = 1)
         # OR be held pending; either way both paths must AGREE
         assert bool(tensor.unschedulable) == bool(oracle.unschedulable)
+
+
+class TestMatchExpressions:
+    """Kube label-selector matchExpressions (In/NotIn/Exists) on pod
+    affinity and topology spread (reference scheduling.md:360-373)."""
+
+    def test_in_expression_coloc_compiles(self, setup):
+        pool, types = setup
+        term = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME,
+            match_expressions=(("tier", "In", ("db", "cache")),),
+        )
+        pods = [
+            Pod(
+                labels={"tier": ("db" if i % 2 else "cache")},
+                requests=Resources(cpu=1, memory="2Gi"),
+                pod_affinity=[term],
+            )
+            for i in range(4)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        nodes = {vn.name for vn in tensor.new_nodes for p in vn.pods}
+        assert len(nodes) == 1  # one co-located unit
+
+    def test_notin_anti_affinity_oracle_exact(self, setup):
+        """NotIn selects pods MISSING the label too — only the oracle's
+        runtime sets can express that; routing must stay correct."""
+        from karpenter_tpu.ops.tensorize import partition_groups
+
+        pool, types = setup
+        anti = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME,
+            match_expressions=(("safe", "NotIn", ("yes",)),),
+            anti=True,
+        )
+        carrier = Pod(
+            labels={"app": "x"}, requests=Resources(cpu=1), pod_affinity=[anti]
+        )
+        plain = [Pod(requests=Resources(cpu=1)) for _ in range(3)]
+        sup, unsup, why = partition_groups([carrier] + plain)
+        # the selector reaches the plain (unlabeled) class: everything
+        # coupled goes oracle
+        assert len(unsup) == 4
+        oracle, tensor, ts = both(pool, types, [carrier] + plain)
+        assert ts.last_path == "oracle"
+        assert not tensor.unschedulable
+        # the carrier must not share a node with anything it selects
+        for vn in tensor.new_nodes:
+            keys = {p.key() for p in vn.pods}
+            if carrier.key() in keys:
+                assert len(keys) == 1
+
+    def test_exists_spread_balances(self, setup):
+        pool, types = setup
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=L.LABEL_ZONE,
+            match_expressions=(("svc", "Exists", ()),),
+        )
+        pods = [
+            Pod(
+                labels={"svc": f"v{i % 3}"},
+                requests=Resources(cpu=1, memory="2Gi"),
+                topology_spread=[c],
+            )
+            for i in range(18)
+        ]
+        oracle, tensor, ts = both(pool, types, pods)
+        assert not tensor.unschedulable
+        counts = {}
+        for vn in tensor.new_nodes:
+            zone = vn.requirements.get(L.LABEL_ZONE).any_value()
+            counts[zone] = counts.get(zone, 0) + len(vn.pods)
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_live_carrier_repels_incoming_matchers(self, setup):
+        """Symmetric anti-affinity: a BOUND pod carrying an anti term
+        repels incoming pods its selector matches, even though they carry
+        no term themselves."""
+        from karpenter_tpu.state.cluster import StateNode
+
+        pool, types = setup
+        carrier = Pod(
+            labels={"lonely": "true"},
+            requests=Resources(cpu=1),
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=L.LABEL_HOSTNAME,
+                    label_selector=(("team", "a"),),
+                    anti=True,
+                )
+            ],
+        )
+        live = StateNode(
+            name="live-sym",
+            provider_id="fake://live-sym",
+            labels={L.LABEL_ZONE: "zone-a"},
+            taints=[],
+            allocatable=Resources(cpu=64, memory="256Gi", pods=110),
+            pods=[carrier],
+            used=Resources(cpu=1),
+        )
+        incoming = [
+            Pod(labels={"team": "a"}, requests=Resources(cpu=0.5, memory="1Gi"))
+            for _ in range(2)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types}, existing=[live])
+        res = ts.solve(incoming)
+        assert ts.last_path == "oracle"  # live carrier routes to the oracle
+        assert not res.unschedulable
+        # neither matching pod may join the carrier's node
+        assert not res.existing_placements
+        assert res.node_count() >= 1
+
+    def test_compaction_respects_unlabeled_carrier(self, setup):
+        """The decode compaction pass must not move a selector-matched pod
+        onto an UNLABELED anti-affinity carrier's node."""
+        pool, types = setup
+        carrier = Pod(
+            requests=Resources(cpu=8),
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=L.LABEL_HOSTNAME,
+                    label_selector=(("team", "a"),),
+                    anti=True,
+                )
+            ],
+        )
+        matcher = Pod(labels={"team": "a"}, requests=Resources(cpu=0.5))
+        fillers = [Pod(requests=Resources(cpu=8)) for _ in range(2)]
+        ts = TensorScheduler([pool], {pool.name: types})
+        res = ts.solve([carrier, matcher] + fillers)
+        assert not res.unschedulable
+        for vn in res.new_nodes:
+            keys = {p.key() for p in vn.pods}
+            if carrier.key() in keys:
+                assert matcher.key() not in keys
