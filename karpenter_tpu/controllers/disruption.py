@@ -440,12 +440,35 @@ class DisruptionController:
 
     def _drift(self, candidates: Sequence[Candidate]) -> bool:
         for c in candidates:
-            reason = self.cloud_provider.is_drifted(c.claim)
+            reason = self.cloud_provider.is_drifted(
+                c.claim
+            ) or self._pool_template_drift(c)
             if reason:
                 c.claim.set_condition("Drifted")
                 if self._disrupt(c, f"drifted/{reason}"):
                     return True
         return False
+
+    @staticmethod
+    def _pool_template_drift(c: Candidate) -> str:
+        """Core-side drift: the claim no longer matches its pool's CURRENT
+        template (karpenter-core's requirements/static drift — a pool whose
+        requirements or taints changed rolls its nodes)."""
+        from karpenter_tpu.api.requirements import Requirements
+
+        pool = c.pool
+        if pool is None:
+            return ""
+        claim_reqs = Requirements.from_labels(c.claim.labels)
+        if not claim_reqs.compatible(pool.template_requirements()):
+            return "requirements"
+        def taint_key(t):
+            return (t.key, t.value, t.effect)
+        if {taint_key(t) for t in c.claim.taints} != {
+            taint_key(t) for t in pool.taints
+        }:
+            return "taints"
+        return ""
 
     def _emptiness(self, candidates: Sequence[Candidate]) -> bool:
         """WhenEmpty pools: delete nodes quiet for consolidate_after

@@ -478,3 +478,51 @@ class TestDisruption:
             1 for c in env.kube.node_claims.values() if c.deleted_at is not None
         )
         assert disrupting <= 1
+
+
+class TestPoolTemplateDrift:
+    def test_requirements_change_rolls_nodes(self):
+        """Narrowing a pool's zone requirement drifts nodes outside it
+        (karpenter-core requirements drift) and replacements land inside."""
+        from karpenter_tpu.api import Requirement, Requirements
+        from karpenter_tpu.api import labels as L
+        from karpenter_tpu.api.requirements import Op
+
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool(
+            requirements=Requirements(
+                [Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"])]
+            )
+        )
+        pods = [Pod(requests=Resources(cpu=2, memory="4Gi")) for _ in range(6)]
+        for p in pods:
+            env.kube.put_pod(p)
+        env.settle()
+        assert not env.kube.pending_pods()
+        before = set(env.kube.node_claims)
+        assert all(
+            c.zone == "zone-b" for c in env.kube.node_claims.values()
+        )
+        # narrow the pool to zone-c: every zone-b node is now drifted
+        pool = env.kube.node_pools["default"]
+        pool.requirements = Requirements(
+            [Requirement(L.LABEL_ZONE, Op.IN, ["zone-c"])]
+        )
+        for _ in range(60):
+            env.clock.step(30)
+            env.step(2.0)
+            live = [
+                c
+                for c in env.kube.node_claims.values()
+                if c.zone == "zone-c"
+            ]
+            if (
+                not env.kube.pending_pods()
+                and live
+                and not (set(env.kube.node_claims) & before)
+            ):
+                break
+        assert not env.kube.pending_pods()
+        assert not (set(env.kube.node_claims) & before)
+        assert all(c.zone == "zone-c" for c in env.kube.node_claims.values())
