@@ -450,6 +450,9 @@ class NodePool:
     disruption: Disruption = field(default_factory=Disruption)
     node_class_ref: str = ""
     kubelet_max_pods: Optional[int] = None
+    # dynamic pod density: pods capacity = min(maxPods/ENI limit,
+    # podsPerCore x vCPUs) (reference pod-density.md:43)
+    kubelet_pods_per_core: Optional[int] = None
     # kubeletConfiguration overrides (reference provisioner.spec.
     # kubeletConfiguration -> types.go:326-399): keys present here REPLACE
     # the computed defaults per resource; absent keys keep the curve

@@ -107,6 +107,7 @@ class InstanceTypeProvider:
         resolved subnets' zones (reference instancetype.go:85-121)."""
         zones = self._zones(node_class)
         max_pods = pool.kubelet_max_pods if pool is not None else None
+        pods_per_core = pool.kubelet_pods_per_core if pool is not None else None
         reserved = (
             (
                 pool.kubelet_kube_reserved,
@@ -119,6 +120,7 @@ class InstanceTypeProvider:
         key = (
             tuple(sorted(zones)),
             max_pods,
+            pods_per_core,
             tuple(None if r is None else tuple(sorted(r.items())) for r in reserved),
             self.catalog_seq,
             self.unavailable.seq_num,
@@ -133,7 +135,10 @@ class InstanceTypeProvider:
             if z in zones:
                 zones_by_type.setdefault(t, []).append(z)
         out = [
-            self._build(shape, zones_by_type.get(name, []), max_pods, reserved)
+            self._build(
+                shape, zones_by_type.get(name, []), max_pods, reserved,
+                pods_per_core,
+            )
             for name, shape in sorted(shapes.items())
         ]
         self._cache.set(key, out)
@@ -199,10 +204,15 @@ class InstanceTypeProvider:
         zones: Sequence[str],
         max_pods_override: Optional[int],
         reserved_overrides: tuple = (None, None, None),
+        pods_per_core: Optional[int] = None,
     ) -> InstanceType:
         max_pods = (
             max_pods_override if max_pods_override is not None else shape.max_pods
         )
+        if pods_per_core:
+            # dynamic pod density (reference pod-density.md:43): density
+            # scales with the instance's logical cores, capped by maxPods
+            max_pods = min(max_pods, int(pods_per_core * shape.cpu))
         capacity = self._capacity(shape, max_pods)
         kube_o, system_o, evict_o = reserved_overrides
         overhead = Overhead(

@@ -100,3 +100,17 @@ class TestKubeletReservedOverrides:
         assert t.overhead.eviction_threshold.get("memory") == 512 * 2**20
         # allocatable shrinks accordingly
         assert t.allocatable().get("cpu") < p.allocatable().get("cpu")
+
+    def test_pods_per_core_caps_density(self, env):
+        """podsPerCore scales pod capacity with vCPUs, capped by maxPods
+        (reference pod-density.md:43)."""
+        nc = env.default_node_class()
+        pool = env.default_node_pool(name="dense", kubelet_pods_per_core=2)
+        types = env.instance_types.list(pool, nc)
+        from karpenter_tpu.api import labels as L
+
+        for t in types:
+            cpu = t.capacity.get("cpu")
+            assert t.capacity.get(L.RESOURCE_PODS) <= max(2 * cpu, 1)
+        small = min(types, key=lambda t: t.capacity.get("cpu"))
+        assert small.capacity.get(L.RESOURCE_PODS) == 2 * small.capacity.get("cpu")
