@@ -78,3 +78,84 @@ def test_random_cluster_consolidation_convergence(seed):
     for n in live:
         if n.name in used:
             assert used[n.name].fits(n.allocatable), (seed, n.name)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_constrained_cluster_consolidation_invariants(seed):
+    """Repacks must preserve co-location, anti-affinity, and volume zone
+    pins while shrinking the fleet."""
+    from karpenter_tpu.api import (
+        PersistentVolumeClaim,
+        Requirement,
+        StorageClass,
+    )
+    from karpenter_tpu.api import labels as L
+    from karpenter_tpu.api.objects import PodAffinityTerm
+
+    env = Environment()
+    env.default_node_class()
+    env.default_node_pool(
+        disruption=Disruption(consolidation_policy="WhenUnderutilized")
+    )
+    env.kube.put_storage_class(
+        StorageClass(name="zonal", zones=("zone-b",), binding_mode="Immediate")
+    )
+    env.kube.put_pvc(PersistentVolumeClaim(name="vol", storage_class="zonal"))
+    rng = random.Random(3000 + seed)
+    pods = [Pod(requests=rng.choice(SIZES)) for _ in range(rng.randint(60, 140))]
+    # co-location group
+    term = PodAffinityTerm(
+        topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "g"),)
+    )
+    coloc = [
+        Pod(labels={"pair": "g"}, requests=SIZES[1], pod_affinity=[term])
+        for _ in range(3)
+    ]
+    # anti-affinity singletons
+    solo = [
+        Pod(
+            labels={"app": "solo"},
+            requests=SIZES[0],
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=L.LABEL_HOSTNAME,
+                    label_selector=(("app", "solo"),),
+                    anti=True,
+                )
+            ],
+        )
+        for _ in range(rng.randint(2, 5))
+    ]
+    vol_pod = Pod(requests=SIZES[1], volume_claims=["vol"])
+    all_pods = pods + coloc + solo + [vol_pod]
+    for p in all_pods:
+        env.kube.put_pod(p)
+    env.settle(max_rounds=50)
+    assert not env.kube.pending_pods(), seed
+    n0 = len(_live_nodes(env))
+
+    def check_invariants(tag):
+        bound = {p.key(): p for p in env.kube.pods.values() if p.node_name}
+        coloc_nodes = {
+            bound[p.key()].node_name for p in coloc if p.key() in bound
+        }
+        assert len(coloc_nodes) <= 1, (seed, tag, coloc_nodes)
+        solo_nodes = [
+            bound[p.key()].node_name for p in solo if p.key() in bound
+        ]
+        assert len(solo_nodes) == len(set(solo_nodes)), (seed, tag)
+        if vol_pod.key() in bound:
+            node = env.kube.nodes[bound[vol_pod.key()].node_name]
+            assert node.labels[L.LABEL_ZONE] == "zone-b", (seed, tag)
+
+    check_invariants("after scale-up")
+    # shrink the plain load
+    for p in rng.sample(pods, int(len(pods) * 0.6)):
+        env.kube.delete_pod(p.key())
+    for _ in range(40):
+        env.clock.step(65)
+        env.step(2.0)
+    env.settle(max_rounds=20)
+    assert not env.kube.pending_pods(), seed
+    assert len(_live_nodes(env)) <= n0, seed
+    check_invariants("after consolidation")
