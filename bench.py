@@ -15,8 +15,9 @@ Configs (BASELINE.md "Benchmark configs to reproduce"):
    ``DisruptionController._simulate`` (the scheduling simulation the
    deprovisioner runs per candidate set).
 5. multi-pool weighted priority + spot price-aware selection.
-6. (extra) hybrid split cost: 9.5k tensor pods + 500 oracle-only pods in
-   one batch — the mixed-path price of ops/tensorize.py:partition_pods.
+6. (extra) hybrid split cost: 9.5k tensor pods + 500 oracle-only pods
+   (preference-differing co-location closures) in one batch — the
+   mixed-path price of ops/tensorize.py:partition_pods.
 7. (extra) the flagship through the solver sidecar (socket RPC) — the
    distributed-backend boundary's overhead (SURVEY.md §5).
 
@@ -285,16 +286,19 @@ def build_affinity_topology():
     return [pool], {pool.name: types}, pods
 
 
-def _coloc_pods(cross_class: bool, node_equiv: bool = True):
-    """100 hostname co-location groups x 5 pods.  Self-selecting groups and
-    NODE-EQUIVALENT cross-class closures both compile to the tensor path
-    (macro placement units, ops/tensorize.py:_coloc_component_mergeable);
-    making the variant class node-INEQUIVALENT (a toleration only it
-    carries) defeats the closure merge, so only the oracle understands the
-    group — the hybrid-split stressor."""
-    from karpenter_tpu.api import Pod, Resources, Toleration
+def _coloc_pods(cross_class: bool, node_equiv: bool = True, prefer: bool = False):
+    """100 hostname co-location groups x 5 pods.  Self-selecting groups,
+    NODE-EQUIVALENT cross-class closures, and node-INEQUIVALENT closures
+    (a toleration only one variant carries — cured by the ANDed
+    feasibility-row merge) all compile to the tensor path
+    (ops/tensorize.py:_coloc_component_mergeable).  ``prefer`` makes one
+    variant carry a PREFERRED zone affinity the other lacks: relax
+    cohesion breaks, the merge refuses, and only the oracle understands
+    the group — the hybrid-split stressor."""
+    from karpenter_tpu.api import Pod, Requirement, Resources, Toleration
     from karpenter_tpu.api import labels as L
     from karpenter_tpu.api.objects import PodAffinityTerm
+    from karpenter_tpu.api.requirements import Op
 
     pods = []
     for g in range(100):
@@ -310,6 +314,10 @@ def _coloc_pods(cross_class: bool, node_equiv: bool = True):
                     kw["tolerations"] = [
                         Toleration(key="burst", value="yes", effect="NoSchedule")
                     ]
+                if prefer and i % 2:
+                    kw["preferred_affinity"] = [
+                        Requirement(L.LABEL_ZONE, Op.IN, [ZONES[g % len(ZONES)]])
+                    ]
             pods.append(
                 Pod(
                     labels=labels,
@@ -321,7 +329,7 @@ def _coloc_pods(cross_class: bool, node_equiv: bool = True):
     return pods
 
 
-def _coloc_problem(cross_class: bool, node_equiv: bool = True):
+def _coloc_problem(cross_class: bool, node_equiv: bool = True, prefer: bool = False):
     """9.5k plain pods + the 500 co-location pods: ONE base problem so the
     hybrid and tensor variants measure the same workload."""
     from karpenter_tpu.api import Pod, Resources
@@ -333,16 +341,19 @@ def _coloc_problem(cross_class: bool, node_equiv: bool = True):
         Resources(cpu=2, memory="4Gi"),
     ]
     pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(9_500)]
-    pods += _coloc_pods(cross_class=cross_class, node_equiv=node_equiv)
+    pods += _coloc_pods(cross_class=cross_class, node_equiv=node_equiv, prefer=prefer)
     return [pool], {pool.name: types}, pods
 
 
 def build_hybrid():
-    """Extra: the hybrid-split cost — the co-location closures are
-    node-INEQUIVALENT (a toleration on one variant), which only the oracle
-    understands.  partition_groups sends just their closure to the Python
-    oracle, seeded with the tensor half's placements."""
-    return _coloc_problem(cross_class=True, node_equiv=False)
+    """Extra: the hybrid-split cost — one variant of each closure carries
+    a preferred zone affinity the other lacks, so the closure merge
+    refuses (relax cohesion) and partition_groups sends just their
+    closures to the Python oracle, seeded with the tensor half's
+    placements.  Gang-aware anchoring (scheduler.py:solve) keeps the
+    oracle from stranding followers, so ZERO unplaced pods are
+    tolerated."""
+    return _coloc_problem(cross_class=True, prefer=True)
 
 
 def build_coloc_tensor():
@@ -357,6 +368,13 @@ def build_crossclass_coloc():
     under one selector, same node constraints) — oracle-only before the
     closure merge, now a compiled macro unit per group."""
     return _coloc_problem(cross_class=True, node_equiv=True)
+
+
+def build_inequiv_coloc():
+    """Extra: node-INEQUIVALENT closures (a toleration on one variant) —
+    the shape that was the round-4 hybrid stressor, now compiled exactly
+    as macro units whose feasibility row is the AND of the members'."""
+    return _coloc_problem(cross_class=True, node_equiv=False)
 
 
 def build_multipool_spot():
@@ -507,12 +525,13 @@ def main() -> None:
         "schedule_10k_multipool_weighted_spot_p50", pools, inventory, pods
     )
 
-    # required hostname co-location can strand a straggler on a full node
-    # (the oracle is as greedy as kube-scheduler here) — tolerate a few
+    # gang-aware anchoring means the oracle continuation never strands a
+    # co-location follower when a node that fits the group exists: zero
+    # unplaced tolerated
     pools, inventory, pods = build_hybrid()
     _run_scheduler_config(
         "schedule_10k_hybrid_500_oracle_pods_p50",
-        pools, inventory, pods, expect_path="hybrid", allow_unplaced=25,
+        pools, inventory, pods, expect_path="hybrid",
     )
 
     pools, inventory, pods = build_coloc_tensor()
@@ -524,6 +543,14 @@ def main() -> None:
     pools, inventory, pods = build_crossclass_coloc()
     _run_scheduler_config(
         "schedule_10k_crossclass_coloc_tensor_p50",
+        pools, inventory, pods, expect_path="tensor",
+    )
+
+    # the round-4 hybrid stressor (node-inequivalent closures), now
+    # compiled: same 10k-pod workload, pure tensor path
+    pools, inventory, pods = build_inequiv_coloc()
+    _run_scheduler_config(
+        "schedule_10k_inequiv_coloc_tensor_p50",
         pools, inventory, pods, expect_path="tensor",
     )
 

@@ -72,7 +72,11 @@ def tolerates_all(tolerations: Sequence[Toleration], taints: Sequence[Taint]) ->
 
 def _expr_matches(labels: Mapping[str, str], expr: Tuple) -> bool:
     """One matchExpressions entry — (key, operator, values) with kube's
-    label-selector operators (In/NotIn/Exists/DoesNotExist)."""
+    label-selector operators (In/NotIn/Exists/DoesNotExist).
+
+    An unknown operator makes the selector INVALID, and kube's contract
+    for an invalid selector is to match nothing — returning False keeps
+    one malformed pod spec from throwing inside the scheduling loop."""
     key, op, values = expr
     v = labels.get(key)
     if op == "In":
@@ -83,7 +87,7 @@ def _expr_matches(labels: Mapping[str, str], expr: Tuple) -> bool:
         return v is not None
     if op == "DoesNotExist":
         return v is None
-    raise ValueError(f"unknown selector operator {op!r}")
+    return False
 
 
 def selector_matches(

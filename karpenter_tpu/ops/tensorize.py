@@ -32,16 +32,18 @@ selectors/affinity (first OR-term; preferences compiled as required),
 volume-derived zone requirements, taints/tolerations, zonal offerings,
 capacity types, hostname anti-affinity — self-selecting AND mutual
 cross-class (shared `_track_key` counter slots), hostname co-location —
-self-selecting AND node-equivalent cross-class closures (macro units),
-hostname topology spread (max `maxSkew` per node while any empty node
-exists — exact in the scale-out regime), zone topology spread — incl.
-mutual cross-class, split across allowed zones against the shared
-per-group accumulator — and zone-keyed pod affinity (compile-time domain
-anchoring).  Anything else — one-sided cross-class couplings,
-node-inequivalent closures, zone-affinity+spread combos, exotic topology
-keys, live-member co-location — is reported via ``unsupported_reason``
-and routed to the pure-Python oracle (scheduling/scheduler.py), whole or
-as the hybrid continuation of a split batch.
+self-selecting AND cross-class closures (macro units; node-INEQUIVALENT
+members compile via ANDed feasibility rows — the group's feasible set is
+the intersection of its members'), hostname topology spread (max
+`maxSkew` per node while any empty node exists — exact in the scale-out
+regime), zone topology spread — incl. mutual cross-class, split across
+allowed zones against the shared per-group accumulator — and zone-keyed
+pod affinity (compile-time domain anchoring).  Anything else — one-sided
+cross-class couplings, zone-affinity+spread combos, exotic topology
+keys, live-member co-location, closures whose members differ in
+preferences/OR-terms — is reported via ``unsupported_reason`` and routed
+to the pure-Python oracle (scheduling/scheduler.py), whole or as the
+hybrid continuation of a split batch.
 """
 
 from __future__ import annotations
@@ -446,13 +448,22 @@ def _coloc_component_mergeable(
     live_labels: Sequence[dict],
 ) -> bool:
     """Whether a hostname-affinity coupled component compiles as ONE macro
-    placement unit: every sig carries only hostname-affinity terms, all
-    sigs are NODE-EQUIVALENT (same node selector, node affinity,
-    tolerations, namespace — they differ only in pod labels/selectors, so
-    one feasibility row represents all), every selector anchors inside the
-    component, and no selector reaches pods already bound on live nodes
-    (those groups must JOIN their node, which a macro can't express)."""
-    node_part = None
+    placement unit: every sig carries only hostname-affinity terms, every
+    selector anchors inside the component, and no selector reaches pods
+    already bound on live nodes (those groups must JOIN their node, which
+    a macro can't express).
+
+    Node-INEQUIVALENT closures (members differing in node selector,
+    required node affinity, tolerations, or volume requirements) merge
+    too: the whole group must land on ONE node, so the group's feasible
+    config set is exactly the INTERSECTION of its members' sets —
+    compile_problem ANDs the per-signature feasibility rows.  What must
+    stay equal across members is the RELAX-COHESION part (preferences,
+    node-affinity OR-terms, namespace): the solver's relaxation pass
+    re-routes unschedulable relax-eligible pods to the oracle, and a
+    closure whose members differ there would be torn apart by a partial
+    re-route."""
+    cohesion_part = None
     for s in comp:
         if reasons[s] and reasons[s] not in _HOST_CURABLE:
             return False
@@ -465,13 +476,13 @@ def _coloc_component_mergeable(
         ):
             return False
         sig = rep.constraint_signature()
-        # node_selector, required/preferred node affinity, volume-derived
-        # requirements, tolerations, namespace — preferences are
-        # node-affecting while unrelaxed
-        part = (sig[0], sig[1], sig[2], sig[7], sig[8], sig[9], rep.namespace)
-        if node_part is None:
-            node_part = part
-        elif part != node_part:
+        # preferred node affinity, OR-terms, namespace — the parts that
+        # decide relax eligibility (solver.solve's relax pass) and
+        # selector scoping
+        part = (sig[7], sig[9], rep.namespace)
+        if cohesion_part is None:
+            cohesion_part = part
+        elif part != cohesion_part:
             return False
     for s in comp:
         for t in sig_rep[s].pod_affinity:
@@ -1132,6 +1143,12 @@ def compile_problem(
             # seed with bound pods the constraint's SELECTOR matches (the
             # oracle replays placements the same way, topology.py:91-93)
             # plus the shares sibling classes of this group already took
+            # when_unsatisfiable deliberately OMITTED: the oracle's tracker
+            # keys groups by (topology key, selector, expressions,
+            # max_skew) only (topology.py:_spread_group), so a
+            # DoNotSchedule and a ScheduleAnyway spread with identical
+            # selectors share one count there — sharing the accumulator
+            # here keeps the compiled shares aligned with those counts
             selkey = (
                 tuple(sorted(c0.label_selector)),
                 c0.match_expressions,
@@ -1213,16 +1230,37 @@ def compile_problem(
     # (signature, pool) over the TYPE axis (and once per zone / capacity
     # type), then broadcast onto the full config axis with numpy — a
     # per-config Python loop would dominate the 200ms solve budget.
+    # A node-INEQUIVALENT co-location macro (members spanning several
+    # constraint signatures) gets the AND of its member rows: the whole
+    # group lands on one node, so its feasible set is exactly the
+    # intersection of the members' sets.
     feas = np.zeros((G, C), dtype=bool)
     classes_by_sig: Dict[Tuple, List[int]] = {}
+    sig_reps_of: Dict[Tuple, Tuple] = {}
     for g, cm in enumerate(classes):
         if cm.infeasible:
             continue  # proven unschedulable at compile time: row stays 0
-        classes_by_sig.setdefault((cm.signature, cm.zone_pin), []).append(g)
+        if cm.group_size:
+            seen: Dict[Tuple, Pod] = {}
+            for p in cm.pods:
+                s = p.constraint_signature()
+                if s not in seen:
+                    seen[s] = p
+            pairs = tuple(seen.items())
+        else:
+            pairs = ((cm.signature, cm.pods[0]),)
+        key = (tuple(s for s, _ in pairs), cm.zone_pin)
+        classes_by_sig.setdefault(key, []).append(g)
+        sig_reps_of[key] = pairs
 
     pools_by_name = {p.name: p for p in pools}
-    for (sig, zone_pin), g_idx in classes_by_sig.items():
-        rep = classes[g_idx[0]].pods[0]
+    row_memo: Dict[Tuple, np.ndarray] = {}
+
+    def _sig_row(sig: Tuple, rep: Pod, zone_pin: str) -> np.ndarray:
+        mkey = (sig, zone_pin)
+        row = row_memo.get(mkey)
+        if row is not None:
+            return row
         sched = rep.scheduling_requirements(preferred=True)
         if zone_pin:
             sched = Requirements(iter(sched))
@@ -1240,6 +1278,14 @@ def compile_problem(
             row[pr.rows] = type_ok[pr.t_of] & zone_ok[pr.z_of] & ct_ok[pr.ct_of]
         for e, sn in enumerate(live):
             row[first_existing + e] = _fits_existing(rep, sched, sn)
+        row_memo[mkey] = row
+        return row
+
+    for (sigs, zone_pin), g_idx in classes_by_sig.items():
+        pairs = sig_reps_of[(sigs, zone_pin)]
+        row = _sig_row(pairs[0][0], pairs[0][1], zone_pin)
+        for s, r in pairs[1:]:
+            row = row & _sig_row(s, r, zone_pin)
         feas[g_idx] = row
 
     req_mat = (

@@ -93,6 +93,13 @@ class VirtualNode:
     # probes against full nodes before any Requirements work
     _headroom: Optional[Dict[str, float]] = None
     _headroom_key: Optional[object] = None
+    # cross-NODE scan memo (Scheduler-owned, attached at node creation):
+    # (feasible-list identity, requirements snapshot) -> candidate entry.
+    # Fresh nodes share the pool template list, and all-fit commits keep
+    # the list identity (see the no-copy return below), so the label scan
+    # for a recurring (list, reqs) pair runs once per SOLVER lifetime
+    # instead of once per (node, shape)
+    _scan_memo: Optional[Dict] = None
 
     def __post_init__(self):
         if not self.name:
@@ -135,33 +142,39 @@ class VirtualNode:
                 return False
         return True
 
-    # (hi_cpu, hi_mem) computed once per node: a STALE upper bound (type
-    # narrowing only shrinks the true value), so the inline prefilter in
-    # _schedule_open_vnode may over-admit — try_add still decides — but
-    # never wrongly rejects
-    _hi2: Optional[Tuple[float, float]] = None
+    # (hi_cpu, hi_mem, hi_pods) computed once per node: a STALE upper
+    # bound (type narrowing only shrinks the true value), so the inline
+    # prefilter in _schedule_open_vnode may over-admit — try_add still
+    # decides — but never wrongly rejects.  The pods axis matters: a
+    # dense pack fills node POD SLOTS before cpu/memory, and a
+    # cpu/mem-only prefilter would pass every slot-full node through to
+    # try_add
+    _hi2: Optional[Tuple[float, float, float]] = None
 
-    def hi_cpu_mem(self) -> Tuple[float, float]:
+    def hi_cpu_mem(self) -> Tuple[float, float, float]:
         if self._hi2 is None:
             if self.widen_thunk is None:
                 # materialized list: the tight bound (and commits narrow
                 # it, so rebuilding here is what invalidation buys)
-                cpu = mem = 0.0
+                cpu = mem = pods = 0.0
                 for t in self.feasible_types:
                     a = t.allocatable()
                     if (c := a.get("cpu")) > cpu:
                         cpu = c
                     if (v := a.get("memory")) > mem:
                         mem = v
-                self._hi2 = (cpu, mem)
+                    if (p := a.get("pods")) > pods:
+                        pods = p
+                self._hi2 = (cpu, mem, pods)
             elif self._headroom:
                 hi = self._headroom
                 self._hi2 = (
                     hi.get("cpu", float("inf")),
                     hi.get("memory", float("inf")),
+                    hi.get("pods", float("inf")),
                 )
             else:  # no decode hint and a pending widen: stay permissive
-                self._hi2 = (float("inf"), float("inf"))
+                self._hi2 = (float("inf"), float("inf"), float("inf"))
         return self._hi2
 
     # -- helpers -------------------------------------------------------------
@@ -184,15 +197,58 @@ class VirtualNode:
     ) -> List[InstanceType]:
         ent = self._fit_cache.get(cache_key) if cache_key is not None else None
         if ent is None:
-            cand = [
-                t
-                for t in self.feasible_types
-                if t.requirements.compatible(reqs, allow_undefined=True)
-                and t.offerings.available().compatible(reqs)
-            ]
+            memo = self._scan_memo
+            mkey = None
+            if memo is not None:
+                # CONTENT key: commits replace the list object, but the
+                # narrowed lists repeat identically across solves (the
+                # pack is deterministic), so keying on the member type
+                # identities lets a later solve reuse this scan.  The
+                # reqs half is an immutable snapshot so an in-place
+                # mutation of a Requirements object can never corrupt
+                # the memo; the value pins the list (and so the types),
+                # keeping both id sets stable.
+                mkey = (
+                    tuple(map(id, self.feasible_types)),
+                    frozenset(reqs._reqs.items()),
+                )
+                got = memo.get(mkey)
+                if got is not None:
+                    ent = got[1]
+        if ent is None:
+            # offering admission with the zone/capacity-type requirements
+            # hoisted OUT of the per-type loop: the old per-type
+            # `offerings.available().compatible(reqs)` built two list
+            # objects and re-fetched both requirements per type, which
+            # dominated the oracle continuation's cache-miss scans
+            zr = reqs.get(ZONE)
+            cr = reqs.get(L.LABEL_CAPACITY_TYPE)
+            if zr is None and cr is None:
+                cand = [
+                    t
+                    for t in self.feasible_types
+                    if any(o.available for o in t.offerings)
+                    and t.requirements.compatible(reqs, allow_undefined=True)
+                ]
+            else:
+                cand = [
+                    t
+                    for t in self.feasible_types
+                    if any(
+                        o.available
+                        and (zr is None or zr.has(o.zone))
+                        and (cr is None or cr.has(o.capacity_type))
+                        for o in t.offerings
+                    )
+                    and t.requirements.compatible(reqs, allow_undefined=True)
+                ]
             ent = (cand, {})
-            if cache_key is not None:
-                self._fit_cache[cache_key] = ent
+            if mkey is not None:
+                if len(memo) > 20_000:
+                    memo.clear()  # unbounded-workload backstop
+                memo[mkey] = (self.feasible_types, ent)
+        if cache_key is not None:
+            self._fit_cache[cache_key] = ent
         cand, mats = ent
         if not cand:
             return []
@@ -209,7 +265,9 @@ class VirtualNode:
         vec = np.array([v for _, v in items])
         mask = (vec <= mat + 1e-9).all(axis=1)
         if mask.all():
-            return list(cand)
+            # no copy: commits replace feasible_types wholesale and no
+            # caller mutates the returned list in place
+            return cand
         return [t for t, ok in zip(cand, mask) if ok]
 
     def try_add(
@@ -218,10 +276,17 @@ class VirtualNode:
         topology: TopologyTracker,
         preferred: bool = True,
         term: int = 0,
+        reserve: Optional[Resources] = None,
     ) -> bool:
+        """``reserve``: a co-location ANCHOR reserves its whole group's
+        total — the node must admit the sum (and its type set narrows to
+        types that hold it) while only the anchor's own requests commit.
+        Prevents anchoring a group on a nearly-full node that strands the
+        followers (kube-scheduler would strand them too, but a fresh node
+        that holds everyone is the better pack when one exists)."""
         if not tolerates_all(pod.tolerations, self.pool.taints):
             return False
-        if not self._headroom_admits(pod.requests):
+        if not self._headroom_admits(reserve if reserve is not None else pod.requests):
             return False
         # topology next: hostname-keyed constraints treat this node as a
         # domain; a node with no pods yet is a fresh domain (NEW_DOMAIN).
@@ -229,7 +294,7 @@ class VirtualNode:
         # headroom gate, it is the cheapest remaining rejection — a
         # co-location follower probes every open node and all but its
         # anchor fail here.
-        host_allowed = topology.allowed_domains(pod, HOSTNAME, preferred)
+        host_allowed = topology.allowed_domains(pod, HOSTNAME, preferred, term)
         if host_allowed is not None and self.name not in host_allowed:
             if not (NEW_DOMAIN in host_allowed and not self.pods):
                 return False
@@ -240,10 +305,13 @@ class VirtualNode:
             return False
         # zone-keyed constraints narrow the node's zone choice; any pod
         # carrying one must PIN a zone so the placement is counted/anchored
-        # (first affinity pod anchors the domain for followers)
-        zone_choice: Optional[str] = None
+        # (first affinity pod anchors the domain for followers).  Allowed
+        # zones are walked balanced-first: a zone whose offerings have no
+        # fitting type falls through to the next allowed zone instead of
+        # wedging the pod on the balance-optimal pick.
+        zone_order: List[Optional[str]] = [None]
         if _zone_constrained(pod, preferred) or topology.selected_by_group(pod, ZONE):
-            zone_allowed = topology.allowed_domains(pod, ZONE, preferred)
+            zone_allowed = topology.allowed_domains(pod, ZONE, preferred, term)
             options = self.zone_options()
             if zone_allowed is not None:
                 options &= zone_allowed
@@ -252,22 +320,49 @@ class VirtualNode:
                 options = {z for z in options if zr.has(z)}
             if not options:
                 return False
-            zone_choice = topology.preferred_domain(pod, ZONE, options)
-            reqs.add(Requirement(ZONE, Op.IN, [zone_choice]))
+            zone_order = topology.preferred_domains(pod, ZONE, options)
 
         new_used = self.used + pod.requests
-        sig = pod.constraint_signature()
-        # the key must cover every sig component that feeds the merged
-        # requirements: node_selector, required affinity, preferences,
-        # volume-derived reqs, OR-terms — plus which attempt this is
-        feasible = self._fits_some_type(
-            reqs,
-            new_used,
-            cache_key=(
-                sig[0], sig[1], sig[7], sig[8], sig[9],
-                preferred, term, zone_choice,
-            ),
-        )
+        base_reqs = reqs
+        zone_choice: Optional[str] = None
+        feasible: List[InstanceType] = []
+        same = False
+        for zc in zone_order:
+            if zc is None:
+                reqs = base_reqs
+            else:
+                reqs = Requirements(iter(base_reqs))
+                reqs.add(Requirement(ZONE, Op.IN, [zc]))
+            same = reqs == self.requirements
+            if same:
+                # the merged reqs add nothing: every probing shape that
+                # folds into this node's requirements shares ONE cache
+                # entry, so a cross-node scan (e.g. gang anchors probing
+                # each open node) costs one label scan per NODE, not one
+                # per (shape, node)
+                cache_key = ("__same__",)
+            else:
+                sig = pod.constraint_signature()
+                # the key must cover every sig component that feeds the
+                # merged requirements: node_selector, required affinity,
+                # preferences, volume-derived reqs, OR-terms — plus which
+                # attempt this is
+                cache_key = (
+                    sig[0], sig[1], sig[7], sig[8], sig[9],
+                    preferred, term, zc,
+                )
+            # the cached half (label-compatible candidate types) depends
+            # only on the merged reqs, so a reserving anchor shares the
+            # same entry — the group-total `used` vector is applied per
+            # call like any other
+            feasible = self._fits_some_type(
+                reqs,
+                self.used + reserve if reserve is not None else new_used,
+                cache_key=cache_key,
+            )
+            if feasible:
+                zone_choice = zc
+                break
         if not feasible:
             return False
 
@@ -277,7 +372,7 @@ class VirtualNode:
         # turns their scans into dict hits; the resource narrowing of
         # `feasible_types` below stays safe because every probe re-applies
         # the allocatable mask against its own `used` vector.
-        if reqs != self.requirements:
+        if not same:
             self._fit_cache.clear()
             self.requirements = reqs
         self.feasible_types = feasible
@@ -352,14 +447,18 @@ class ExistingNode:
         topology: TopologyTracker,
         preferred: bool = True,
         term: int = 0,
+        reserve: Optional[Resources] = None,
     ) -> bool:
         if self.state.marked_for_deletion() or (
             self.state.node is not None and self.state.node.cordoned
         ):
             return False
         # resources first: the cheapest definitive rejection, and most
-        # probes in a big solve hit already-full nodes
-        if not (self.used + pod.requests).fits(self.state.allocatable):
+        # probes in a big solve hit already-full nodes; an anchor's
+        # `reserve` (its group total) must fit so followers can join
+        if not (
+            self.used + (reserve if reserve is not None else pod.requests)
+        ).fits(self.state.allocatable):
             return False
         if not tolerates_all(pod.tolerations, self.state.taints):
             return False
@@ -369,10 +468,10 @@ class ExistingNode:
             pod.scheduling_requirements(preferred=preferred, term=term)
         ):
             return False
-        host_allowed = topology.allowed_domains(pod, HOSTNAME, preferred)
+        host_allowed = topology.allowed_domains(pod, HOSTNAME, preferred, term)
         if host_allowed is not None and self.name not in host_allowed:
             return False
-        zone_allowed = topology.allowed_domains(pod, ZONE, preferred)
+        zone_allowed = topology.allowed_domains(pod, ZONE, preferred, term)
         zone = self.state.zone
         if zone_allowed is not None and zone and zone not in zone_allowed:
             return False
@@ -408,7 +507,15 @@ class Scheduler:
         existing: Sequence[StateNode] = (),
         daemonsets: Sequence[Pod] = (),
         zones: Sequence[str] = (),
+        scan_memo: Optional[Dict] = None,
     ):
+        # cross-node label-scan memo (see VirtualNode._scan_memo); a
+        # long-lived caller (TensorScheduler's oracle continuation) passes
+        # its own dict so entries survive per-solve Scheduler recreation
+        self._scan_memo: Dict = scan_memo if scan_memo is not None else {}
+        # open-node scan list, (re)seeded per solve() and pruned of
+        # slot-full nodes as the solve proceeds
+        self._scan_nodes: List[VirtualNode] = []
         # highest weight first (reference designs/provisioner-priority.md)
         self.pools = sorted(
             (p for p in pools if not p.deleted), key=lambda p: -p.weight
@@ -452,38 +559,182 @@ class Scheduler:
         tensor+oracle path seeds the tensor half's placements this way)."""
         if result is None:
             result = SchedulingResult()
+        pods = list(pods)
+        gangs = self._gang_components(pods)
+        # the open-node scan list: starts as the (possibly seeded)
+        # new_nodes and is PRUNED as nodes fill their pod slots — every
+        # pod needs >= 1 slot, so a slot-full node can never admit
+        # anything again, and a continued solve over a dense tensor pack
+        # would otherwise re-probe hundreds of full nodes per placement
+        self._scan_nodes = list(result.new_nodes)
+        done: Set[int] = set()
         for pod in sorted(pods, key=pod_sort_key):
-            # node-affinity OR-terms go in order, first that works
-            # (reference scheduling.md:230-259); within each term,
-            # preferences AND ScheduleAnyway spreads are REQUIRED on the
-            # first attempt and relaxed (all at once) only when the pod
-            # proves unschedulable — karpenter-core's relaxation
-            relaxable = bool(pod.preferred_affinity) or any(
-                c.when_unsatisfiable != "DoNotSchedule"
-                for c in pod.topology_spread
-            )
-            reason = None
-            for ti in range(len(pod.node_affinity_terms())):
-                reason = self._place(pod, result, preferred=True, term=ti)
-                if reason is None:
-                    break
-                if relaxable:
-                    reason = self._place(pod, result, preferred=False, term=ti)
-                    if reason is None:
-                        break
-            if reason is not None:
-                result.unschedulable[pod.key()] = reason
+            if id(pod) in done:
+                continue  # placed ahead of order by its gang's anchor pass
+            self._place_one(pod, result, gangs, done)
         return result
 
+    def _place_one(
+        self,
+        pod: Pod,
+        result: SchedulingResult,
+        gangs: Dict[int, list],
+        done: Set[int],
+    ) -> None:
+        # a co-location ANCHOR (first member of its gang to place, no
+        # live/prior matching placement) reserves the gang total so it
+        # only anchors where the whole group fits; if no node admits
+        # the total, fall back to per-pod placement (kube-scheduler's
+        # greedy partial semantics)
+        gang = gangs.get(id(pod))
+        reserve = None
+        if gang is not None and not gang[1] and not self._gang_anchored(pod):
+            reserve = gang[0]
+        reason = self._attempt_ladder(pod, result, reserve)
+        done.add(id(pod))
+        if reason is not None:
+            result.unschedulable[pod.key()] = reason
+            if gang is not None:
+                # a dead member must stop inflating the reserve the next
+                # anchor candidate will carry
+                gang[0] = gang[0] - pod.requests
+            return
+        if gang is None:
+            return
+        gang[1].append(pod)
+        if reserve is not None:
+            # anchored with the whole group reserved: place every other
+            # member NOW, before any interleaved pod (another gang's
+            # anchor, a plain pod) can consume the reserved headroom —
+            # the reservation exists only as this contiguous pass
+            for member in sorted(gang[2], key=pod_sort_key):
+                if id(member) in done:
+                    continue
+                r2 = self._attempt_ladder(member, result, None)
+                done.add(id(member))
+                if r2 is not None:
+                    result.unschedulable[member.key()] = r2
+                    gang[0] = gang[0] - member.requests
+                else:
+                    gang[1].append(member)
+
+    def _attempt_ladder(
+        self, pod: Pod, result: SchedulingResult, reserve: Optional[Resources]
+    ) -> Optional[str]:
+        """Node-affinity OR-terms go in order, first that works (reference
+        scheduling.md:230-259); within each term, preferences AND
+        ScheduleAnyway spreads are REQUIRED on the first attempt and
+        relaxed (all at once) only when the pod proves unschedulable —
+        karpenter-core's relaxation.  With a gang reserve, every reserved
+        attempt (strict, then relaxed) runs BEFORE the plain fallbacks:
+        hostname affinity is a HARD constraint, so keeping the gang whole
+        on a relaxed placement beats satisfying a soft preference and
+        stranding the followers."""
+        relaxable = bool(pod.preferred_affinity) or any(
+            c.when_unsatisfiable != "DoNotSchedule"
+            for c in pod.topology_spread
+        )
+        reason = None
+        n_terms = len(pod.node_affinity_terms())
+        if reserve is not None:
+            # every reserved attempt — all OR-terms, strict then relaxed —
+            # before ANY plain fallback: a later term that holds the whole
+            # gang beats an earlier term that strands followers
+            for ti in range(n_terms):
+                if self._place(pod, result, True, ti, reserve) is None:
+                    return None
+                if relaxable and self._place(pod, result, False, ti, reserve) is None:
+                    return None
+        for ti in range(n_terms):
+            reason = self._place(pod, result, True, ti)
+            if reason is None:
+                return None
+            if relaxable:
+                reason = self._place(pod, result, False, ti)
+                if reason is None:
+                    return None
+        return reason
+
+    def _gang_components(self, pods: Sequence[Pod]) -> Dict[int, list]:
+        """Connected components over hostname co-location carriers in the
+        batch: id(pod) -> shared ``[total_requests, placed_members,
+        members]``.  An anchor uses the total as its placement reserve and
+        then places the remaining members contiguously (see _place_one)."""
+        carriers = [
+            p
+            for p in pods
+            if any(
+                not t.anti and t.topology_key == HOSTNAME
+                for t in p.pod_affinity
+            )
+        ]
+        if not carriers:
+            return {}
+        # inverted label index: selector matching runs as set intersection
+        by_label: Dict[Tuple[str, str], Set[int]] = {}
+        for i, p in enumerate(carriers):
+            for kv in p.labels.items():
+                by_label.setdefault(kv, set()).add(i)
+        parent = list(range(len(carriers)))
+
+        def find(x: int) -> int:
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        for i, p in enumerate(carriers):
+            for t in p.pod_affinity:
+                if t.anti or t.topology_key != HOSTNAME:
+                    continue
+                cand: Optional[Set[int]] = None
+                for kv in t.label_selector:
+                    hit = by_label.get(kv, set())
+                    cand = set(hit) if cand is None else (cand & hit)
+                    if not cand:
+                        break
+                if cand is None:
+                    cand = set(range(len(carriers)))
+                for j in cand:
+                    if t.selects(carriers[j]):
+                        ri, rj = find(i), find(j)
+                        if ri != rj:
+                            parent[rj] = ri
+        comps: Dict[int, list] = {}
+        for i, p in enumerate(carriers):
+            root = find(i)
+            ent = comps.get(root)
+            if ent is None:
+                ent = comps[root] = [Resources(), [], []]
+            ent[0] = ent[0] + p.requests
+            ent[2].append(p)
+        return {id(p): comps[find(i)] for i, p in enumerate(carriers)}
+
+    def _gang_anchored(self, pod: Pod) -> bool:
+        """Whether some placement already anchors this pod's affinity terms
+        (a live member or an earlier matched pod): then the pod must JOIN,
+        and reserving a fresh-node total would be wrong."""
+        for t in pod.pod_affinity:
+            if t.anti or t.topology_key != HOSTNAME:
+                continue
+            if self.topology._affinity_group(t).domains:
+                return True
+        return False
+
     def _place(
-        self, pod: Pod, result: SchedulingResult, preferred: bool, term: int = 0
+        self,
+        pod: Pod,
+        result: SchedulingResult,
+        preferred: bool,
+        term: int = 0,
+        reserve: Optional[Resources] = None,
     ) -> Optional[str]:
         """One placement attempt; None on success, else the reason."""
-        if self._schedule_existing(pod, result, preferred, term):
+        if self._schedule_existing(pod, result, preferred, term, reserve):
             return None
-        if self._schedule_open_vnode(pod, result, preferred, term):
+        if self._schedule_open_vnode(pod, result, preferred, term, reserve):
             return None
-        return self._schedule_new_vnode(pod, result, preferred, term)
+        return self._schedule_new_vnode(pod, result, preferred, term, reserve)
 
     def _schedule_existing(
         self,
@@ -491,12 +742,13 @@ class Scheduler:
         result: SchedulingResult,
         preferred: bool = True,
         term: int = 0,
+        reserve: Optional[Resources] = None,
     ) -> bool:
-        host_allowed = self.topology.allowed_domains(pod, HOSTNAME, preferred)
+        host_allowed = self.topology.allowed_domains(pod, HOSTNAME, preferred, term)
         for en in self.existing:
             if host_allowed is not None and en.name not in host_allowed:
                 continue
-            if en.try_add(pod, self.topology, preferred, term):
+            if en.try_add(pod, self.topology, preferred, term, reserve):
                 result.existing_placements[pod.key()] = en.name
                 return True
         return False
@@ -507,33 +759,50 @@ class Scheduler:
         result: SchedulingResult,
         preferred: bool = True,
         term: int = 0,
+        reserve: Optional[Resources] = None,
     ) -> bool:
         # two cheap prefilters before any try_add work: hostname-constrained
         # pods (co-location followers, anti-affinity singletons) admit only
         # their anchor domains, and every pod skips nodes whose cached
         # cpu/mem upper bound can't hold it — most probes in a big solve
         # hit already-full nodes
-        host_allowed = self.topology.allowed_domains(pod, HOSTNAME, preferred)
+        host_allowed = self.topology.allowed_domains(pod, HOSTNAME, preferred, term)
         allow_new = host_allowed is None or NEW_DOMAIN in host_allowed
-        cpu_need = pod.requests.get("cpu")
-        mem_need = pod.requests.get("memory")
-        for vn in result.new_nodes:
+        need = reserve if reserve is not None else pod.requests
+        cpu_need = need.get("cpu")
+        mem_need = need.get("memory")
+        pods_need = need.get("pods")
+        scan = self._scan_nodes
+        placed = False
+        full: Optional[set] = None
+        for vn in scan:
+            used = vn.used
+            hi_cpu, hi_mem, hi_pods = vn.hi_cpu_mem()
+            if used.get("pods") + 1 > hi_pods + 1e-9:
+                # slot-full: prune from the scan list for good (hi_pods
+                # is an upper bound, so this never drops a usable node)
+                if full is None:
+                    full = set()
+                full.add(id(vn))
+                continue
             if (
                 host_allowed is not None
                 and vn.name not in host_allowed
                 and not (allow_new and not vn.pods)
             ):
                 continue
-            hi_cpu, hi_mem = vn.hi_cpu_mem()
-            used = vn.used
             if (
                 used.get("cpu") + cpu_need > hi_cpu + 1e-9
                 or used.get("memory") + mem_need > hi_mem + 1e-9
+                or used.get("pods") + pods_need > hi_pods + 1e-9
             ):
                 continue
-            if vn.try_add(pod, self.topology, preferred, term):
-                return True
-        return False
+            if vn.try_add(pod, self.topology, preferred, term, reserve):
+                placed = True
+                break
+        if full is not None:
+            self._scan_nodes = [vn for vn in scan if id(vn) not in full]
+        return placed
 
     def _schedule_new_vnode(
         self,
@@ -541,6 +810,7 @@ class Scheduler:
         result: SchedulingResult,
         preferred: bool = True,
         term: int = 0,
+        reserve: Optional[Resources] = None,
     ) -> Optional[str]:
         reason = "no nodepool matched pod constraints"
         for pool in self.pools:
@@ -549,24 +819,72 @@ class Scheduler:
                 reason = f"nodepool {pool.name} has no instance types"
                 continue
             vn = self._new_vnode(pool, types)
-            if vn.try_add(pod, self.topology, preferred, term):
+            if vn.try_add(pod, self.topology, preferred, term, reserve):
                 result.new_nodes.append(vn)
+                self._scan_nodes.append(vn)
                 return None
             reason = "pod incompatible with every instance type / offering"
         return reason
 
     def _new_vnode(self, pool: NodePool, types: List[InstanceType]) -> VirtualNode:
-        reqs = pool.template_requirements()
-        feasible = [
-            t for t in types if t.requirements.compatible(reqs, allow_undefined=True)
-        ]
-        overhead = self._daemon_overhead(pool, reqs)
-        return VirtualNode(
+        # the template parts (pool requirements, label-feasible type list,
+        # daemonset overhead) are pool-constant while the caller's type
+        # lists are; a big batch opens hundreds of nodes and re-deriving
+        # them per node was a measurable slice of the oracle continuation.
+        # Stored in the (possibly cross-solve) scan memo so the template
+        # LIST IDENTITY is stable across continuations — that identity is
+        # what keys the cross-node label-scan memo entries.  Validity is
+        # identity-based over EVERY input the template derives from —
+        # types list, pool object, daemonset objects — mirroring the
+        # solver's catalog key: the provider can return the same cached
+        # types list while the pool template or daemonsets changed.
+        tkey = ("__vnode_tpl__", pool.name)
+        ds = tuple(self.daemonsets)
+        ent = self._scan_memo.get(tkey)
+        if (
+            ent is None
+            or ent[0] is not types
+            or ent[1] is not pool
+            or len(ent[2]) != len(ds)
+            or any(a is not b for a, b in zip(ent[2], ds))
+        ):
+            reqs = pool.template_requirements()
+            feasible = [
+                t
+                for t in types
+                if t.requirements.compatible(reqs, allow_undefined=True)
+            ]
+            hi: Dict[str, float] = {}
+            for t in feasible:
+                for axis, v in t.allocatable().items():
+                    if v > hi.get(axis, 0.0):
+                        hi[axis] = v
+            ent = (
+                types,
+                pool,
+                ds,
+                reqs,
+                feasible,
+                self._daemon_overhead(pool, reqs),
+                hi,
+                (hi.get("cpu", 0.0), hi.get("memory", 0.0), hi.get("pods", 0.0)),
+            )
+            self._scan_memo[tkey] = ent
+        _, _, _, reqs, feasible, overhead, hi, hi2 = ent
+        vn = VirtualNode(
             pool=pool,
-            requirements=reqs,
+            requirements=Requirements(iter(reqs)),
             feasible_types=feasible,
             daemon_overhead=overhead,
         )
+        # seed the headroom caches from the template (shared, never
+        # mutated in place): a failed probe on a fresh node must not pay
+        # a full allocatable walk per attempt
+        vn._headroom = hi
+        vn._headroom_key = feasible
+        vn._hi2 = hi2
+        vn._scan_memo = self._scan_memo
+        return vn
 
     def _daemon_overhead(self, pool: NodePool, reqs: Requirements) -> Resources:
         """Daemonset pods that will land on any node of this pool charge

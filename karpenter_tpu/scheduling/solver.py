@@ -107,6 +107,17 @@ class TensorScheduler:
         self._catalog_key: tuple = ()
         self._catalog = None
         self._catalog_pins: tuple = ()
+        # persistent cross-solve label-scan memo handed to every oracle
+        # Scheduler this solver creates (see scheduler.Scheduler.__init__):
+        # the continuation's fresh-node scans repeat identically across
+        # reconciles, so the memo amortizes them to one scan per shape.
+        # Entries PIN the keyed type list (and so its member types), so
+        # the ids in a key stay allocated for the entry's lifetime and id
+        # reuse cannot alias; an input roll clears the memo wholesale
+        # (update() / _solve_tensor's catalog rebuild) before dead
+        # entries can accumulate.
+        self._scan_memo: dict = {}
+        self._input_key: tuple = ()
 
     def update(
         self,
@@ -123,6 +134,18 @@ class TensorScheduler:
         across reconciles (like the reference's long-lived provisioner over
         its 5m-TTL instance-type cache) reuses the compiled catalog whenever
         the provider returns the same cached lists."""
+        key = (
+            tuple(map(id, pools)),
+            tuple(sorted((k, id(v)) for k, v in instance_types.items())),
+            tuple(map(id, daemonsets)),
+        )
+        if key != self._input_key:
+            # new input objects make every id-keyed scan-memo entry dead;
+            # drop them here too, not only on the tensor-path catalog
+            # roll — a run of pure-oracle reconciles would otherwise pin
+            # superseded type graphs until the size backstop
+            self._input_key = key
+            self._scan_memo.clear()
         self.pools = list(pools)
         self.instance_types = instance_types
         self.existing = list(existing)
@@ -187,11 +210,21 @@ class TensorScheduler:
                 )
         # a selector that matches UNLABELED pods (empty matchLabels, or
         # only negative expressions) leaves no pod safely untracked —
-        # with one in the batch, skip compaction
+        # with one in the batch, skip compaction.  LIVE bound pods'
+        # symmetric anti-affinity counts too: a label-less batch pod
+        # matched by a live carrier's zone-keyed anti term is zone-pinned
+        # by the main solve, and the compaction scratch tracker (seeded
+        # only with new-node pods) would not see the ban.
         if not any(
             selector_matches({}, c.label_selector, c.match_expressions)
             for p in pods
             for c in (*p.topology_spread, *p.pod_affinity)
+        ) and not any(
+            selector_matches({}, t.label_selector, t.match_expressions)
+            for sn in self.existing
+            for bp in sn.pods
+            for t in bp.pod_affinity
+            if t.anti
         ):
             with TRACER.span("solver.compact"):
                 self._compact_small_nodes(result)
@@ -299,6 +332,11 @@ class TensorScheduler:
                 self.pools, self.instance_types, self.daemonsets, axes
             )
             self._catalog_key = key
+            # a catalog roll (new instance-type list objects) makes every
+            # id-keyed scan-memo entry permanently unreachable while still
+            # pinning the superseded type graphs — drop them now instead
+            # of letting dead entries crawl toward the size backstop
+            self._scan_memo.clear()
             self._catalog_pins = (
                 tuple(self.pools),
                 tuple(self.instance_types.values()),
@@ -378,6 +416,7 @@ class TensorScheduler:
             existing=self.existing,
             daemonsets=self.daemonsets,
             zones=self.zones,
+            scan_memo=self._scan_memo,
         ).solve(pods)
 
     def _oracle_continue(
@@ -406,6 +445,7 @@ class TensorScheduler:
             existing=self.existing,
             daemonsets=self.daemonsets,
             zones=self.zones,
+            scan_memo=self._scan_memo,
         )
         by_key = {p.key(): p for p in supported}
         en_by_name = {en.name: en for en in sch.existing}

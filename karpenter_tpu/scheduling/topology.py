@@ -144,7 +144,7 @@ class TopologyTracker:
 
     # -- queries -------------------------------------------------------------
     def allowed_domains(
-        self, pod: Pod, key: str, include_soft: bool = True
+        self, pod: Pod, key: str, include_soft: bool = True, term: int = 0
     ) -> Optional[Set[str]]:
         """Intersection of all constraints' allowed domains for `pod` on
         topology `key`.  None = unconstrained.  NEW_DOMAIN membership means a
@@ -152,7 +152,10 @@ class TopologyTracker:
 
         ScheduleAnyway spreads participate while ``include_soft`` (the
         strict first attempt); a relaxing caller passes False to drop
-        them, keeping hard constraints in force."""
+        them, keeping hard constraints in force.  ``term`` is the
+        node-affinity OR-term under attempt: the nodeAffinityPolicy=Honor
+        spread universe is narrowed by the ACTIVE term's zone requirement,
+        not term 0's."""
         allow_new = key == HOSTNAME
         universe = self.universe.get(key, set())
         result: Optional[Set[str]] = None
@@ -170,7 +173,7 @@ class TopologyTracker:
                 # wedged global minimum
                 spread_universe = universe
                 if key == ZONE:
-                    zr = pod.scheduling_requirements().get(key)
+                    zr = pod.scheduling_requirements(term=term).get(key)
                     if zr is not None:
                         spread_universe = {
                             z for z in universe if zr.has(z)
@@ -230,10 +233,13 @@ class TopologyTracker:
                 return True
         return False
 
-    def preferred_domain(self, pod: Pod, key: str, candidates: Set[str]) -> str:
-        """Pick the candidate domain with the lowest aggregate spread count
-        over every group that counts this pod (own constraints or membership
-        in others') — keeps skew balanced; deterministic tie-break by name."""
+    def preferred_domains(self, pod: Pod, key: str, candidates: Set[str]) -> List[str]:
+        """Candidate domains ordered by aggregate spread count over every
+        group that counts this pod (own constraints or membership in
+        others') — lowest first keeps skew balanced; deterministic
+        tie-break by name.  Callers walk the list so a domain with no
+        fitting capacity falls through to the next-balanced one instead
+        of wedging the pod."""
 
         # make sure the pod's own groups exist, then count each group once
         for c in pod.topology_spread:
@@ -250,7 +256,7 @@ class TopologyTracker:
         def load(d: str) -> int:
             return sum(g.counts.get(d, 0) for g in groups)
 
-        return min(sorted(candidates), key=load)
+        return sorted(sorted(candidates), key=load)
 
     # -- recording -----------------------------------------------------------
     def record(self, pod: Pod, domains: Dict[str, str]) -> None:

@@ -299,3 +299,256 @@ def test_tpu_accelerator_selector(env):
     assert not result.unschedulable
     (node,) = result.new_nodes
     assert all(t.capacity.get(L.RESOURCE_TPU) >= 2 for t in node.feasible_types)
+
+
+def test_gang_anchor_avoids_node_too_small_for_group(env):
+    """A co-location anchor reserves its whole group's total: a live node
+    with room for only part of the group is skipped, so the followers are
+    never stranded when a node that holds everyone exists."""
+    sn = StateNode(
+        name="tight-1",
+        provider_id="fake://i-9",
+        labels={
+            L.LABEL_ZONE: "zone-a",
+            L.LABEL_INSTANCE_TYPE: "std1.xlarge",
+            L.LABEL_NODEPOOL: "default",
+        },
+        taints=[],
+        allocatable=Resources(cpu=8, memory="30Gi", pods=110),
+        used=Resources(cpu=6),
+    )
+    term = PodAffinityTerm(
+        topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "g"),)
+    )
+    group = [
+        Pod(labels={"pair": "g"}, requests=Resources(cpu=1), pod_affinity=[term])
+        for _ in range(3)
+    ]
+    s = make_scheduler(env, existing=[sn])
+    result = s.solve(group)
+    assert not result.unschedulable
+    # 2 free cpu on the live node can't hold the 3-cpu gang: all three
+    # must land together on one fresh node
+    assert not result.existing_placements
+    assert result.node_count() == 1
+    assert len(result.new_nodes[0].pods) == 3
+
+
+def test_gang_too_big_for_any_node_partial_places(env):
+    """When NO node admits the gang total the anchor falls back to
+    per-pod placement (kube-scheduler's greedy partial semantics)."""
+    s = make_scheduler(env)
+    biggest = max(
+        t.capacity.cpu
+        for ts in s.instance_types.values()
+        for t in ts
+    )
+    n = int(biggest // 4) + 2
+    term = PodAffinityTerm(
+        topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "big"),)
+    )
+    group = [
+        Pod(labels={"pair": "big"}, requests=Resources(cpu=4), pod_affinity=[term])
+        for _ in range(n)
+    ]
+    result = s.solve(group)
+    placed = sum(len(vn.pods) for vn in result.new_nodes)
+    assert placed > 0  # partial, not all-or-nothing
+    assert len(result.unschedulable) == n - placed
+    assert result.node_count() == 1  # everyone placed shares the anchor node
+
+
+def test_gang_with_live_anchor_joins_existing(env):
+    """A gang whose selector matches a pod bound on a live node JOINS that
+    node (no fresh-node reserve): live-member co-location semantics."""
+    bound = Pod(labels={"pair": "g"}, requests=Resources(cpu=1))
+    sn = StateNode(
+        name="holder-1",
+        provider_id="fake://i-8",
+        labels={
+            L.LABEL_ZONE: "zone-a",
+            L.LABEL_INSTANCE_TYPE: "std1.xlarge",
+            L.LABEL_NODEPOOL: "default",
+        },
+        taints=[],
+        allocatable=Resources(cpu=8, memory="30Gi", pods=110),
+        pods=[bound],
+        used=Resources(cpu=1),
+    )
+    term = PodAffinityTerm(
+        topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "g"),)
+    )
+    group = [
+        Pod(labels={"pair": "g"}, requests=Resources(cpu=1), pod_affinity=[term])
+        for _ in range(2)
+    ]
+    s = make_scheduler(env, existing=[sn])
+    result = s.solve(group)
+    assert not result.unschedulable
+    assert set(result.existing_placements.values()) == {"holder-1"}
+
+
+def test_unknown_selector_operator_matches_nothing(env):
+    """An invalid matchExpressions operator must not throw inside the
+    scheduling loop — kube's contract for an invalid selector is to match
+    nothing."""
+    from karpenter_tpu.api.objects import selector_matches
+
+    assert not selector_matches({"a": "b"}, (), (("a", "Bogus", ("b",)),))
+    # a spread carrying the bogus expression schedules without raising
+    c = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=L.LABEL_ZONE,
+        label_selector=(("svc", "x"),),
+        match_expressions=(("tier", "Bogus", ("gold",)),),
+    )
+    s = make_scheduler(env)
+    result = s.solve(
+        [Pod(labels={"svc": "x"}, requests=Resources(cpu=1), topology_spread=[c])]
+    )
+    assert not result.unschedulable
+
+
+def _zoned_type(name, cpu, zone, price):
+    """Hand-built type mirroring the provider's shape: type requirements
+    carry the zone/capacity-type keys its offerings span
+    (providers/instancetype.py:265), so required-zone pods are admitted."""
+    from karpenter_tpu.api import InstanceType, Requirement, Requirements
+    from karpenter_tpu.api.objects import Offering, Offerings
+    from karpenter_tpu.api.requirements import Op as _Op
+
+    return InstanceType(
+        name=name,
+        requirements=Requirements(
+            [
+                Requirement(L.LABEL_INSTANCE_TYPE, _Op.IN, [name]),
+                Requirement(L.LABEL_ZONE, _Op.IN, [zone]),
+                Requirement(L.LABEL_CAPACITY_TYPE, _Op.IN, ["on-demand"]),
+            ]
+        ),
+        capacity=Resources(cpu=cpu, memory=f"{cpu*4}Gi", pods=110),
+        offerings=Offerings(
+            [Offering(zone=zone, capacity_type="on-demand", price=price)]
+        ),
+    )
+
+
+def test_gang_relaxed_attempt_keeps_reserve(env):
+    """Preference-carrying gangs stay gang-aware through relaxation: if
+    strict+reserve fails, relaxed+reserve runs BEFORE the plain partial
+    fallback — the whole group lands on the big node in the non-preferred
+    zone instead of stranding a follower in the preferred one."""
+    from karpenter_tpu.api import NodePool
+
+    small = _zoned_type("small-8", 8, "zone-a", 1.0)
+    big = _zoned_type("big-16", 16, "zone-b", 2.0)
+    pool = NodePool(name="p")
+    s = Scheduler([pool], {"p": [small, big]})
+    term = PodAffinityTerm(
+        topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "g"),)
+    )
+    group = [
+        Pod(
+            labels={"pair": "g"},
+            requests=Resources(cpu=4),
+            pod_affinity=[term],
+            preferred_affinity=[Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])],
+        )
+        for _ in range(3)
+    ]
+    result = s.solve(group)
+    assert not result.unschedulable, result.unschedulable
+    assert result.node_count() == 1
+    vn = result.new_nodes[0]
+    assert len(vn.pods) == 3
+    # the 12-cpu gang only fits the zone-b type
+    assert {t.name for t in vn.feasible_types} == {"big-16"}
+
+
+def test_interleaved_gangs_do_not_double_book_reserve(env):
+    """Two gangs whose members interleave in input order must not anchor
+    on the same node and strand each other's followers: the anchor's
+    contiguous gang pass consumes the reservation before the next gang's
+    anchor probes."""
+    from karpenter_tpu.api import NodePool
+
+    only16 = _zoned_type("only-16", 16, "zone-a", 1.0)
+    pool = NodePool(name="p")
+    s = Scheduler([pool], {"p": [only16]})
+    pods = []
+    for i in range(3):  # interleave: A,B,A,B,A,B
+        for g in ("ga", "gb"):
+            pods.append(
+                Pod(
+                    labels={"pair": g},
+                    requests=Resources(cpu=4),
+                    pod_affinity=[
+                        PodAffinityTerm(
+                            topology_key=L.LABEL_HOSTNAME,
+                            label_selector=(("pair", g),),
+                        )
+                    ],
+                )
+            )
+    result = s.solve(pods)
+    # each 12-cpu gang fits a 16-cpu node alone but not together: two
+    # nodes, zero stranded
+    assert not result.unschedulable, result.unschedulable
+    assert result.node_count() == 2
+    by_gang = {}
+    for vn in result.new_nodes:
+        for p in vn.pods:
+            by_gang.setdefault(p.labels["pair"], set()).add(vn.name)
+    assert all(len(v) == 1 for v in by_gang.values()), by_gang
+
+
+def test_gang_reserve_prefers_later_or_term_over_partial(env):
+    """All reserved OR-term attempts run before any plain fallback: a
+    gang whose first term lands in a zone too small for the group takes
+    the second term's zone whole instead of stranding a follower."""
+    from karpenter_tpu.api import NodePool
+
+    small_a = _zoned_type("small-8a", 8, "zone-a", 1.0)
+    big_b = _zoned_type("big-16b", 16, "zone-b", 2.0)
+    pool = NodePool(name="p")
+    s = Scheduler([pool], {"p": [small_a, big_b]})
+    term = PodAffinityTerm(
+        topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "g"),)
+    )
+    group = [
+        Pod(
+            labels={"pair": "g"},
+            requests=Resources(cpu=4),
+            pod_affinity=[term],
+            affinity_terms=[
+                (Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"]),),
+                (Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"]),),
+            ],
+        )
+        for _ in range(3)
+    ]
+    result = s.solve(group)
+    assert not result.unschedulable, result.unschedulable
+    assert result.node_count() == 1
+    assert {t.name for t in result.new_nodes[0].feasible_types} == {"big-16b"}
+
+
+def test_spread_pod_retries_next_allowed_zone(env):
+    """A DoNotSchedule zone spread must not wedge on the balance-optimal
+    zone when only another allowed zone has a fitting type: the zone walk
+    falls through to the next-balanced allowed domain."""
+    from karpenter_tpu.api import NodePool
+
+    tiny_a = _zoned_type("tiny-2a", 2, "zone-a", 0.5)
+    big_b = _zoned_type("big-16b2", 16, "zone-b", 2.0)
+    pool = NodePool(name="p")
+    s = Scheduler([pool], {"p": [tiny_a, big_b]})
+    c = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=L.LABEL_ZONE,
+        label_selector=(("app", "w"),),
+    )
+    pod = Pod(labels={"app": "w"}, requests=Resources(cpu=4), topology_spread=[c])
+    result = s.solve([pod])
+    assert not result.unschedulable, result.unschedulable
+    assert result.new_nodes[0].zone_options() == {"zone-b"}

@@ -723,15 +723,15 @@ class TestHybridSolve:
         for i in range(120):
             pods.append(Pod(requests=Resources(cpu=random.choice([1, 2, 4]))))
         for i in range(4):
-            # two variants sharing one selector but NODE-INEQUIVALENT
-            # (differing tolerations): the closure merge can't prove one
-            # feasibility row represents all, so the group stays oracle-only
+            # two variants sharing one selector but differing in PREFERRED
+            # affinity: relax cohesion breaks, so the closure merge refuses
+            # and the group stays oracle-only
             pods.append(
                 Pod(
                     labels={"app": "co", "variant": str(i % 2)},
                     requests=Resources(cpu=2),
-                    tolerations=(
-                        [Toleration(key="burst", value="yes", effect="NoSchedule")]
+                    preferred_affinity=(
+                        [Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])]
                         if i % 2
                         else []
                     ),
@@ -812,7 +812,10 @@ class TestCrossClassColocMerge:
         assert not tensor.unschedulable
         assert tensor.node_count() == 1
 
-    def test_node_inequivalent_closure_stays_oracle(self, setup):
+    def test_node_inequivalent_closure_compiles(self, setup):
+        """Members differing in tolerations (node-INEQUIVALENT) compile as
+        one macro unit whose feasibility row is the AND of the members' —
+        the whole group must land on one node, so intersection is exact."""
         pool, types = setup
         pods = [Pod(requests=Resources(cpu=1)) for _ in range(10)]
         group = self._group(0)
@@ -823,12 +826,61 @@ class TestCrossClassColocMerge:
                 ]
         pods += group
         oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        nodes = {
+            vn.name
+            for vn in tensor.new_nodes
+            for p in vn.pods
+            if p.labels.get("pair") == "host-0"
+        }
+        assert len(nodes) == 1
+        assert tensor.node_count() <= oracle.node_count() + 1
+
+    def test_inequivalent_closure_selector_intersects(self, setup):
+        """A member pinning the pool via node selector narrows the whole
+        group: every member lands on the selected pool's node."""
+        pool, types = setup
+        group = self._group(0)
+        group[1].node_selector = {L.LABEL_NODEPOOL: pool.name}
+        oracle, tensor, ts = both(pool, types, group)
+        assert ts.last_path == "tensor"
+        assert not tensor.unschedulable
+        assert tensor.node_count() == 1
+        assert tensor.new_nodes[0].pool.name == pool.name
+
+    def test_preference_differing_closure_stays_oracle(self, setup):
+        """Members differing in PREFERRED affinity keep the oracle: the
+        relaxation pass re-routes preference carriers individually, which
+        would tear a merged macro apart."""
+        pool, types = setup
+        group = self._group(0)
+        group[0].preferred_affinity = [
+            Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])
+        ]
+        pods = [Pod(requests=Resources(cpu=1)) for _ in range(10)] + group
+        oracle, tensor, ts = both(pool, types, pods)
         assert ts.last_path == "hybrid"
         assert not tensor.unschedulable
-        # the tensor half may right-size its node for the plain pods before
-        # the oracle continuation sees the group, costing at most the one
-        # node the co-located group needs
-        assert tensor.node_count() <= oracle.node_count() + 1
+        nodes = set()
+        for vn in tensor.new_nodes:
+            for p in vn.pods:
+                if p.labels.get("pair") == "host-0":
+                    nodes.add(vn.name)
+        assert len(nodes) == 1
+
+    def test_conflicting_inequivalent_closure_unschedulable(self, setup):
+        """Disjoint node selectors across members make the intersection
+        empty: the whole group reports unschedulable (gang semantics, same
+        as the oversized-group case)."""
+        pool, types = setup
+        group = self._group(0, n=4)
+        group[0].node_selector = {L.LABEL_NODEPOOL: pool.name}
+        group[1].node_selector = {L.LABEL_NODEPOOL: "nowhere"}
+        ts = TensorScheduler([pool], {pool.name: types})
+        res = ts.solve(group)
+        assert ts.last_path == "tensor"
+        assert len(res.unschedulable) == len(group)
 
     def test_closure_with_spread_member_stays_oracle(self, setup):
         """A closure member carrying a topology spread is not mergeable."""
@@ -902,7 +954,7 @@ class TestCrossClassColocMerge:
         raw units, not the compiled MiB scale."""
         pool, types = setup
         plain = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(6)]
-        # node-inequivalent closure (differing tolerations): oracle-only
+        # preference-differing closure: oracle-only (relax cohesion)
         term = PodAffinityTerm(
             topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "mem"),)
         )
@@ -910,8 +962,8 @@ class TestCrossClassColocMerge:
             Pod(
                 labels={"pair": "mem", "variant": str(i % 2)},
                 requests=Resources(cpu=0.25, memory="512Mi"),
-                tolerations=(
-                    [Toleration(key="burst", value="yes", effect="NoSchedule")]
+                preferred_affinity=(
+                    [Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])]
                     if i % 2
                     else []
                 ),
