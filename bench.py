@@ -41,7 +41,9 @@ BUDGET_MS = 200.0
 ZONES = ("zone-a", "zone-b", "zone-c")
 
 
-def _emit(metric: str, p50_ms: float, path: str, kernel: str, nodes: int) -> None:
+def _emit(
+    metric: str, p50_ms: float, path: str, kernel: str, nodes: int, **extra
+) -> None:
     print(
         json.dumps(
             {
@@ -52,6 +54,7 @@ def _emit(metric: str, p50_ms: float, path: str, kernel: str, nodes: int) -> Non
                 "path": path,
                 "kernel": kernel,
                 "nodes": nodes,
+                **extra,
             }
         ),
         flush=True,
@@ -81,6 +84,7 @@ def _run_scheduler_config(
     expect_kernel: str = "",
     allow_unplaced: int = 0,
     pack_fn=None,
+    expect_relaxed: int = 0,
 ) -> None:
     from karpenter_tpu.scheduling import TensorScheduler
 
@@ -93,6 +97,10 @@ def _run_scheduler_config(
         assert ts.last_path == expect_path, (metric, ts.last_path)
         if expect_kernel:
             assert ts.last_kernel == expect_kernel, (metric, ts.last_kernel)
+        if expect_relaxed:
+            assert ts.last_compile_relaxed >= expect_relaxed, (
+                metric, ts.last_compile_relaxed,
+            )
         placed = sum(len(n.pods) for n in result.new_nodes) + len(
             result.existing_placements
         )
@@ -105,7 +113,10 @@ def _run_scheduler_config(
         nodes_out[0] = len(result.new_nodes)
 
     p50 = _measure(solve_once)
-    _emit(metric, p50, ts.last_path, ts.last_kernel, nodes_out[0])
+    extra = (
+        {"relaxed": ts.last_compile_relaxed} if expect_relaxed else {}
+    )
+    _emit(metric, p50, ts.last_path, ts.last_kernel, nodes_out[0], **extra)
 
 
 # ---------------------------------------------------------------------------
@@ -377,6 +388,48 @@ def build_inequiv_coloc():
     return _coloc_problem(cross_class=True, node_equiv=False)
 
 
+def build_relax():
+    """Extra: the relaxation path — 30% of the batch carries soft
+    constraints that must relax: 2k pods preferring an impossible zone
+    (peeled, some keeping a satisfiable higher-priority preference), 1k
+    pods whose first node-affinity OR-term admits nothing (walked to the
+    second).  All of it resolves at COMPILE time on the feasibility rows
+    (ops/tensorize.py compile-time relaxation ladder), so the batch stays
+    on the tensor path."""
+    from karpenter_tpu.api import Pod, Requirement, Resources
+    from karpenter_tpu.api import labels as L
+    from karpenter_tpu.api.requirements import Op
+
+    pool, types, _ = build_problem()
+    sizes = [
+        Resources(cpu=0.5, memory="1Gi"),
+        Resources(cpu=1, memory="2Gi"),
+        Resources(cpu=2, memory="4Gi"),
+    ]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(7_000)]
+    for i in range(2_000):
+        prefs = [Requirement(L.LABEL_ZONE, Op.IN, ["zone-nowhere"])]
+        if i % 2:
+            # a satisfiable higher-priority preference the peel must KEEP
+            prefs = [
+                Requirement(L.LABEL_ZONE, Op.IN, [ZONES[i % len(ZONES)]]),
+            ] + prefs
+        pods.append(
+            Pod(requests=sizes[i % len(sizes)], preferred_affinity=prefs)
+        )
+    for i in range(1_000):
+        pods.append(
+            Pod(
+                requests=sizes[i % len(sizes)],
+                affinity_terms=[
+                    (Requirement(L.LABEL_ZONE, Op.IN, ["zone-nowhere"]),),
+                    (Requirement(L.LABEL_ZONE, Op.IN, [ZONES[i % len(ZONES)]]),),
+                ],
+            )
+        )
+    return [pool], {pool.name: types}, pods
+
+
 def build_multipool_spot():
     """Config 5: weighted multi-pool priority + spot-aware selection.
 
@@ -552,6 +605,14 @@ def main() -> None:
     _run_scheduler_config(
         "schedule_10k_inequiv_coloc_tensor_p50",
         pools, inventory, pods, expect_path="tensor",
+    )
+
+    # relaxation under load: 3k of 10k pods must drop/walk soft
+    # constraints — resolved on the compiled rows, not in the oracle
+    pools, inventory, pods = build_relax()
+    _run_scheduler_config(
+        "schedule_10k_relax_3k_soft_pods_p50",
+        pools, inventory, pods, expect_path="tensor", expect_relaxed=3_000,
     )
 
     # extra: the flagship solved THROUGH the solver sidecar (socket RPC,

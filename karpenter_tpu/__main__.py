@@ -78,6 +78,17 @@ def main(argv=None) -> int:
         "in-process kernel is used when omitted",
     )
     parser.add_argument(
+        "--leader-elect",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="take the store-backed Lease before reconciling; non-leaders "
+        "idle-watch (the chart runs two replicas on this basis). The "
+        "election coordinates replicas SHARING the durable store — any "
+        "real backend, where the store is the cluster apiserver; the "
+        "bundled simulation backend's store is in-process, so simulator "
+        "replicas are independent clusters and each leads its own",
+    )
+    parser.add_argument(
         "--dump-settings", action="store_true",
         help="print the resolved settings and exit",
     )
@@ -104,7 +115,19 @@ def main(argv=None) -> int:
         Clock(), shapes=generate_catalog()
     ).with_default_topology()
     kube = KubeStore()
-    operator = Operator(cloud, kube, settings=settings)
+    elector = None
+    if args.leader_elect:
+        import os
+        import socket
+
+        from karpenter_tpu.utils.leader import LeaderElector
+
+        elector = LeaderElector(
+            kube,
+            cloud.clock,
+            identity=f"{socket.gethostname()}-{os.getpid()}",
+        )
+    operator = Operator(cloud, kube, settings=settings, elector=elector)
 
     if args.solver_address:
         from karpenter_tpu.service.client import RemoteSolver
@@ -132,6 +155,10 @@ def main(argv=None) -> int:
         args.interval,
     )
     operator.run(interval_s=args.interval)
+    if elector is not None:
+        # graceful handoff: free the Lease so the standby takes over
+        # immediately instead of waiting out the expiry
+        elector.release()
     if server is not None:
         server.shutdown()
     if operator.tracer.enabled:

@@ -220,7 +220,8 @@ class Pod:
         return [()]
 
     def scheduling_requirements(
-        self, preferred: bool = False, term: int = 0
+        self, preferred: bool = False, term: int = 0,
+        keep_prefs: Optional[int] = None,
     ) -> Requirements:
         """nodeSelector + the ``term``-th node-affinity OR-term as one
         conjunction.
@@ -228,8 +229,12 @@ class Pod:
         With ``preferred`` the preferred-affinity terms merge in too:
         karpenter treats preferences as REQUIRED while simulating and
         relaxes them only when the pod proves unschedulable (reference
-        website v0.31 concepts/scheduling.md "preferences"; the relaxation
-        here is all-or-nothing rather than term-by-term)."""
+        website v0.31 concepts/scheduling.md "preferences").  The
+        relaxation is TERM-BY-TERM: ``keep_prefs`` keeps only the first N
+        preferences (list order is priority order, highest first), so the
+        oracle's peel walk (scheduler._attempt_ladder) drops one
+        preference per attempt from the tail — karpenter-core's
+        RelaxMinimal, with list position standing in for weight."""
         reqs = Requirements.from_labels(self.node_selector)
         terms = self.node_affinity_terms()
         for r in terms[min(term, len(terms) - 1)]:
@@ -237,7 +242,12 @@ class Pod:
         for r in self.volume_requirements:
             reqs.add(r)
         if preferred:
-            for r in self.preferred_affinity:
+            prefs = (
+                self.preferred_affinity
+                if keep_prefs is None
+                else self.preferred_affinity[:keep_prefs]
+            )
+            for r in prefs:
                 reqs.add(r)
         return reqs
 
@@ -274,8 +284,13 @@ class Pod:
             tuple(sorted(self.pod_affinity, key=repr)),
             tuple(sorted(self.labels.items())),
             self.namespace,
-            # appended LAST so consumers indexing sig[0..6] stay valid
-            tuple(sorted(map(repr, self.preferred_affinity))),
+            # appended LAST so consumers indexing sig[0..6] stay valid.
+            # preferred_affinity keeps LIST ORDER (not sorted): order is
+            # priority under term-by-term peeling (keep_prefs slices the
+            # list), so pods with the same preferences in different order
+            # relax differently and must not share a class or a try_add
+            # label-scan cache entry
+            tuple(map(repr, self.preferred_affinity)),
             tuple(sorted(map(repr, self.volume_requirements))),
             tuple(tuple(map(repr, t)) for t in self.affinity_terms),
         )

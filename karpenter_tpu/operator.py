@@ -56,7 +56,14 @@ class Operator:
         clock: Optional[Clock] = None,
         registry: Registry = REGISTRY,
         batch_windows: Optional[dict] = None,
+        elector=None,
     ):
+        # leader election (utils/leader.py): with an elector, every tick
+        # first acquires-or-renews the Lease and a non-leading replica
+        # skips reconciling entirely — the reference gets the same from
+        # controller-runtime leader election over a coordination/v1 Lease
+        # (its chart ships replicas: 2 on that basis)
+        self.elector = elector
         self.cloud = cloud
         self.kube = kube
         self.settings = settings or Settings()
@@ -197,20 +204,45 @@ class Operator:
 
     def reconcile_once(self) -> None:
         """One tick of every control loop, in a stable order: status
-        resolution, provisioning, lifecycle, events, disruption, cleanup."""
-        self._reconcile("nodeclass", self.node_class_controller)
-        self._reconcile("provisioner", self.provisioner)
-        self._reconcile("lifecycle", self.lifecycle)
+        resolution, provisioning, lifecycle, events, disruption, cleanup.
+
+        With an elector, a replica that does not hold the lease skips the
+        tick (idle-watch): two live replicas must never both reconcile, or
+        every NodeClaim would double-launch."""
+        if self.elector is not None:
+            leading = self.elector.acquire_or_renew()
+            self.registry.set(
+                "karpenter_leader_election_leading",
+                1.0 if leading else 0.0,
+                {"identity": self.elector.identity},
+            )
+            if not leading:
+                return
+
+        sequence = [
+            ("nodeclass", self.node_class_controller),
+            ("provisioner", self.provisioner),
+            ("lifecycle", self.lifecycle),
+        ]
         if self.interruption is not None:
-            self._reconcile("interruption", self.interruption)
-        self._reconcile("disruption", self.disruption)
-        self._reconcile("termination", self.termination)
-        # adopt before GC lists, so no race to reap
-        self._reconcile("link", self.link)
-        self._reconcile("garbagecollection", self.garbage_collection)
-        self._reconcile("tagging", self.tagging)
-        self._reconcile("metrics_state", self.metrics_state)
-        self._reconcile("consistency", self.consistency)
+            sequence.append(("interruption", self.interruption))
+        sequence += [
+            ("disruption", self.disruption),
+            ("termination", self.termination),
+            # adopt before GC lists, so no race to reap
+            ("link", self.link),
+            ("garbagecollection", self.garbage_collection),
+            ("tagging", self.tagging),
+            ("metrics_state", self.metrics_state),
+            ("consistency", self.consistency),
+        ]
+        for name, controller in sequence:
+            # mid-tick abdication: the background renewal thread flips
+            # `leading` False the moment the lease is lost, and the tick
+            # stops before the next controller mutates anything
+            if self.elector is not None and not self.elector.leading:
+                return
+            self._reconcile(name, controller)
         # 12h pricing refresh (reference pricing/controller.go:39-41)
         if self.clock.now() - self._pricing_updated_at >= PRICING_UPDATE_PERIOD:
             if not self.settings.isolated_vpc:
@@ -220,6 +252,9 @@ class Operator:
 
     def run(self, interval_s: float = 1.0) -> None:
         """Blocking controller-manager loop for real deployments."""
+        if self.elector is not None:
+            # keep the lease fresh through ticks longer than its duration
+            self.elector.start_background_renewal(self._stop)
         while not self._stop.is_set():
             self.reconcile_once()
             self.clock.sleep(interval_s)

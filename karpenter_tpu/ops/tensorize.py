@@ -159,17 +159,32 @@ def _pool_feas(
     sig: Tuple,
     pname: str,
     pools_by_name: Dict[str, NodePool],
+    term: int = 0,
+    keep_prefs: Optional[int] = None,
 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Memoized per-(signature, pool) compatibility vectors over the pool's
     unique types / zones / capacity types.  Zone PINS are intentionally not
     part of the key: config rows exist only for a type's actual offerings,
-    so pinning composes exactly as a per-row zone filter on top of these."""
+    so pinning composes exactly as a per-row zone filter on top of these.
+    ``(term, keep_prefs)`` select a relaxation step of the ladder
+    (compile-time peel, see compile_problem) — the default strict shape
+    keeps the compact two-part key."""
     memo = catalog.feas_memo
-    key = (sig, pname)
+    key = (
+        (sig, pname)
+        if term == 0 and keep_prefs is None
+        else (sig, pname, term, keep_prefs)
+    )
     ent = memo.get(key, _MEMO_MISS)
     if ent is _MEMO_MISS:
         pr = catalog.pool_rows[pname]
-        merged = _merge_pool(rep, rep.scheduling_requirements(preferred=True), pools_by_name[pname])
+        merged = _merge_pool(
+            rep,
+            rep.scheduling_requirements(
+                preferred=True, term=term, keep_prefs=keep_prefs
+            ),
+            pools_by_name[pname],
+        )
         if merged is None:
             ent = None
         else:
@@ -335,6 +350,10 @@ class CompiledProblem:
     sig_used0: np.ndarray  # [S, E] int32 — tracked-signature counts per node
     n_track_slots: int = 1
     unsupported_reason: str = ""
+    # pods whose class was relaxed at COMPILE time (preference peel /
+    # OR-term walk over globally-empty strict rows) — observability for
+    # the solver's last_compile_relaxed and the bench's relax line
+    compile_relaxed: int = 0
 
     @property
     def supported(self) -> bool:
@@ -1256,18 +1275,28 @@ def compile_problem(
     pools_by_name = {p.name: p for p in pools}
     row_memo: Dict[Tuple, np.ndarray] = {}
 
-    def _sig_row(sig: Tuple, rep: Pod, zone_pin: str) -> np.ndarray:
-        mkey = (sig, zone_pin)
+    def _sig_row(
+        sig: Tuple,
+        rep: Pod,
+        zone_pin: str,
+        term: int = 0,
+        keep: Optional[int] = None,
+    ) -> np.ndarray:
+        mkey = (sig, zone_pin, term, keep)
         row = row_memo.get(mkey)
         if row is not None:
             return row
-        sched = rep.scheduling_requirements(preferred=True)
+        sched = rep.scheduling_requirements(
+            preferred=True, term=term, keep_prefs=keep
+        )
         if zone_pin:
             sched = Requirements(iter(sched))
             sched.add(Requirement(L.LABEL_ZONE, Op.IN, [zone_pin]))
         row = np.zeros(C, dtype=bool)
         for pname, pr in catalog.pool_rows.items():
-            ent = _pool_feas(catalog, rep, sig, pname, pools_by_name)
+            ent = _pool_feas(
+                catalog, rep, sig, pname, pools_by_name, term, keep
+            )
             if ent is None:
                 continue
             type_ok, zone_ok, ct_ok = ent
@@ -1281,11 +1310,50 @@ def compile_problem(
         row_memo[mkey] = row
         return row
 
+    def _combined_row(
+        pairs: Tuple, zone_pin: str, term: int, keep: Optional[int]
+    ) -> np.ndarray:
+        row = _sig_row(pairs[0][0], pairs[0][1], zone_pin, term, keep)
+        for s, r in pairs[1:]:
+            row = row & _sig_row(s, r, zone_pin, term, keep)
+        return row
+
+    compile_relaxed = 0
     for (sigs, zone_pin), g_idx in classes_by_sig.items():
         pairs = sig_reps_of[(sigs, zone_pin)]
-        row = _sig_row(pairs[0][0], pairs[0][1], zone_pin)
-        for s, r in pairs[1:]:
-            row = row & _sig_row(s, r, zone_pin)
+        row = _combined_row(pairs, zone_pin, 0, None)
+        if not row.any():
+            # compile-time relaxation: when the STRICT shape admits no
+            # config anywhere, walk the same (OR-term x preference-peel)
+            # ladder the oracle walks per pod (scheduler._attempt_ladder)
+            # — but once per class, on the compiled rows, so a
+            # preference-heavy batch stays on the tensor path instead of
+            # draining through the Python continuation.  Global row
+            # emptiness is exactly the oracle's "proves unschedulable"
+            # for these shapes: no node (new or live) admits the pod, so
+            # the oracle would relax too.
+            # rep0 speaks for every member: a multi-signature class is a
+            # co-location macro, and the merge's relax-cohesion gate
+            # (_coloc_component_mergeable) requires identical sig[7]
+            # (preferences) and sig[9] (OR-terms) across members
+            rep0 = pairs[0][1]
+            n_terms = len(rep0.node_affinity_terms())
+            n_prefs = len(rep0.preferred_affinity)
+            for ti in range(n_terms):
+                keeps = [None] if ti else []
+                keeps += list(range(n_prefs - 1, -1, -1))
+                found = False
+                for keep in keeps:
+                    cand = _combined_row(pairs, zone_pin, ti, keep)
+                    if cand.any():
+                        row = cand
+                        compile_relaxed += sum(
+                            len(classes[g].pods) for g in g_idx
+                        )
+                        found = True
+                        break
+                if found:
+                    break
         feas[g_idx] = row
 
     req_mat = (
@@ -1351,6 +1419,7 @@ def compile_problem(
         sig_used0=sig_used0,
         n_track_slots=S,
         unsupported_reason=reason,
+        compile_relaxed=compile_relaxed,
     )
 
 

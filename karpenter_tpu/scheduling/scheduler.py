@@ -277,13 +277,16 @@ class VirtualNode:
         preferred: bool = True,
         term: int = 0,
         reserve: Optional[Resources] = None,
+        keep_prefs: Optional[int] = None,
     ) -> bool:
         """``reserve``: a co-location ANCHOR reserves its whole group's
         total — the node must admit the sum (and its type set narrows to
         types that hold it) while only the anchor's own requests commit.
         Prevents anchoring a group on a nearly-full node that strands the
         followers (kube-scheduler would strand them too, but a fresh node
-        that holds everyone is the better pack when one exists)."""
+        that holds everyone is the better pack when one exists).
+        ``keep_prefs``: the preference-peel attempt (see
+        Pod.scheduling_requirements)."""
         if not tolerates_all(pod.tolerations, self.pool.taints):
             return False
         if not self._headroom_admits(reserve if reserve is not None else pod.requests):
@@ -299,7 +302,9 @@ class VirtualNode:
             if not (NEW_DOMAIN in host_allowed and not self.pods):
                 return False
         reqs = Requirements(iter(self.requirements))
-        for r in pod.scheduling_requirements(preferred=preferred, term=term):
+        for r in pod.scheduling_requirements(
+            preferred=preferred, term=term, keep_prefs=keep_prefs
+        ):
             reqs.add(r)
         if reqs.is_unsatisfiable():
             return False
@@ -346,10 +351,10 @@ class VirtualNode:
                 # the key must cover every sig component that feeds the
                 # merged requirements: node_selector, required affinity,
                 # preferences, volume-derived reqs, OR-terms — plus which
-                # attempt this is
+                # attempt (term, peel step) this is
                 cache_key = (
                     sig[0], sig[1], sig[7], sig[8], sig[9],
-                    preferred, term, zc,
+                    preferred, term, keep_prefs, zc,
                 )
             # the cached half (label-compatible candidate types) depends
             # only on the merged reqs, so a reserving anchor shares the
@@ -448,6 +453,7 @@ class ExistingNode:
         preferred: bool = True,
         term: int = 0,
         reserve: Optional[Resources] = None,
+        keep_prefs: Optional[int] = None,
     ) -> bool:
         if self.state.marked_for_deletion() or (
             self.state.node is not None and self.state.node.cordoned
@@ -465,7 +471,9 @@ class ExistingNode:
         if self._label_reqs is None:
             self._label_reqs = Requirements.from_labels(self.state.labels)
         if not self._label_reqs.compatible(
-            pod.scheduling_requirements(preferred=preferred, term=term)
+            pod.scheduling_requirements(
+                preferred=preferred, term=term, keep_prefs=keep_prefs
+            )
         ):
             return False
         host_allowed = topology.allowed_domains(pod, HOSTNAME, preferred, term)
@@ -622,35 +630,42 @@ class Scheduler:
         self, pod: Pod, result: SchedulingResult, reserve: Optional[Resources]
     ) -> Optional[str]:
         """Node-affinity OR-terms go in order, first that works (reference
-        scheduling.md:230-259); within each term, preferences AND
-        ScheduleAnyway spreads are REQUIRED on the first attempt and
-        relaxed (all at once) only when the pod proves unschedulable —
-        karpenter-core's relaxation.  With a gang reserve, every reserved
-        attempt (strict, then relaxed) runs BEFORE the plain fallbacks:
-        hostname affinity is a HARD constraint, so keeping the gang whole
-        on a relaxed placement beats satisfying a soft preference and
-        stranding the followers."""
-        relaxable = bool(pod.preferred_affinity) or any(
+        scheduling.md:230-259).  Within each term, every soft input is
+        REQUIRED on the first attempt, then relaxed incrementally —
+        karpenter-core's RelaxMinimal: preferences peel ONE AT A TIME from
+        the lowest priority (list tail), so a pod with one unsatisfiable
+        and one satisfiable preference keeps the satisfiable one;
+        ScheduleAnyway spreads drop last, after every preference.  With a
+        gang reserve, every reserved attempt runs BEFORE the plain
+        fallbacks: hostname affinity is a HARD constraint, so keeping the
+        gang whole on a relaxed placement beats satisfying a soft
+        preference and stranding the followers."""
+        n_prefs = len(pod.preferred_affinity)
+        relax_spreads = any(
             c.when_unsatisfiable != "DoNotSchedule"
             for c in pod.topology_spread
         )
+        # (preferred, keep_prefs) per attempt: strict, then peel one
+        # preference per step, then (only when soft spreads exist —
+        # keep_prefs=0 already covers "no preferences") the fully-relaxed
+        # attempt that also drops ScheduleAnyway spreads
+        attempts = [(True, None)]
+        attempts += [(True, k) for k in range(n_prefs - 1, -1, -1)]
+        if relax_spreads:
+            attempts += [(False, None)]
         reason = None
         n_terms = len(pod.node_affinity_terms())
         if reserve is not None:
-            # every reserved attempt — all OR-terms, strict then relaxed —
+            # every reserved attempt — all OR-terms, the full relax walk —
             # before ANY plain fallback: a later term that holds the whole
             # gang beats an earlier term that strands followers
             for ti in range(n_terms):
-                if self._place(pod, result, True, ti, reserve) is None:
-                    return None
-                if relaxable and self._place(pod, result, False, ti, reserve) is None:
-                    return None
+                for preferred, keep in attempts:
+                    if self._place(pod, result, preferred, ti, reserve, keep) is None:
+                        return None
         for ti in range(n_terms):
-            reason = self._place(pod, result, True, ti)
-            if reason is None:
-                return None
-            if relaxable:
-                reason = self._place(pod, result, False, ti)
+            for preferred, keep in attempts:
+                reason = self._place(pod, result, preferred, ti, None, keep)
                 if reason is None:
                     return None
         return reason
@@ -728,13 +743,14 @@ class Scheduler:
         preferred: bool,
         term: int = 0,
         reserve: Optional[Resources] = None,
+        keep_prefs: Optional[int] = None,
     ) -> Optional[str]:
         """One placement attempt; None on success, else the reason."""
-        if self._schedule_existing(pod, result, preferred, term, reserve):
+        if self._schedule_existing(pod, result, preferred, term, reserve, keep_prefs):
             return None
-        if self._schedule_open_vnode(pod, result, preferred, term, reserve):
+        if self._schedule_open_vnode(pod, result, preferred, term, reserve, keep_prefs):
             return None
-        return self._schedule_new_vnode(pod, result, preferred, term, reserve)
+        return self._schedule_new_vnode(pod, result, preferred, term, reserve, keep_prefs)
 
     def _schedule_existing(
         self,
@@ -743,12 +759,13 @@ class Scheduler:
         preferred: bool = True,
         term: int = 0,
         reserve: Optional[Resources] = None,
+        keep_prefs: Optional[int] = None,
     ) -> bool:
         host_allowed = self.topology.allowed_domains(pod, HOSTNAME, preferred, term)
         for en in self.existing:
             if host_allowed is not None and en.name not in host_allowed:
                 continue
-            if en.try_add(pod, self.topology, preferred, term, reserve):
+            if en.try_add(pod, self.topology, preferred, term, reserve, keep_prefs):
                 result.existing_placements[pod.key()] = en.name
                 return True
         return False
@@ -760,6 +777,7 @@ class Scheduler:
         preferred: bool = True,
         term: int = 0,
         reserve: Optional[Resources] = None,
+        keep_prefs: Optional[int] = None,
     ) -> bool:
         # two cheap prefilters before any try_add work: hostname-constrained
         # pods (co-location followers, anti-affinity singletons) admit only
@@ -797,7 +815,7 @@ class Scheduler:
                 or used.get("pods") + pods_need > hi_pods + 1e-9
             ):
                 continue
-            if vn.try_add(pod, self.topology, preferred, term, reserve):
+            if vn.try_add(pod, self.topology, preferred, term, reserve, keep_prefs):
                 placed = True
                 break
         if full is not None:
@@ -811,6 +829,7 @@ class Scheduler:
         preferred: bool = True,
         term: int = 0,
         reserve: Optional[Resources] = None,
+        keep_prefs: Optional[int] = None,
     ) -> Optional[str]:
         reason = "no nodepool matched pod constraints"
         for pool in self.pools:
@@ -819,7 +838,7 @@ class Scheduler:
                 reason = f"nodepool {pool.name} has no instance types"
                 continue
             vn = self._new_vnode(pool, types)
-            if vn.try_add(pod, self.topology, preferred, term, reserve):
+            if vn.try_add(pod, self.topology, preferred, term, reserve, keep_prefs):
                 result.new_nodes.append(vn)
                 self._scan_nodes.append(vn)
                 return None

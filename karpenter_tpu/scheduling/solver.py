@@ -96,6 +96,7 @@ class TensorScheduler:
         self.pack_fn = pack_fn
         self.last_path = ""  # "tensor" | "oracle" | "hybrid" (observability)
         self.last_kernel = ""  # "pallas" | "scan" | "" (oracle)
+        self.last_compile_relaxed = 0  # pods relaxed on the compiled rows
         # Prebuilt config-axis tensors — the analogue of the reference's
         # seqnum-keyed instance-type cache (instancetype.go:97-104).
         # Invalidation is identity-based: the instance-type provider returns
@@ -161,6 +162,7 @@ class TensorScheduler:
         exotic constraint no longer sends the whole 10k-pod batch to the
         O(pods x nodes) Python loop — only its coupled closure goes."""
         pods = list(pods)
+        self.last_compile_relaxed = 0  # per-solve; oracle paths leave it 0
         with TRACER.span("solver.partition"):
             sup_groups, unsupported, _reason = partition_groups(
                 pods, existing=self.existing
@@ -357,6 +359,10 @@ class TensorScheduler:
         if not prob.supported:
             return None
         self.last_path = "tensor"
+        # compile-time relaxation observability (bench relax line): pods
+        # whose class had its preferences peeled / OR-terms walked on the
+        # compiled rows rather than in the oracle continuation
+        self.last_compile_relaxed = prob.compile_relaxed
         if self.pack_fn is None:
             self.pack_fn = default_pack_fn()
         # the XLA timeline must stay open through fetch: pack_fn only

@@ -11,6 +11,7 @@ test role matches controller-runtime envtest in the reference suites
 from __future__ import annotations
 
 import itertools
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -92,6 +93,11 @@ class KubeStore:
         self.events: List[tuple] = []  # (kind, reason, obj_name, message)
         self._watchers: List[Callable[[str, str, object], None]] = []
         self._seq = itertools.count(1)
+        # coordination.k8s.io/v1 Leases (utils/leader.py): the only store
+        # surface touched concurrently by competing replicas, so its
+        # compare-and-swap runs under a lock
+        self.leases: Dict[str, "Lease"] = {}
+        self._lease_lock = threading.Lock()
 
     # -- watch hooks ---------------------------------------------------------
     def watch(self, fn: Callable[[str, str, object], None]) -> None:
@@ -249,6 +255,66 @@ class KubeStore:
             if p.is_daemonset:
                 seen.setdefault(p.constraint_signature(), p)
         return list(seen.values())
+
+    # -- leases --------------------------------------------------------------
+    def try_acquire_lease(
+        self, name: str, holder: str, now: float, duration_s: float
+    ) -> bool:
+        """Atomic acquire-or-renew (the coordination/v1 Lease update the
+        reference's controller-runtime election performs): succeeds when
+        the lease is free, expired, or already held by ``holder``.
+        Watcher callbacks fire AFTER the lock is released — the lock is
+        non-reentrant and a competing replica's election must not stall
+        on a slow watcher."""
+        from karpenter_tpu.utils.leader import Lease
+
+        acquired = None
+        with self._lease_lock:
+            lease = self.leases.get(name)
+            if (
+                lease is not None
+                and lease.holder
+                and lease.holder != holder
+                and now - lease.renewed_at <= lease.duration_s
+            ):
+                return False  # held by a live other replica
+            if lease is None or lease.holder != holder:
+                lease = Lease(
+                    name=name,
+                    holder=holder,
+                    acquired_at=now,
+                    duration_s=duration_s,
+                )
+                self.leases[name] = lease
+                acquired = lease
+            lease.renewed_at = now
+            lease.duration_s = duration_s
+        if acquired is not None:
+            self._notify("Lease", "acquire", acquired)
+        return True
+
+    def renew_lease(self, name: str, holder: str, now: float) -> bool:
+        """Renew-ONLY: succeeds only while ``holder`` still holds the
+        lease.  The background renewal thread uses this so it can never
+        re-acquire a lease the graceful shutdown path just released."""
+        with self._lease_lock:
+            lease = self.leases.get(name)
+            if lease is None or lease.holder != holder:
+                return False
+            lease.renewed_at = now
+            return True
+
+    def release_lease(self, name: str, holder: str) -> None:
+        """Graceful give-up: only the current holder may free the lease."""
+        released = None
+        with self._lease_lock:
+            lease = self.leases.get(name)
+            if lease is not None and lease.holder == holder:
+                lease.holder = ""
+                lease.renewed_at = 0.0
+                released = lease
+        if released is not None:
+            self._notify("Lease", "release", released)
 
     # -- events --------------------------------------------------------------
     def record_event(self, kind: str, reason: str, obj_name: str, message: str = ""):

@@ -1,0 +1,104 @@
+"""Lease-based leader election.
+
+The reference runs two controller replicas behind controller-runtime's
+leader election (core operator wires it; charts/karpenter/templates/
+deployment.yaml ships ``replicas: 2`` + a PodDisruptionBudget, and the
+election uses a coordination.k8s.io/v1 Lease).  Here the Lease lives in
+the KubeStore — the same single source of durable truth the reference
+keeps in the kube-apiserver — and the elector runs the client-go loop:
+acquire when the lease is free or expired, renew while held, retry every
+``RETRY_PERIOD`` otherwise.  Non-leaders keep their caches warm by
+watching the store but skip every reconcile (operator.py:reconcile_once).
+
+Timings mirror controller-runtime's defaults (LeaseDuration 15s,
+RetryPeriod 2s): a crashed leader stops renewing and the standby takes
+over within one lease duration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# controller-runtime defaults (leaderelection.go)
+LEASE_DURATION_S = 15.0
+RETRY_PERIOD_S = 2.0
+LEASE_NAME = "karpenter-tpu-leader-election"
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease projection."""
+
+    name: str
+    holder: str = ""
+    acquired_at: float = 0.0
+    renewed_at: float = 0.0
+    duration_s: float = LEASE_DURATION_S
+
+
+class LeaderElector:
+    """One replica's view of the election.
+
+    ``acquire_or_renew`` is the per-tick gate: True while this identity
+    holds (or just took) the lease.  Transitions are observable through
+    ``leading`` and the ``karpenter_leader_election_leading`` gauge the
+    operator exports.
+    """
+
+    def __init__(
+        self,
+        kube,
+        clock,
+        identity: str,
+        lease_name: str = LEASE_NAME,
+        lease_duration_s: float = LEASE_DURATION_S,
+    ):
+        self.kube = kube
+        self.clock = clock
+        self.identity = identity
+        self.lease_name = lease_name
+        self.lease_duration_s = lease_duration_s
+        self.leading = False
+
+    def acquire_or_renew(self) -> bool:
+        """Try to take or keep the lease; updates ``leading``."""
+        now = self.clock.now()
+        was = self.leading
+        self.leading = self.kube.try_acquire_lease(
+            self.lease_name, self.identity, now, self.lease_duration_s
+        )
+        if self.leading and not was:
+            self.kube.record_event(
+                "Lease", "LeaderElected", self.lease_name, self.identity
+            )
+        return self.leading
+
+    def release(self) -> None:
+        """Graceful handoff: free the lease so the standby can take it
+        immediately instead of waiting out the expiry."""
+        if self.leading:
+            self.kube.release_lease(self.lease_name, self.identity)
+            self.leading = False
+
+    def start_background_renewal(self, stop) -> None:
+        """Renew every RETRY_PERIOD while leading, on a daemon thread, so
+        a reconcile tick longer than the lease duration does not silently
+        expire the lease under a healthy leader (controller-runtime
+        renews on the same cadence).  On a failed renewal — the lease was
+        lost — ``leading`` flips False, and the operator abdicates at its
+        next between-controller check (operator.reconcile_once).  Only a
+        WEDGED leader (one that stops renewing entirely) is fenced by
+        expiry, matching the reference's failure model."""
+        import threading
+
+        def renew() -> None:
+            while not stop.wait(RETRY_PERIOD_S):
+                if self.leading:
+                    # renew-ONLY (never acquire): a release() racing this
+                    # thread must not see the freed lease re-taken by the
+                    # exiting process
+                    self.leading = self.kube.renew_lease(
+                        self.lease_name, self.identity, self.clock.now()
+                    )
+
+        threading.Thread(target=renew, daemon=True).start()

@@ -1,0 +1,134 @@
+"""Leader election: two replicas over one store, one reconciler.
+
+The reference ships two controller replicas behind controller-runtime
+leader election (charts/karpenter/templates/deployment.yaml + core
+operator); here the Lease lives in the KubeStore and the elector gates
+Operator.reconcile_once (utils/leader.py).
+"""
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.testing import FAST_BATCH_WINDOWS, Environment
+from karpenter_tpu.utils.leader import LEASE_DURATION_S, LeaderElector
+
+
+def _two_replicas():
+    env = Environment()
+    env.default_node_class()
+    env.default_node_pool()
+    a = LeaderElector(env.kube, env.clock, "replica-a")
+    b = LeaderElector(env.kube, env.clock, "replica-b")
+    env.operator.elector = a
+    reg_b = Registry()
+    op_b = Operator(
+        env.cloud,
+        env.kube,
+        settings=env.settings,
+        clock=env.clock,
+        registry=reg_b,
+        batch_windows=FAST_BATCH_WINDOWS,
+        elector=b,
+    )
+    return env, a, b, op_b, reg_b
+
+
+class TestLease:
+    def test_acquire_renew_exclusion_expiry(self):
+        env = Environment()
+        now = env.clock.now()
+        assert env.kube.try_acquire_lease("l", "a", now, 15.0)
+        # renewal by the holder succeeds; a competitor is rejected
+        assert env.kube.try_acquire_lease("l", "a", now + 5, 15.0)
+        assert not env.kube.try_acquire_lease("l", "b", now + 10, 15.0)
+        # after expiry (last renewal + duration) the competitor takes it
+        assert env.kube.try_acquire_lease("l", "b", now + 5 + 15.1, 15.0)
+        assert env.kube.leases["l"].holder == "b"
+
+    def test_release_only_by_holder(self):
+        env = Environment()
+        now = env.clock.now()
+        env.kube.try_acquire_lease("l", "a", now, 15.0)
+        env.kube.release_lease("l", "b")  # non-holder: no-op
+        assert env.kube.leases["l"].holder == "a"
+        env.kube.release_lease("l", "a")
+        assert env.kube.leases["l"].holder == ""
+        assert env.kube.try_acquire_lease("l", "b", now + 0.1, 15.0)
+
+
+class TestTwoReplicas:
+    def test_only_leader_reconciles(self):
+        env, a, b, op_b, reg_b = _two_replicas()
+        env.kube.put_pod(Pod(requests=Resources(cpu=1, memory="2Gi")))
+        env.settle()  # replica A ticks: takes the lease, provisions
+        assert a.leading
+        claims_after_a = len(env.kube.node_claims)
+        assert claims_after_a >= 1
+        # replica B ticks while A holds the lease: it must not reconcile
+        # (no controller counters) and must not double-launch
+        op_b.reconcile_once()
+        assert not b.leading
+        assert len(env.kube.node_claims) == claims_after_a
+        assert not reg_b.counters.get("karpenter_controller_reconcile_total")
+        assert reg_b.gauges["karpenter_leader_election_leading"][
+            (("identity", "replica-b"),)
+        ] == 0.0
+
+    def test_standby_takes_over_after_leader_crash(self):
+        env, a, b, op_b, reg_b = _two_replicas()
+        env.step()  # A leads
+        assert a.leading
+        # A crashes: it stops renewing.  Within the lease duration the
+        # standby still defers...
+        env.clock.step(LEASE_DURATION_S / 2)
+        op_b.reconcile_once()
+        assert not b.leading
+        # ...and past the expiry it takes over and serves new work (the
+        # kubelet binds against the new leader's nominations)
+        env.clock.step(LEASE_DURATION_S + 1)
+        env.cluster = op_b.cluster
+        env.kube.put_pod(Pod(requests=Resources(cpu=1, memory="2Gi")))
+        for _ in range(12):
+            env.clock.step(2.0)
+            env.kubelet.step()
+            op_b.reconcile_once()
+            env.kubelet.step()
+            if not env.kube.pending_pods():
+                break
+        assert b.leading
+        assert not env.kube.pending_pods()
+        assert reg_b.counters.get("karpenter_controller_reconcile_total")
+
+    def test_graceful_release_hands_over_immediately(self):
+        env, a, b, op_b, reg_b = _two_replicas()
+        env.step()
+        assert a.leading
+        a.release()  # SIGTERM path (__main__.py frees the lease)
+        op_b.reconcile_once()  # no expiry wait needed
+        assert b.leading
+
+    def test_mid_tick_abdication_stops_remaining_controllers(self):
+        """The background renewal thread flips `leading` False when the
+        lease is lost; the tick must stop before the next controller
+        mutates anything."""
+        env, a, b, op_b, reg_b = _two_replicas()
+        env.step()  # A leads
+        orig = env.operator.provisioner.reconcile
+
+        def lose_lease_during_provisioning():
+            orig()
+            a.leading = False  # what the renewal thread does on loss
+
+        env.operator.provisioner.reconcile = lose_lease_during_provisioning
+
+        def count(name):
+            series = env.registry.counters.get(
+                "karpenter_controller_reconcile_total", {}
+            )
+            return series.get((("controller", name),), 0)
+
+        prov_before = count("provisioner")
+        term_before = count("termination")
+        env.operator.reconcile_once()
+        assert count("provisioner") == prov_before + 1  # ran, then lost
+        assert count("termination") == term_before  # never reached

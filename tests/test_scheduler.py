@@ -552,3 +552,82 @@ def test_spread_pod_retries_next_allowed_zone(env):
     result = s.solve([pod])
     assert not result.unschedulable, result.unschedulable
     assert result.new_nodes[0].zone_options() == {"zone-b"}
+
+
+def test_preference_peeling_keeps_satisfiable_preference(env):
+    """Term-by-term relaxation (karpenter-core RelaxMinimal): a pod whose
+    lower-priority preference is unsatisfiable keeps the satisfiable
+    higher-priority one instead of losing both."""
+    s = make_scheduler(env)
+    pod = Pod(
+        requests=Resources(cpu=1),
+        preferred_affinity=[
+            Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"]),       # satisfiable
+            Requirement(L.LABEL_ZONE, Op.IN, ["zone-nowhere"]),  # not
+        ],
+    )
+    result = s.solve([pod])
+    assert not result.unschedulable
+    # the peel dropped only the impossible preference: zone-b is honored
+    assert result.new_nodes[0].zone_options() == {"zone-b"}
+
+
+def test_preference_peeling_drops_all_when_none_satisfiable(env):
+    s = make_scheduler(env)
+    pod = Pod(
+        requests=Resources(cpu=1),
+        preferred_affinity=[
+            Requirement(L.LABEL_ZONE, Op.IN, ["zone-nope"]),
+            Requirement(L.LABEL_ZONE, Op.IN, ["zone-nah"]),
+        ],
+    )
+    result = s.solve([pod])
+    assert not result.unschedulable
+    assert len(result.new_nodes[0].zone_options()) >= 2  # unpinned
+
+
+def test_peeling_keeps_soft_spread_while_dropping_preference(env):
+    """ScheduleAnyway spreads relax LAST: a pod dropping an impossible
+    preference still honors its soft spread on that attempt."""
+    s = make_scheduler(env)
+    c = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=L.LABEL_ZONE,
+        label_selector=(("app", "w"),),
+        when_unsatisfiable="ScheduleAnyway",
+    )
+    pods = [
+        Pod(
+            labels={"app": "w"},
+            requests=Resources(cpu=1),
+            topology_spread=[c],
+            preferred_affinity=[Requirement(L.LABEL_ZONE, Op.IN, ["zone-nope"])],
+        )
+        for _ in range(6)
+    ]
+    result = s.solve(pods)
+    assert not result.unschedulable
+    counts = {}
+    for vn in result.new_nodes:
+        zones = vn.zone_options()
+        assert len(zones) == 1
+        counts[next(iter(zones))] = counts.get(next(iter(zones)), 0) + len(vn.pods)
+    assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+
+def test_preference_order_distinct_pods_do_not_share_cache(env):
+    """List order is priority under peeling, so pods with the same
+    preferences in different order must not share a class or a try_add
+    cache entry: each keeps ITS OWN satisfiable higher-priority pick."""
+    s = make_scheduler(env)
+    bad = Requirement(L.LABEL_ZONE, Op.IN, ["zone-nowhere"])
+    good = Requirement(L.LABEL_ZONE, Op.IN, ["zone-b"])
+    p0 = Pod(requests=Resources(cpu=1), preferred_affinity=[bad, good])
+    p1 = Pod(requests=Resources(cpu=1), preferred_affinity=[good, bad])
+    p2 = Pod(requests=Resources(cpu=1), preferred_affinity=[bad, good])
+    result = s.solve([p0, p2, p1])
+    assert not result.unschedulable
+    # p1 keeps zone-b (its higher-priority pref); p0/p2 peel down to no
+    # preferences and pack beside it — one node suffices
+    assert result.node_count() == 1
+    assert result.new_nodes[0].zone_options() == {"zone-b"}
