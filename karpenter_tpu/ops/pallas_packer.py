@@ -345,8 +345,21 @@ def run_pack_pallas(
     """Drop-in for run_pack via the fused Pallas kernel.
 
     ``interpret`` defaults to True off-TPU (tests on the virtual CPU mesh
-    run the same kernel through the Pallas interpreter).
-    """
+    run the same kernel through the Pallas interpreter)."""
+    out, ctx = dispatch_pack_pallas(prob, k_slots, objective, interpret)
+    return finish_pack_pallas(out, ctx)
+
+
+def dispatch_pack_pallas(
+    prob: CompiledProblem, k_slots: int = 0, objective: str = "nodes",
+    interpret: bool | None = None,
+):
+    """ENQUEUE one fused-kernel solve and return (device outputs, host
+    context) without synchronizing — `finish_pack_pallas` performs the
+    one fetch.  Split out so the bench can chain dispatches back-to-back
+    and measure the marginal per-solve cost with the link round trip
+    amortized away (the in-function `jax.device_get` of the plain entry
+    would otherwise serialize a round trip per call)."""
     if not supports(prob):
         raise ValueError(
             "problem exceeds the Pallas formulation "
@@ -420,6 +433,12 @@ def run_pack_pallas(
         g_steps=Gp, kr=kr, cr=cr, s8=s8, t8=t8, objective=objective,
         interpret=interpret,
     )
+    return out, (prob, cnt, Gp, Kp, R)
+
+
+def finish_pack_pallas(out, ctx) -> PackResult:
+    """The one synchronizing fetch for a dispatched fused-kernel solve."""
+    prob, cnt, Gp, Kp, R = ctx
     # one transfer for all outputs (the device link may be high-latency);
     # take arrives sparse unless the nonzero count overflowed the buffer
     take_dense, vals, idx, nnz, cfg_out, npods_out, rem_out = out
@@ -445,15 +464,18 @@ def run_pack_pallas(
     )
 
 
-# below this count the fused kernel's fixed launch cost outweighs its
-# per-step win over the scan kernel (measured on TPU v5e: ~20ms fixed,
-# ~7us/step vs the scan's ~29us/step).  With the bit-packed admission
-# upload and the sparse take fetch (round 4), the fused kernel measures
-# FASTER than the scan kernel end-to-end at this class count even on the
-# driver's tunneled v5e (177ms vs 190ms p50 on bench config 2), where
-# transfer latency once buried its per-step win.  bench.py still reports
-# both kernels side by side.
-PALLAS_MIN_CLASSES = 256
+# The fused kernel's fixed launch + host-prep cost outweighs its
+# per-step win over the scan kernel until the class axis is deep.
+# End-to-end wall clock through the tunneled driver link cannot separate
+# the kernels (the ~100ms fixed round trip buries a few-ms delta in
+# run-to-run jitter); bench.py's `device_ms` field — the marginal
+# per-solve cost with the round trip amortized out (chained dispatches,
+# one fetch) — measured the fused kernel at PARITY-OR-WORSE vs the scan
+# kernel at ~300 classes on the driver's v5e, so the dispatch threshold
+# sits at the per-step model's break-even (~20ms fixed / ~22us-per-step
+# gain ≈ 900 steps).  bench.py reports both kernels side by side with
+# their device_ms at config-2 scale regardless of the dispatch choice.
+PALLAS_MIN_CLASSES = 1024
 
 # which kernel the last auto_pack dispatch ran ("pallas" | "scan") —
 # observability for the bench harness and the scheduler's metrics

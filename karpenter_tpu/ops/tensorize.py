@@ -323,6 +323,14 @@ class ClassMeta:
     # unschedulable (real-scheduler bind semantics: once the first member
     # binds, required hostname affinity forces every member to that node)
     group_size: int = 0
+    # custom-topology-key split: a representative CLONE whose node
+    # selector pins the class to its domain — feasibility rows compile
+    # from this pod instead of pods[0] (the members keep their real spec)
+    rep_override: Optional[Pod] = None
+    # ...and the domain's pools: only pools DEFINING the key are valid
+    # domains (the oracle's rule), which the requirement merge alone
+    # cannot express because undefined keys pass at the pool level
+    pool_allow: Optional[frozenset] = None
 
 
 @dataclass
@@ -431,8 +439,50 @@ def class_unsupported_reason(rep: Pod) -> str:
         return "hostname co-location combined with another constraint"
     for c in rep.topology_spread:
         if c.topology_key not in (L.LABEL_HOSTNAME, L.LABEL_ZONE):
+            # provisional: partition_groups cures the single-constraint
+            # self-selecting shape when the caller's pools give the key a
+            # well-defined domain partition (_custom_spread_curable)
             return f"topology spread on key {c.topology_key}"
     return ""
+
+
+def _custom_spread_curable(rep: Pod, pools: Sequence[NodePool]) -> str:
+    """Domain partition for a CUSTOM-topology-key spread, or "" when the
+    shape must keep the oracle.
+
+    Compilable when the rep's only pod-level constraint is ONE
+    self-selecting spread on the key, and every pool defining the key is
+    SINGLE-VALUED for it (domains partition the pools, so each split
+    class's pinned feasibility row maps to whole pools and two domains
+    can never share a config row).  Returns the key when curable."""
+    if not pools:
+        return ""
+    if rep.pod_affinity or len(rep.topology_spread) != 1:
+        return ""
+    c = rep.topology_spread[0]
+    key = c.topology_key
+    if key in (L.LABEL_HOSTNAME, L.LABEL_ZONE) or not c.selects(rep):
+        return ""
+    domains = set()
+    for pool in pools:
+        vr = pool.template_requirements().get(key)
+        if vr is None:
+            continue
+        if vr.complement or len(vr.values) != 1:
+            return ""  # multi-valued / negated template: oracle
+        domains.update(vr.values)
+    return key if domains else ""
+
+
+def _pin_clone(rep: Pod, key: str, value: str) -> Pod:
+    """Representative clone pinned to one domain via node selector; the
+    reassignment invalidates the copied signature memo (Pod.__setattr__),
+    so the clone groups and memoizes as its own shape."""
+    import copy
+
+    ov = copy.copy(rep)
+    ov.node_selector = {**rep.node_selector, key: value}
+    return ov
 
 
 def _class_groups(pods: Sequence[Pod]) -> List[Tuple[Tuple, List[Pod]]]:
@@ -517,12 +567,13 @@ def _coloc_component_mergeable(
 
 def partition_pods(
     pods: Sequence[Pod],
+    pools: Sequence[NodePool] = (),
 ) -> Tuple[List[Pod], List[Pod], str]:
     """Split a batch into (tensor-solvable, oracle-only, reason); see
     `partition_groups` (which the solver uses directly so the class
     grouping is computed once per solve, not once here and again in
     `compile_problem`)."""
-    sup_groups, unsupported, why = partition_groups(pods)
+    sup_groups, unsupported, why = partition_groups(pods, pools=pools)
     supported = [p for _, members in sup_groups for p in members]
     return supported, unsupported, why
 
@@ -530,6 +581,7 @@ def partition_pods(
 def partition_groups(
     pods: Sequence[Pod],
     existing: Sequence["StateNode"] = (),
+    pools: Sequence[NodePool] = (),
 ) -> Tuple[List[Tuple[Tuple, List[Pod]]], List[Pod], str]:
     """Split a batch into (tensor-solvable class groups, oracle-only pods,
     reason).
@@ -566,6 +618,16 @@ def partition_groups(
         sig_of.append(s)
     m = len(sig_rep)
     reasons = [class_unsupported_reason(r) for r in sig_rep]
+    # cure custom-topology-key spreads the caller's pools can partition
+    # (single-valued templates; see _custom_spread_curable).  Deleted
+    # pools are filtered FIRST so this decision matches compile_problem,
+    # whose catalog drops them (build_catalog).
+    alive_pools = [p for p in pools if not p.deleted]
+    if alive_pools:
+        for i, r in enumerate(sig_rep):
+            if reasons[i].startswith("topology spread on key") and \
+                    _custom_spread_curable(r, alive_pools):
+                reasons[i] = ""
     # built ONCE for the live-member checks below
     live_labels = [dict(bp.labels) for sn in existing for bp in sn.pods]
     # symmetric anti-affinity from LIVE carriers: a bound pod's anti term
@@ -834,13 +896,15 @@ def partition_groups(
 
 
 def _unsupported_reason(
-    pods: Sequence[Pod], existing: Sequence["StateNode"] = ()
+    pods: Sequence[Pod],
+    existing: Sequence["StateNode"] = (),
+    pools: Sequence[NodePool] = (),
 ) -> str:
     """Whole-batch gate used by `compile_problem`: non-empty when ANY pod
     needs the oracle (callers that cannot hybrid-split fall back whole).
     `existing` matters: co-location groups with members already on live
     nodes must JOIN those nodes, which only the oracle expresses."""
-    _, unsupported, why = partition_groups(pods, existing=existing)
+    _, unsupported, why = partition_groups(pods, existing=existing, pools=pools)
     return why if unsupported else ""
 
 
@@ -952,7 +1016,9 @@ def compile_problem(
         # merge-aware grouping: node-equivalent co-location closures arrive
         # as ONE macro group here exactly as they do on the solver's
         # presplit path
-        sup_groups, unsupported, why = partition_groups(pods, existing=existing)
+        sup_groups, unsupported, why = partition_groups(
+            pods, existing=existing, pools=pools
+        )
         if unsupported:
             groups = _class_groups(pods)
             reason = "" if presplit else why
@@ -960,7 +1026,7 @@ def compile_problem(
             groups = sup_groups
             reason = ""
     else:
-        reason = "" if presplit else _unsupported_reason(pods, existing)
+        reason = "" if presplit else _unsupported_reason(pods, existing, pools)
     axes = _axes_for_requests([key[1] for key, _ in groups])
     if catalog is None or catalog.axes != axes:
         catalog = build_catalog(pools, instance_types, daemonsets, axes)
@@ -1009,6 +1075,7 @@ def compile_problem(
     anchor_of = _anchor_zone_affinity(group_list, all_zones, catalog, pools, live)
 
     classes: List[ClassMeta] = []
+    pools_by_name = {p.name: p for p in pools}
     track_slots: Dict[Tuple, int] = {}
     # per-SPREAD-GROUP shares already handed out in this compile: a
     # service whose pods span several request classes splits each class
@@ -1161,7 +1228,7 @@ def compile_problem(
                 split_zones = cand_zones
             # seed with bound pods the constraint's SELECTOR matches (the
             # oracle replays placements the same way, topology.py:91-93)
-            # plus the shares sibling classes of this group already took
+            # plus the shares sibling classes of this group already took.
             # when_unsatisfiable deliberately OMITTED: the oracle's tracker
             # keys groups by (topology key, selector, expressions,
             # max_skew) only (topology.py:_spread_group), so a
@@ -1169,42 +1236,26 @@ def compile_problem(
             # selectors share one count there — sharing the accumulator
             # here keeps the compiled shares aligned with those counts
             selkey = (
+                c0.topology_key,
                 tuple(sorted(c0.label_selector)),
                 c0.match_expressions,
                 c0.max_skew,
             )
             assigned = spread_assigned.setdefault(selkey, {})
-            zcounts = {z: assigned.get(z, 0) for z in split_zones}
-            all_counts = {z: assigned.get(z, 0) for z in cand_zones}
+            live_counts: Dict[str, int] = {}
             for sn in live:
-                if sn.zone in zcounts:
-                    zcounts[sn.zone] += sum(
-                        1 for bp in sn.pods if c0.selects(bp)
-                    )
-                if sn.zone in all_counts:
-                    all_counts[sn.zone] += sum(
-                        1 for bp in sn.pods if c0.selects(bp)
-                    )
-            share = _balanced_split(len(members), zcounts)
-            for z, take in share.items():
-                if take:
-                    assigned[z] = assigned.get(z, 0) + take
-            if len(split_zones) < len(cand_zones) and not reason:
-                # skew is measured against ALL candidate domains: if an
-                # infeasible zone anchors the global minimum and the split
-                # would push a feasible zone past min+maxSkew, the kernel's
-                # hard-pinned shares diverge from DoNotSchedule semantics —
-                # let the oracle arbitrate (it caps per-domain instead)
-                finals = dict(all_counts)
-                for z, take in share.items():
-                    finals[z] = finals.get(z, 0) + take
-                floor = min(finals.values(), default=0)
-                if any(
-                    finals[z] > floor + c0.max_skew for z in split_zones
-                ):
-                    reason = (
-                        "zone spread constrained by infeasible domains"
-                    )
+                if sn.zone:
+                    n_sel = sum(1 for bp in sn.pods if c0.selects(bp))
+                    if n_sel:
+                        live_counts[sn.zone] = (
+                            live_counts.get(sn.zone, 0) + n_sel
+                        )
+            share, guard = _split_shares(
+                len(members), split_zones, cand_zones, assigned,
+                live_counts, c0.max_skew,
+            )
+            if guard and not reason:
+                reason = "zone spread constrained by infeasible domains"
             cursor = 0
             for z in split_zones:
                 take = share[z]
@@ -1221,6 +1272,124 @@ def compile_problem(
                     )
                 )
                 cursor += take
+        elif _custom_spread_curable(rep, pools):
+            # CUSTOM-topology-key spread (scheduling.md:319-331): pool
+            # templates are single-valued for the key, so the domains
+            # partition the pools — split the class across them like
+            # zones, each split pinned via a cloned representative whose
+            # node selector carries the domain (decoded nodes inherit the
+            # label from their pool template, so the oracle's accounting
+            # matches).
+            c0 = rep.topology_spread[0]
+            key = c0.topology_key
+            domain_pools: Dict[str, List[NodePool]] = {}
+            for pool in pools:
+                vr = pool.template_requirements().get(key)
+                if vr is not None and not vr.complement and len(vr.values) == 1:
+                    domain_pools.setdefault(
+                        next(iter(vr.values)), []
+                    ).append(pool)
+            # live label values are domains too (the oracle's universe
+            # includes them) — an orphaned domain with no serving pool
+            # still anchors the skew floor
+            live_doms = {
+                v for sn in live if (v := sn.labels.get(key)) is not None
+            }
+            cand_domains = sorted(set(domain_pools) | live_doms)
+            kr = rep.scheduling_requirements(preferred=True).get(key)
+            if kr is not None:
+                cand_domains = [d for d in cand_domains if kr.has(d)]
+            # only split into pool-served domains the class can actually
+            # land in (label-feasible, resource-fitting openable config,
+            # or an admitting live node) — the zone split's
+            # _feasible_zones filter
+            ovs = {
+                d: _pin_clone(rep, key, d)
+                for d in cand_domains
+                if d in domain_pools
+            }
+            feas_doms = [
+                d
+                for d in cand_domains
+                if d in ovs
+                and _pin_feasible(
+                    ovs[d], domain_pools[d], catalog, pools_by_name,
+                    live, requests,
+                )
+            ]
+            split_domains = feas_doms or sorted(ovs)
+            if not split_domains:
+                classes.append(
+                    ClassMeta(
+                        pods=members,
+                        requests=requests,
+                        signature=sig,
+                        infeasible=True,
+                        unsched_reason=(
+                            "topology spread: no admissible domain"
+                        ),
+                    )
+                )
+                continue
+            selkey = (
+                c0.topology_key,
+                tuple(sorted(c0.label_selector)),
+                c0.match_expressions,
+                c0.max_skew,
+            )
+            assigned = spread_assigned.setdefault(selkey, {})
+            live_counts = {}
+            for sn in live:
+                dv = sn.labels.get(key)
+                if dv is not None:
+                    n_sel = sum(1 for bp in sn.pods if c0.selects(bp))
+                    if n_sel:
+                        live_counts[dv] = live_counts.get(dv, 0) + n_sel
+            share, guard = _split_shares(
+                len(members), split_domains, cand_domains, assigned,
+                live_counts, c0.max_skew,
+            )
+            if guard and not reason:
+                reason = "topology spread constrained by infeasible domains"
+            cursor = 0
+            for d in split_domains:
+                take = share[d]
+                if take == 0:
+                    continue
+                classes.append(
+                    ClassMeta(
+                        pods=members[cursor : cursor + take],
+                        requests=requests,
+                        signature=ovs[d].constraint_signature(),
+                        rep_override=ovs[d],
+                        pool_allow=frozenset(
+                            p.name for p in domain_pools[d]
+                        ),
+                        max_per_node=maxper,
+                        track_slot=slot,
+                    )
+                )
+                cursor += take
+        elif any(
+            c.topology_key not in (L.LABEL_HOSTNAME, L.LABEL_ZONE)
+            for c in rep.topology_spread
+        ):
+            # partition cured the custom-key spread against a pool list
+            # that differs from the catalog's (e.g. the defining pool was
+            # deleted between the two): compiling the class PLAIN would
+            # silently drop a hard constraint — match the oracle, where a
+            # key no pool defines has no valid domain
+            classes.append(
+                ClassMeta(
+                    pods=members,
+                    requests=requests,
+                    signature=sig,
+                    infeasible=True,
+                    unsched_reason=(
+                        "topology spread: no pool defines the domain key"
+                    ),
+                )
+            )
         else:
             classes.append(
                 ClassMeta(
@@ -1234,7 +1403,11 @@ def compile_problem(
 
     # FFD order: constrained classes first, then descending size
     def class_key(cm: ClassMeta) -> Tuple:
-        constrained = cm.max_per_node < BIG or bool(cm.zone_pin)
+        constrained = (
+            cm.max_per_node < BIG
+            or bool(cm.zone_pin)
+            or cm.rep_override is not None
+        )
         r = cm.requests
         return (
             not constrained,
@@ -1267,12 +1440,13 @@ def compile_problem(
                     seen[s] = p
             pairs = tuple(seen.items())
         else:
-            pairs = ((cm.signature, cm.pods[0]),)
-        key = (tuple(s for s, _ in pairs), cm.zone_pin)
+            # a custom-spread split's override pod carries the domain
+            # pin; its signature IS cm.signature by construction
+            pairs = ((cm.signature, cm.rep_override or cm.pods[0]),)
+        key = (tuple(s for s, _ in pairs), cm.zone_pin, cm.pool_allow)
         classes_by_sig.setdefault(key, []).append(g)
-        sig_reps_of[key] = pairs
+        sig_reps_of[key] = (pairs, cm.pool_allow)
 
-    pools_by_name = {p.name: p for p in pools}
     row_memo: Dict[Tuple, np.ndarray] = {}
 
     def _sig_row(
@@ -1281,8 +1455,9 @@ def compile_problem(
         zone_pin: str,
         term: int = 0,
         keep: Optional[int] = None,
+        pool_allow: Optional[frozenset] = None,
     ) -> np.ndarray:
-        mkey = (sig, zone_pin, term, keep)
+        mkey = (sig, zone_pin, term, keep, pool_allow)
         row = row_memo.get(mkey)
         if row is not None:
             return row
@@ -1294,6 +1469,8 @@ def compile_problem(
             sched.add(Requirement(L.LABEL_ZONE, Op.IN, [zone_pin]))
         row = np.zeros(C, dtype=bool)
         for pname, pr in catalog.pool_rows.items():
+            if pool_allow is not None and pname not in pool_allow:
+                continue  # only the domain's pools DEFINE the spread key
             ent = _pool_feas(
                 catalog, rep, sig, pname, pools_by_name, term, keep
             )
@@ -1311,17 +1488,21 @@ def compile_problem(
         return row
 
     def _combined_row(
-        pairs: Tuple, zone_pin: str, term: int, keep: Optional[int]
+        pairs: Tuple,
+        zone_pin: str,
+        term: int,
+        keep: Optional[int],
+        pool_allow: Optional[frozenset] = None,
     ) -> np.ndarray:
-        row = _sig_row(pairs[0][0], pairs[0][1], zone_pin, term, keep)
+        row = _sig_row(pairs[0][0], pairs[0][1], zone_pin, term, keep, pool_allow)
         for s, r in pairs[1:]:
-            row = row & _sig_row(s, r, zone_pin, term, keep)
+            row = row & _sig_row(s, r, zone_pin, term, keep, pool_allow)
         return row
 
     compile_relaxed = 0
-    for (sigs, zone_pin), g_idx in classes_by_sig.items():
-        pairs = sig_reps_of[(sigs, zone_pin)]
-        row = _combined_row(pairs, zone_pin, 0, None)
+    for (sigs, zone_pin, _pa), g_idx in classes_by_sig.items():
+        pairs, pool_allow = sig_reps_of[(sigs, zone_pin, _pa)]
+        row = _combined_row(pairs, zone_pin, 0, None, pool_allow)
         if not row.any():
             # compile-time relaxation: when the STRICT shape admits no
             # config anywhere, walk the same (OR-term x preference-peel)
@@ -1344,7 +1525,7 @@ def compile_problem(
                 keeps += list(range(n_prefs - 1, -1, -1))
                 found = False
                 for keep in keeps:
-                    cand = _combined_row(pairs, zone_pin, ti, keep)
+                    cand = _combined_row(pairs, zone_pin, ti, keep, pool_allow)
                     if cand.any():
                         row = cand
                         compile_relaxed += sum(
@@ -1364,18 +1545,30 @@ def compile_problem(
 
     # pool weight priority (reference designs/provisioner-priority.md): the
     # oracle tries pools highest-weight-first and commits to the first that
-    # admits the pod.  Enforce the same by restricting each class's new-node
-    # feasibility to its highest-weight admitting pool (label-compatible AND
-    # resource-fitting at least one config).
+    # admits the pod.  Enforce the same by restricting each class's
+    # new-node feasibility to its highest-weight admitting TIER — pools
+    # with EQUAL weight have no defined priority between them (the oracle
+    # freely fills any open node regardless of pool), so restricting to a
+    # single pool within a tier would fragment the pack.
     if len(pools) > 1:
         pool_of = np.full(C, -1, np.int32)
         pool_of[:first_existing] = catalog.pool_rank_of
+        # rank -> weight tier index (pools are weight-desc ordered)
+        tier_of_rank = np.zeros(len(pools), np.int32)
+        tier = 0
+        for r in range(1, len(pools)):
+            if pools[r].weight != pools[r - 1].weight:
+                tier += 1
+            tier_of_rank[r] = tier
+        tier_of = np.full(C, -1, np.int32)
+        tier_of[:first_existing] = tier_of_rank[catalog.pool_rank_of]
+        n_tiers = tier + 1
         for g in range(G):
             fits = (req_mat[g][None, :] <= alloc + 1e-6).all(axis=1)
-            for rank in range(len(pools)):
-                sel = (pool_of == rank) & feas[g] & fits
+            for t in range(n_tiers):
+                sel = (tier_of == t) & feas[g] & fits
                 if sel.any():
-                    feas[g] &= (pool_of == rank) | (pool_of == -1)
+                    feas[g] &= (tier_of == t) | (tier_of == -1)
                     break
 
     # seed per-signature counters with pods already bound to existing nodes
@@ -1494,6 +1687,75 @@ def _feasible_zones(
                 if (sn.used + requests).fits(sn.allocatable):
                     out.add(sn.zone)
     return out
+
+
+def _pin_feasible(
+    ov: Pod,
+    pool_list: Sequence[NodePool],
+    catalog: Catalog,
+    pools_by_name: Dict[str, NodePool],
+    live: Sequence[StateNode],
+    requests: Resources,
+) -> bool:
+    """Whether a domain-pinned representative has at least one
+    label-compatible, resource-fitting openable config among its domain's
+    pools, or an admitting live node with room — the custom-topology-key
+    analogue of `_feasible_zones`."""
+    req_vec = _vec(requests, catalog.axes)
+    sig = ov.constraint_signature()
+    for pool in pool_list:
+        ent = _pool_feas(catalog, ov, sig, pool.name, pools_by_name)
+        if ent is None:
+            continue
+        type_ok, zone_ok, ct_ok = ent
+        pr = catalog.pool_rows[pool.name]
+        fits = (req_vec[None, :] <= catalog.alloc[pr.rows] + 1e-6).all(axis=1)
+        if (type_ok[pr.t_of] & zone_ok[pr.z_of] & ct_ok[pr.ct_of] & fits).any():
+            return True
+    if live:
+        sched = ov.scheduling_requirements(preferred=True)
+        for sn in live:
+            if _fits_existing(ov, sched, sn) and (
+                sn.used + requests
+            ).fits(sn.allocatable):
+                return True
+    return False
+
+
+def _split_shares(
+    n_members: int,
+    split_doms: Sequence[str],
+    cand_doms: Sequence[str],
+    assigned: Dict[str, int],
+    live_counts: Dict[str, int],
+    max_skew: int,
+) -> Tuple[Dict[str, int], bool]:
+    """Balanced shares over ``split_doms``, seeded with the shares sibling
+    classes of the group already took (``assigned``, updated in place) and
+    with live placements the constraint's selector matches.
+
+    The second return is the infeasible-domain GUARD: skew is measured
+    against ALL candidate domains, so when an unservable domain anchors
+    the global minimum and the hard-pinned shares would push a served
+    domain past min+maxSkew, the caller must route the class to the
+    oracle (which caps per-domain instead of pre-splitting)."""
+    counts = {
+        d: assigned.get(d, 0) + live_counts.get(d, 0) for d in split_doms
+    }
+    share = _balanced_split(n_members, counts)
+    guard = False
+    if len(split_doms) < len(cand_doms):
+        finals = {
+            d: assigned.get(d, 0) + live_counts.get(d, 0) for d in cand_doms
+        }
+        for d, take in share.items():
+            finals[d] = finals.get(d, 0) + take
+        floor = min(finals.values(), default=0)
+        guard = any(finals[d] > floor + max_skew for d in split_doms)
+    for d, take in share.items():
+        if take:
+            assigned[d] = assigned.get(d, 0) + take
+    return share, guard
 
 
 def _anchor_zone_affinity(

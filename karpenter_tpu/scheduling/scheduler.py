@@ -56,6 +56,26 @@ def _zone_constrained(pod: Pod, include_soft: bool = True) -> bool:
     ) or any(t.topology_key == ZONE for t in pod.pod_affinity)
 
 
+def _spread_pin_keys(pod: Pod, topology: TopologyTracker, preferred: bool):
+    """(own, counted) CUSTOM topology keys a placement must pin/record:
+    ``own`` — keys of the pod's active spread constraints (missing node
+    label = invalid domain, reject); ``counted`` — keys of registered
+    groups that merely COUNT this pod (record if the node has the label,
+    never reject)."""
+    own = [
+        c.topology_key
+        for c in pod.topology_spread
+        if c.topology_key not in (HOSTNAME, ZONE) and c.selects(pod)
+        and (preferred or c.when_unsatisfiable == "DoNotSchedule")
+    ]
+    counted = [
+        key
+        for key in topology.custom_spread_keys()
+        if key not in own and topology.selected_by_group(pod, key)
+    ]
+    return own, counted
+
+
 def pod_sort_key(pod: Pod) -> Tuple:
     """Descending-size FFD order; most-constrained (affinity/topology) pods
     first so their narrow placements aren't crowded out."""
@@ -308,6 +328,36 @@ class VirtualNode:
             reqs.add(r)
         if reqs.is_unsatisfiable():
             return False
+        # CUSTOM topology keys (any node label beyond zone/hostname,
+        # reference scheduling.md:319-331): the node's candidate values
+        # come from its merged requirements (pool templates carry the
+        # label), the pod pins the least-loaded allowed value, and the
+        # placement records the domain so group counts stay exact.  A
+        # node whose pool doesn't define the label is not a valid domain.
+        custom_pins: Tuple = ()
+        own_keys, counted_keys = _spread_pin_keys(pod, topology, preferred)
+        if own_keys or counted_keys:
+            pins = []
+            for key in own_keys + counted_keys:
+                allowed = topology.allowed_domains(pod, key, preferred, term)
+                vr = reqs.get(key)
+                options = (
+                    set(vr.values)
+                    if vr is not None and not vr.complement
+                    else set()
+                )
+                if allowed is not None:
+                    options &= allowed
+                if not options:
+                    if key in counted_keys:
+                        # counted-only pod on a node without the label:
+                        # valid placement, just not in any domain
+                        continue
+                    return False
+                choice = topology.preferred_domains(pod, key, options)[0]
+                reqs.add(Requirement(key, Op.IN, [choice]))
+                pins.append((key, choice))
+            custom_pins = tuple(pins)
         # zone-keyed constraints narrow the node's zone choice; any pod
         # carrying one must PIN a zone so the placement is counted/anchored
         # (first affinity pod anchors the domain for followers).  Allowed
@@ -354,7 +404,7 @@ class VirtualNode:
                 # attempt (term, peel step) this is
                 cache_key = (
                     sig[0], sig[1], sig[7], sig[8], sig[9],
-                    preferred, term, keep_prefs, zc,
+                    preferred, term, keep_prefs, zc, custom_pins,
                 )
             # the cached half (label-compatible candidate types) depends
             # only on the merged reqs, so a reserving anchor shares the
@@ -392,6 +442,8 @@ class VirtualNode:
         domains = {HOSTNAME: self.name}
         if zone_choice is not None:
             domains[ZONE] = zone_choice
+        for key, choice in custom_pins:
+            domains[key] = choice
         # pods that reach this point unpinned are neither zone-constrained
         # nor selected by any zone-keyed group (the zone_choice branch
         # catches both, and constrained-first sort guarantees every group
@@ -483,11 +535,24 @@ class ExistingNode:
         zone = self.state.zone
         if zone_allowed is not None and zone and zone not in zone_allowed:
             return False
-        self.used = self.used + pod.requests
-        self.pods.append(pod)
+        # custom topology keys: the node's label IS its domain; a node
+        # lacking the label is not a valid domain for the constraint
         domains = {HOSTNAME: self.name}
         if zone:
             domains[ZONE] = zone
+        own_keys, counted_keys = _spread_pin_keys(pod, topology, preferred)
+        for key in own_keys + counted_keys:
+            domain = self.state.labels.get(key)
+            if domain is None:
+                if key in counted_keys:
+                    continue  # counted-only: valid, just not in a domain
+                return False
+            allowed = topology.allowed_domains(pod, key, preferred, term)
+            if allowed is not None and domain not in allowed:
+                return False
+            domains[key] = domain
+        self.used = self.used + pod.requests
+        self.pods.append(pod)
         topology.record(pod, domains)
         return True
 
@@ -550,10 +615,12 @@ class Scheduler:
         self.topology.universe.setdefault(HOSTNAME, set()).update(
             en.name for en in self.existing
         )
-        # seed topology with already-bound pods
+        # seed topology with already-bound pods; ALL node labels record as
+        # domains (not just zone) so custom-topology-key spread groups see
+        # live counts when they lazily replay the placement log
         for en in self.existing:
             for pod in en.state.pods:
-                domains = {HOSTNAME: en.name}
+                domains = {**en.state.labels, HOSTNAME: en.name}
                 if en.state.zone:
                     domains[ZONE] = en.state.zone
                 self.topology.record(pod, domains)
@@ -568,6 +635,7 @@ class Scheduler:
         if result is None:
             result = SchedulingResult()
         pods = list(pods)
+        self._seed_custom_domains(pods)
         gangs = self._gang_components(pods)
         # the open-node scan list: starts as the (possibly seeded)
         # new_nodes and is PRUNED as nodes fill their pod slots — every
@@ -669,6 +737,33 @@ class Scheduler:
                 if reason is None:
                     return None
         return reason
+
+    def _seed_custom_domains(self, pods: Sequence[Pod]) -> None:
+        """Topology domains for CUSTOM spread keys (any node label beyond
+        zone/hostname, scheduling.md:319-331): like zones, the universe is
+        what some pool could actually create — the pool templates' values
+        for the key — plus the labels of live nodes.  karpenter-core
+        builds spread domains from provisioner requirements the same
+        way."""
+        seeded = getattr(self, "_custom_seeded", None)
+        if seeded is None:
+            seeded = self._custom_seeded = set()
+        for pod in pods:
+            for c in pod.topology_spread:
+                key = c.topology_key
+                if key in (HOSTNAME, ZONE) or key in seeded:
+                    continue
+                seeded.add(key)
+                domains: Set[str] = set()
+                for pool in self.pools:
+                    vr = pool.template_requirements().get(key)
+                    if vr is not None and not vr.complement:
+                        domains.update(vr.values)
+                for en in self.existing:
+                    v = en.state.labels.get(key)
+                    if v:
+                        domains.add(v)
+                self.topology.universe.setdefault(key, set()).update(domains)
 
     def _gang_components(self, pods: Sequence[Pod]) -> Dict[int, list]:
         """Connected components over hostname co-location carriers in the

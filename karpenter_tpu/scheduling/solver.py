@@ -165,7 +165,7 @@ class TensorScheduler:
         self.last_compile_relaxed = 0  # per-solve; oracle paths leave it 0
         with TRACER.span("solver.partition"):
             sup_groups, unsupported, _reason = partition_groups(
-                pods, existing=self.existing
+                pods, existing=self.existing, pools=self.pools
             )
         if not sup_groups:
             with TRACER.span("solver.oracle", pods=len(pods)):
@@ -463,7 +463,10 @@ class TensorScheduler:
             en.used = en.used + pod.requests
             en.pods.append(pod)
             if seed_topology:
-                domains = {HOSTNAME: node_name}
+                # ALL node labels record as domains (custom-topology-key
+                # groups replay them), mirroring Scheduler.__init__'s
+                # bound-pod seeding
+                domains = {**en.state.labels, HOSTNAME: node_name}
                 if en.state.zone:
                     domains[ZONE] = en.state.zone
                 sch.topology.record(pod, domains)
@@ -478,8 +481,19 @@ class TensorScheduler:
             if seed_topology:
                 opts = vn.zone_options()
                 zone = next(iter(opts)) if len(opts) == 1 else None
+                # custom-topology-key pins are single-valued node
+                # requirements (the split's pool template carries the
+                # label) — replay them so relax-pass pods sharing a
+                # custom-key spread group see their siblings' counts
+                extra = {}
+                for r in vn.requirements:
+                    if r.key in (HOSTNAME, ZONE):
+                        continue
+                    v = r.single_value()
+                    if v is not None:
+                        extra[r.key] = v
                 for pod in vn.pods:
-                    domains = {HOSTNAME: vn.name}
+                    domains = {**extra, HOSTNAME: vn.name}
                     if zone:
                         domains[ZONE] = zone
                     sch.topology.record(pod, domains)

@@ -169,15 +169,12 @@ class TopologyTracker:
             if spread_universe is None:
                 # kube's default nodeAffinityPolicy=Honor: skew is counted
                 # only over domains the pod itself can schedule into — a
-                # pod pinned to one zone has a one-domain universe, not a
-                # wedged global minimum
+                # pod pinned to one zone (or one custom-key value) has a
+                # narrowed universe, not a wedged global minimum
                 spread_universe = universe
-                if key == ZONE:
-                    zr = pod.scheduling_requirements(term=term).get(key)
-                    if zr is not None:
-                        spread_universe = {
-                            z for z in universe if zr.has(z)
-                        }
+                kr = pod.scheduling_requirements(term=term).get(key)
+                if kr is not None:
+                    spread_universe = {d for d in universe if kr.has(d)}
             allowed = self._spread_group(c).allowed(spread_universe, allow_new)
             result = allowed if result is None else (result & allowed)
 
@@ -217,6 +214,17 @@ class TopologyTracker:
             )
             result = cand if result is None else (result - banned)
         return result
+
+    def custom_spread_keys(self) -> Set[str]:
+        """Topology keys of registered spread groups beyond the built-in
+        hostname/zone pair — the keys a placement may need to pin even
+        when the pod carries no constraint of its own (it can still be
+        COUNTED by another pod's custom-key group)."""
+        return {
+            key[1]
+            for key in self._spread
+            if key[1] not in (HOSTNAME, ZONE)
+        }
 
     def selected_by_group(self, pod: Pod, key: str) -> bool:
         """Whether any REGISTERED group on `key` counts this pod as a member.

@@ -39,7 +39,15 @@ def setup():
         name="tainted",
         taints=[Taint(key="team", value="ml", effect="NoSchedule")],
     )
-    pools = [general, tainted]
+    # racked pools: domains for CUSTOM-topology-key spreads (each pool
+    # single-valued for the key, so the compiled split partitions pools)
+    rack_a = env.default_node_pool(
+        name="rack-a", labels={"example.com/rack": "r1"}
+    )
+    rack_b = env.default_node_pool(
+        name="rack-b", labels={"example.com/rack": "r2"}
+    )
+    pools = [general, tainted, rack_a, rack_b]
     inventory = {p.name: env.instance_types.list(p, nc) for p in pools}
     return pools, inventory
 
@@ -133,6 +141,22 @@ def _workload(rng: random.Random):
                     **kw,
                 )
             )
+    # custom-topology-key spread service (compiled via the pool-template
+    # domain partition; scheduling.md:319-331)
+    if rng.random() < 0.5:
+        c = TopologySpreadConstraint(
+            max_skew=rng.choice([1, 2]),
+            topology_key="example.com/rack",
+            label_selector=(("svc", "racked"),),
+        )
+        for i in range(rng.randint(2, 12)):
+            pods.append(
+                Pod(
+                    labels={"svc": "racked"},
+                    requests=rng.choice(SIZES[:3]),
+                    topology_spread=[c],
+                )
+            )
     # anti-affinity singletons; sometimes cross-class (variant labels
     # under one selector, compiled via the shared tracking slot)
     anti_cross = rng.random() < 0.5
@@ -216,6 +240,22 @@ def test_random_workload_invariants(setup, seed):
                 counts,
             )
 
+    # 4b. custom-key (rack) spread within skew over the placed set
+    racked = [p for p in pods if p.labels.get("svc") == "racked"]
+    if racked and not any(p.key() in res.unschedulable for p in racked):
+        skew = racked[0].topology_spread[0].max_skew
+        counts = {}
+        for p in racked:
+            name, vn = placed[p.key()]
+            rack = vn.requirements.get("example.com/rack")
+            assert rack is not None, (seed, name)
+            counts[rack.any_value()] = counts.get(rack.any_value(), 0) + 1
+        if len(counts) > 1:
+            assert max(counts.values()) - min(counts.values()) <= skew, (
+                seed,
+                counts,
+            )
+
     # 5. taints honored
     for p in pods:
         if p.key() in placed:
@@ -248,12 +288,24 @@ def _existing_cluster(rng: random.Random):
         used = Resources()
         for b in range(rng.randint(0, 3)):
             labels = {}
+            kw = {}
             r = rng.random()
             if r < 0.2:
                 labels = {"app": "solo"}  # blocks anti-affinity singletons
             elif r < 0.3:
                 labels = {"pair": "g0"}  # live co-location member
-            p = Pod(labels=labels, requests=Resources(cpu=1, memory="2Gi"))
+            elif r < 0.4:
+                # live ANTI CARRIER: symmetric anti-affinity repels
+                # incoming matched pods from this node; selected incoming
+                # classes route to the oracle (partition live_anti)
+                kw["pod_affinity"] = [
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=(("app", "solo"),),
+                        anti=True,
+                    )
+                ]
+            p = Pod(labels=labels, requests=Resources(cpu=1, memory="2Gi"), **kw)
             bound.append(p)
             used = used + p.requests
         nodes.append(
@@ -298,7 +350,9 @@ def test_random_workload_with_existing_nodes(setup, seed):
         assert total.fits(en.allocatable), (seed, en.name)
 
     # anti-affinity: a live node holding an app=solo pod never receives a
-    # solo singleton, and no two singletons share any node
+    # solo singleton, and no two singletons share any node; a live ANTI
+    # CARRIER's node never receives a pod its selector matches (symmetric
+    # anti-affinity)
     solo_on = {}
     for key, name in res.existing_placements.items():
         p = by_key[key]
@@ -308,6 +362,13 @@ def test_random_workload_with_existing_nodes(setup, seed):
             assert not any(
                 bp.labels.get("app") == "solo" for bp in en.pods
             ), (seed, name)
+        if p.labels.get("app") == "solo":
+            en = next(e for e in existing if e.name == name)
+            assert not any(
+                t.anti and t.selects(p)
+                for bp in en.pods
+                for t in bp.pod_affinity
+            ), (seed, name, "landed beside a live anti carrier")
     for name, keys in solo_on.items():
         assert len(keys) == 1, (seed, name)
     solo_new = [

@@ -631,3 +631,94 @@ def test_preference_order_distinct_pods_do_not_share_cache(env):
     # preferences and pack beside it — one node suffices
     assert result.node_count() == 1
     assert result.new_nodes[0].zone_options() == {"zone-b"}
+
+
+def test_custom_topology_key_spread(env):
+    """Spreads on arbitrary node-label keys (scheduling.md:319-331):
+    domains come from the pool templates' values for the key, and the
+    oracle balances across them like zones."""
+    ra = env.default_node_pool(name="rack-a", labels={"example.com/rack": "r1"})
+    rb = env.default_node_pool(name="rack-b", labels={"example.com/rack": "r2"})
+    s = make_scheduler(env, pools=[ra, rb])
+    c = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key="example.com/rack",
+        label_selector=(("app", "w"),),
+    )
+    pods = [
+        Pod(labels={"app": "w"}, requests=Resources(cpu=1), topology_spread=[c])
+        for _ in range(6)
+    ]
+    result = s.solve(pods)
+    assert not result.unschedulable, result.unschedulable
+    counts = {}
+    for vn in result.new_nodes:
+        rack = vn.requirements.get("example.com/rack").any_value()
+        counts[rack] = counts.get(rack, 0) + len(vn.pods)
+    assert set(counts) == {"r1", "r2"}
+    assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+
+def test_custom_key_spread_respects_live_counts(env):
+    """Live pods matched by the selector count toward their node's rack
+    domain, skewing new placements toward the emptier rack."""
+    ra = env.default_node_pool(name="rack-a", labels={"example.com/rack": "r1"})
+    rb = env.default_node_pool(name="rack-b", labels={"example.com/rack": "r2"})
+    bound = [
+        Pod(labels={"app": "w"}, requests=Resources(cpu=1)) for _ in range(2)
+    ]
+    sn = StateNode(
+        name="live-1",
+        provider_id="fake://live-1",
+        labels={
+            L.LABEL_ZONE: "zone-a",
+            "example.com/rack": "r1",
+            L.LABEL_NODEPOOL: "rack-a",
+        },
+        taints=[],
+        allocatable=Resources(cpu=2, memory="8Gi", pods=110),
+        pods=bound,
+        used=Resources(cpu=2),
+    )
+    s = make_scheduler(env, pools=[ra, rb], existing=[sn])
+    c = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key="example.com/rack",
+        label_selector=(("app", "w"),),
+    )
+    pods = [
+        Pod(labels={"app": "w"}, requests=Resources(cpu=1), topology_spread=[c])
+        for _ in range(2)
+    ]
+    result = s.solve(pods)
+    assert not result.unschedulable, result.unschedulable
+    # r1 already holds 2; both new pods must land in r2 to stay in skew
+    for vn in result.new_nodes:
+        assert vn.requirements.get("example.com/rack").any_value() == "r2"
+
+
+def test_custom_key_spread_honors_node_selector_universe(env):
+    """nodeAffinityPolicy=Honor applies to custom keys too: a pod pinned
+    to one rack by its own node selector measures skew over {r1} only —
+    r2's zero count must not wedge it."""
+    ra = env.default_node_pool(name="rack-a", labels={"example.com/rack": "r1"})
+    rb = env.default_node_pool(name="rack-b", labels={"example.com/rack": "r2"})
+    s = make_scheduler(env, pools=[ra, rb])
+    c = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key="example.com/rack",
+        label_selector=(("app", "w"),),
+    )
+    pods = [
+        Pod(
+            labels={"app": "w"},
+            requests=Resources(cpu=1),
+            node_selector={"example.com/rack": "r1"},
+            topology_spread=[c],
+        )
+        for _ in range(3)
+    ]
+    result = s.solve(pods)
+    assert not result.unschedulable, result.unschedulable
+    for vn in result.new_nodes:
+        assert vn.requirements.get("example.com/rack").any_value() == "r1"

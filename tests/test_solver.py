@@ -1560,3 +1560,91 @@ class TestMatchExpressions:
             keys = {p.key() for p in vn.pods}
             if carrier.key() in keys:
                 assert matcher.key() not in keys
+
+
+class TestCustomTopologyKeySpread:
+    """Spreads on arbitrary node-label keys compile when pool templates
+    partition the domains (scheduling.md:319-331)."""
+
+    def _setup(self, env):
+        nc = env.default_node_class()
+        ra = env.default_node_pool(name="rack-a", labels={"example.com/rack": "r1"})
+        rb = env.default_node_pool(name="rack-b", labels={"example.com/rack": "r2"})
+        pools = [ra, rb]
+        inv = {p.name: env.instance_types.list(p, nc) for p in pools}
+        return pools, inv
+
+    def _pods(self, n=12, skew=1):
+        c = TopologySpreadConstraint(
+            max_skew=skew,
+            topology_key="example.com/rack",
+            label_selector=(("app", "w"),),
+        )
+        return [
+            Pod(labels={"app": "w"}, requests=Resources(cpu=1, memory="2Gi"),
+                topology_spread=[c])
+            for _ in range(n)
+        ]
+
+    def test_compiles_and_balances(self, env):
+        pools, inv = self._setup(env)
+        pods = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(20)]
+        pods += self._pods(12)
+        ts = TensorScheduler(pools, inv)
+        res = ts.solve(pods)
+        oracle = Scheduler(pools, inv).solve(pods)
+        assert ts.last_path == "tensor"
+        assert not res.unschedulable
+        counts = {}
+        for vn in res.new_nodes:
+            rack = vn.requirements.get("example.com/rack")
+            for p in vn.pods:
+                if p.labels.get("app") == "w":
+                    assert rack is not None
+                    counts[rack.any_value()] = counts.get(rack.any_value(), 0) + 1
+        assert set(counts) == {"r1", "r2"}
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+        assert res.node_count() <= oracle.node_count() + 1
+
+    def test_multivalued_template_stays_oracle(self, env):
+        from karpenter_tpu.api import Requirements as Reqs
+        from karpenter_tpu.ops.tensorize import partition_groups
+
+        nc = env.default_node_class()
+        multi = env.default_node_pool(
+            name="multi",
+            requirements=Reqs(
+                [Requirement("example.com/rack", Op.IN, ["r1", "r2"])]
+            ),
+        )
+        pods = self._pods(4)
+        sup, unsup, why = partition_groups(pods, pools=[multi])
+        assert len(unsup) == 4
+        assert "topology spread on key" in why
+
+    def test_spread_spanning_request_classes_shares_accumulator(self, env):
+        """Two request classes under one custom-key spread balance their
+        SUM across racks, like the zone accumulator."""
+        pools, inv = self._setup(env)
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="example.com/rack",
+            label_selector=(("app", "w"),),
+        )
+        pods = []
+        for n, cpu in ((7, 1), (5, 2)):
+            for _ in range(n):
+                pods.append(
+                    Pod(labels={"app": "w"},
+                        requests=Resources(cpu=cpu, memory="2Gi"),
+                        topology_spread=[c])
+                )
+        ts = TensorScheduler(pools, inv)
+        res = ts.solve(pods)
+        assert ts.last_path == "tensor"
+        assert not res.unschedulable
+        counts = {}
+        for vn in res.new_nodes:
+            rack = vn.requirements.get("example.com/rack").any_value()
+            counts[rack] = counts.get(rack, 0) + len(vn.pods)
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
