@@ -546,8 +546,6 @@ def _device_ms(kind: str, pools, inventory, pods, chain: int = 6) -> float:
     the only way to compare kernels on this link: block_until_ready does
     not sync the remote device, so device-only timing is unmeasurable
     end-to-end."""
-    import statistics as stats
-
     from karpenter_tpu.ops.tensorize import build_catalog, compile_problem, partition_groups
     from karpenter_tpu.ops.packer import fetch_bundled, run_pack
 

@@ -1226,33 +1226,13 @@ def compile_problem(
             split_zones = [z for z in cand_zones if z in feas_zones]
             if not split_zones:
                 split_zones = cand_zones
-            # seed with bound pods the constraint's SELECTOR matches (the
-            # oracle replays placements the same way, topology.py:91-93)
-            # plus the shares sibling classes of this group already took.
-            # when_unsatisfiable deliberately OMITTED: the oracle's tracker
-            # keys groups by (topology key, selector, expressions,
-            # max_skew) only (topology.py:_spread_group), so a
-            # DoNotSchedule and a ScheduleAnyway spread with identical
-            # selectors share one count there — sharing the accumulator
-            # here keeps the compiled shares aligned with those counts
-            selkey = (
-                c0.topology_key,
-                tuple(sorted(c0.label_selector)),
-                c0.match_expressions,
-                c0.max_skew,
-            )
-            assigned = spread_assigned.setdefault(selkey, {})
-            live_counts: Dict[str, int] = {}
-            for sn in live:
-                if sn.zone:
-                    n_sel = sum(1 for bp in sn.pods if c0.selects(bp))
-                    if n_sel:
-                        live_counts[sn.zone] = (
-                            live_counts.get(sn.zone, 0) + n_sel
-                        )
+            # seed with bound pods the constraint's SELECTOR matches plus
+            # the shares sibling classes of this group already took
+            assigned = spread_assigned.setdefault(_spread_selkey(c0), {})
             share, guard = _split_shares(
                 len(members), split_zones, cand_zones, assigned,
-                live_counts, c0.max_skew,
+                _live_spread_counts(live, c0, lambda sn: sn.zone or None),
+                c0.max_skew,
             )
             if guard and not reason:
                 reason = "zone spread constrained by infeasible domains"
@@ -1299,25 +1279,25 @@ def compile_problem(
             kr = rep.scheduling_requirements(preferred=True).get(key)
             if kr is not None:
                 cand_domains = [d for d in cand_domains if kr.has(d)]
-            # only split into pool-served domains the class can actually
-            # land in (label-feasible, resource-fitting openable config,
-            # or an admitting live node) — the zone split's
-            # _feasible_zones filter
-            ovs = {
-                d: _pin_clone(rep, key, d)
-                for d in cand_domains
-                if d in domain_pools
-            }
+            # only split into domains the class can actually land in: a
+            # label-feasible, resource-fitting openable config of the
+            # domain's pools, or an admitting live node carrying the
+            # label (a LIVE-ONLY domain is valid — its split class gets
+            # an empty pool_allow, so its feasibility row holds only the
+            # existing-node columns) — the zone split's _feasible_zones
+            # filter
+            ovs = {d: _pin_clone(rep, key, d) for d in cand_domains}
             feas_doms = [
                 d
                 for d in cand_domains
-                if d in ovs
-                and _pin_feasible(
-                    ovs[d], domain_pools[d], catalog, pools_by_name,
-                    live, requests,
+                if _pin_feasible(
+                    ovs[d], domain_pools.get(d, ()), catalog,
+                    pools_by_name, live, requests,
                 )
             ]
-            split_domains = feas_doms or sorted(ovs)
+            split_domains = feas_doms or [
+                d for d in cand_domains if d in domain_pools
+            ]
             if not split_domains:
                 classes.append(
                     ClassMeta(
@@ -1331,23 +1311,11 @@ def compile_problem(
                     )
                 )
                 continue
-            selkey = (
-                c0.topology_key,
-                tuple(sorted(c0.label_selector)),
-                c0.match_expressions,
-                c0.max_skew,
-            )
-            assigned = spread_assigned.setdefault(selkey, {})
-            live_counts = {}
-            for sn in live:
-                dv = sn.labels.get(key)
-                if dv is not None:
-                    n_sel = sum(1 for bp in sn.pods if c0.selects(bp))
-                    if n_sel:
-                        live_counts[dv] = live_counts.get(dv, 0) + n_sel
+            assigned = spread_assigned.setdefault(_spread_selkey(c0), {})
             share, guard = _split_shares(
                 len(members), split_domains, cand_domains, assigned,
-                live_counts, c0.max_skew,
+                _live_spread_counts(live, c0, lambda sn: sn.labels.get(key)),
+                c0.max_skew,
             )
             if guard and not reason:
                 reason = "topology spread constrained by infeasible domains"
@@ -1363,7 +1331,7 @@ def compile_problem(
                         signature=ovs[d].constraint_signature(),
                         rep_override=ovs[d],
                         pool_allow=frozenset(
-                            p.name for p in domain_pools[d]
+                            p.name for p in domain_pools.get(d, ())
                         ),
                         max_per_node=maxper,
                         track_slot=slot,
@@ -1720,6 +1688,35 @@ def _pin_feasible(
             ).fits(sn.allocatable):
                 return True
     return False
+
+
+def _spread_selkey(c0) -> Tuple:
+    """Identity of a spread group's share accumulator — must mirror the
+    oracle tracker's group key (topology.py:_spread_group): topology key,
+    selector, expressions, max_skew; when_unsatisfiable deliberately
+    omitted (the tracker shares counts across DNS/SA variants too)."""
+    return (
+        c0.topology_key,
+        tuple(sorted(c0.label_selector)),
+        c0.match_expressions,
+        c0.max_skew,
+    )
+
+
+def _live_spread_counts(
+    live: Sequence[StateNode], c0, domain_of
+) -> Dict[str, int]:
+    """Per-domain counts of live bound pods the constraint's selector
+    matches (the oracle replays placements the same way)."""
+    out: Dict[str, int] = {}
+    for sn in live:
+        d = domain_of(sn)
+        if d is None:
+            continue
+        n_sel = sum(1 for bp in sn.pods if c0.selects(bp))
+        if n_sel:
+            out[d] = out.get(d, 0) + n_sel
+    return out
 
 
 def _split_shares(

@@ -19,6 +19,22 @@ XLA insert the collectives:
 The same mesh recipe runs on one chip (trivial mesh), an ICI-connected
 slice, or CPU with `--xla_force_host_platform_device_count` for tests and
 the driver's multi-chip dry run.
+
+Why the SCAN kernel is the mesh backend (and not the fused Pallas
+kernel under shard_map): the fused kernel keeps the whole slot/config
+state VMEM-resident within one core — sharding it would force a manual
+collective prefix over the slot axis between kernel invocations,
+re-deriving exactly what XLA SPMD already emits for the scan kernel's
+K-cumsum.  That price could only be worth paying if the fused kernel
+held a material single-chip win, and the measured marginal per-solve
+cost says it does not: bench.py's `device_ms` (chained dispatches, one
+fetch — the tunnel's fixed RTT cancels) put the fused kernel at
+parity-or-worse vs the scan kernel at the ~300-class bench shape
+(BENCH_r05), which is also why auto_pack's single-chip dispatch
+threshold sits at ~1k classes (ops/pallas_packer.py:PALLAS_MIN_CLASSES).
+Both production shapes — the flagship AND the 300+-class heterogeneous
+problem — are parity-asserted against the single-device kernel on every
+driver dry run (__graft_entry__.dryrun_multichip).
 """
 
 from __future__ import annotations

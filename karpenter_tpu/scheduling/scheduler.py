@@ -56,21 +56,30 @@ def _zone_constrained(pod: Pod, include_soft: bool = True) -> bool:
     ) or any(t.topology_key == ZONE for t in pod.pod_affinity)
 
 
+_NO_KEYS: tuple = ((), ())
+
+
 def _spread_pin_keys(pod: Pod, topology: TopologyTracker, preferred: bool):
     """(own, counted) CUSTOM topology keys a placement must pin/record:
     ``own`` — keys of the pod's active spread constraints (missing node
     label = invalid domain, reject); ``counted`` — keys of registered
     groups that merely COUNT this pod (record if the node has the label,
-    never reject)."""
+    never reject).  The no-custom-keys case (virtually every workload)
+    exits on two cheap checks — this runs per try_add probe."""
+    tracked = topology.custom_spread_keys()
+    if not tracked and not pod.topology_spread:
+        return _NO_KEYS
     own = [
         c.topology_key
         for c in pod.topology_spread
         if c.topology_key not in (HOSTNAME, ZONE) and c.selects(pod)
         and (preferred or c.when_unsatisfiable == "DoNotSchedule")
     ]
+    if not tracked and not own:
+        return _NO_KEYS
     counted = [
         key
-        for key in topology.custom_spread_keys()
+        for key in tracked
         if key not in own and topology.selected_by_group(pod, key)
     ]
     return own, counted

@@ -84,6 +84,7 @@ class TopologyTracker:
         self.universe: Dict[str, Set[str]] = {ZONE: set(zones)}
         self._spread: Dict[Tuple, _SpreadGroup] = {}
         self._affinity: Dict[Tuple, _AffinityGroup] = {}
+        self._custom_keys: Set[str] = set()  # non-zone/hostname spread keys
         self._placements: List[Tuple[Pod, Dict[str, str]]] = []
         # label indexes: selectors are matchLabels conjunctions, so a group
         # can only select pods carrying its FIRST label pair, and a pod can
@@ -127,6 +128,8 @@ class TopologyTracker:
                     g.counts[domains[c.topology_key]] += 1
             self._spread[key] = g
             self._register_group(c.label_selector, g)
+            if c.topology_key not in (HOSTNAME, ZONE):
+                self._custom_keys.add(c.topology_key)
         return g
 
     def _affinity_group(self, t: PodAffinityTerm) -> _AffinityGroup:
@@ -219,12 +222,10 @@ class TopologyTracker:
         """Topology keys of registered spread groups beyond the built-in
         hostname/zone pair — the keys a placement may need to pin even
         when the pod carries no constraint of its own (it can still be
-        COUNTED by another pod's custom-key group)."""
-        return {
-            key[1]
-            for key in self._spread
-            if key[1] not in (HOSTNAME, ZONE)
-        }
+        COUNTED by another pod's custom-key group).  Maintained
+        incrementally at group registration: this is queried per try_add
+        probe, the solver's hottest loop."""
+        return self._custom_keys
 
     def selected_by_group(self, pod: Pod, key: str) -> bool:
         """Whether any REGISTERED group on `key` counts this pod as a member.

@@ -1648,3 +1648,46 @@ class TestCustomTopologyKeySpread:
             rack = vn.requirements.get("example.com/rack").any_value()
             counts[rack] = counts.get(rack, 0) + len(vn.pods)
         assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_live_only_domain_is_a_valid_split_target(self, env):
+        """A domain served only by a LIVE node (its pool is gone) is
+        still a valid placement target: the split class's feasibility row
+        holds just the existing-node columns, matching the oracle."""
+        from karpenter_tpu.api import labels as L2
+        from karpenter_tpu.state.cluster import StateNode
+
+        nc = env.default_node_class()
+        ra = env.default_node_pool(name="rack-a2", labels={"example.com/rack": "r1"})
+        pools = [ra]
+        inv = {ra.name: env.instance_types.list(ra, nc)}
+        live = StateNode(
+            name="live-r2",
+            provider_id="fake://live-r2",
+            labels={
+                L2.LABEL_ZONE: "zone-a",
+                "example.com/rack": "r2",
+                L2.LABEL_NODEPOOL: "gone",
+            },
+            taints=[],
+            allocatable=Resources(cpu=8, memory="32Gi", pods=110),
+        )
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="example.com/rack",
+            label_selector=(("app", "w"),),
+        )
+        pods = [
+            Pod(
+                labels={"app": "w"},
+                requests=Resources(cpu=1, memory="2Gi"),
+                node_selector={"example.com/rack": "r2"},
+                topology_spread=[c],
+            )
+            for _ in range(2)
+        ]
+        ts = TensorScheduler(pools, inv, existing=[live])
+        res = ts.solve(pods)
+        oracle = Scheduler(pools, inv, existing=[live]).solve(pods)
+        assert not oracle.unschedulable, oracle.unschedulable
+        assert not res.unschedulable, res.unschedulable
+        assert set(res.existing_placements.values()) == {"live-r2"}
