@@ -18,8 +18,9 @@ Configs (BASELINE.md "Benchmark configs to reproduce"):
    deprovisioner runs per candidate set).
 5. multi-pool weighted priority + spot price-aware selection.
 6. (extra) hybrid split cost: 9.5k tensor pods + 500 oracle-only pods
-   (preference-differing co-location closures) in one batch — the
-   mixed-path price of ops/tensorize.py:partition_pods.
+   (LIVE-MEMBER co-location: groups that must JOIN nodes their members
+   already run on) in one batch — the mixed-path price of
+   ops/tensorize.py:partition_pods.
 7. (extra) the flagship through the solver sidecar (socket RPC) — the
    distributed-backend boundary's overhead (SURVEY.md §5).
 
@@ -88,11 +89,12 @@ def _run_scheduler_config(
     pack_fn=None,
     expect_relaxed: int = 0,
     device_ms=None,
+    existing=(),
 ) -> None:
     from karpenter_tpu.scheduling import TensorScheduler
 
     kw = {"pack_fn": pack_fn} if pack_fn is not None else {}
-    ts = TensorScheduler(pools, inventory, **kw)
+    ts = TensorScheduler(pools, inventory, existing=list(existing), **kw)
     nodes_out = [0]
 
     def solve_once():
@@ -363,13 +365,66 @@ def _coloc_problem(cross_class: bool, node_equiv: bool = True, prefer: bool = Fa
 
 
 def build_hybrid():
-    """Extra: the hybrid-split cost — one variant of each closure carries
-    a preferred zone affinity the other lacks, so the closure merge
-    refuses (relax cohesion) and partition_groups sends just their
-    closures to the Python oracle, seeded with the tensor half's
-    placements.  Gang-aware anchoring (scheduler.py:solve) keeps the
-    oracle from stranding followers, so ZERO unplaced pods are
-    tolerated."""
+    """Extra: the hybrid-split cost — LIVE-MEMBER co-location.  Each
+    group's selector matches a pod already BOUND on a live node, so the
+    group must JOIN that node: the one co-location shape a compiled
+    macro can never express (the anchor is a fixed existing node, not a
+    free placement).  partition_groups routes just those closures to the
+    Python oracle, seeded with the tensor half's placements; the 9.5k
+    plain pods solve on the tensor path against the same 100 live nodes.
+    ZERO unplaced pods are tolerated."""
+    from karpenter_tpu.api import Pod, Resources
+    from karpenter_tpu.api import labels as L
+    from karpenter_tpu.api.objects import PodAffinityTerm
+    from karpenter_tpu.state.cluster import StateNode
+
+    pool, types, _ = build_problem()
+    sizes = [
+        Resources(cpu=0.5, memory="1Gi"),
+        Resources(cpu=1, memory="2Gi"),
+        Resources(cpu=2, memory="4Gi"),
+    ]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(9_500)]
+    existing = []
+    for g in range(100):
+        bound = Pod(
+            labels={"pair": f"host-{g}"},
+            requests=Resources(cpu=1, memory="2Gi"),
+        )
+        existing.append(
+            StateNode(
+                name=f"live-{g}",
+                provider_id=f"fake://live-{g}",
+                labels={
+                    L.LABEL_ZONE: ZONES[g % len(ZONES)],
+                    L.LABEL_NODEPOOL: pool.name,
+                },
+                taints=[],
+                allocatable=Resources(cpu=16, memory="64Gi", pods=110),
+                pods=[bound],
+                used=Resources(cpu=1, memory="2Gi"),
+            )
+        )
+        term = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME,
+            label_selector=(("pair", f"host-{g}"),),
+        )
+        for _ in range(5):
+            pods.append(
+                Pod(
+                    labels={"pair": f"host-{g}"},
+                    requests=Resources(cpu=1, memory="2Gi"),
+                    pod_affinity=[term],
+                )
+            )
+    return [pool], {pool.name: types}, pods, existing
+
+
+def build_prefer_coloc():
+    """Extra: preference-DIFFERING closures (one variant prefers a zone
+    the other doesn't mention) — round 5's hybrid stressor, now merged:
+    each member's preferences fold into its own feasibility row, so the
+    group compiles pinned where the satisfiable preference points."""
     return _coloc_problem(cross_class=True, prefer=True)
 
 
@@ -648,13 +703,12 @@ def main() -> None:
         "schedule_10k_multipool_weighted_spot_p50", pools, inventory, pods
     )
 
-    # gang-aware anchoring means the oracle continuation never strands a
-    # co-location follower when a node that fits the group exists: zero
-    # unplaced tolerated
-    pools, inventory, pods = build_hybrid()
+    # live-member co-location: 500 pods must JOIN their groups' live
+    # nodes through the oracle continuation; zero unplaced tolerated
+    pools, inventory, pods, existing = build_hybrid()
     _run_scheduler_config(
         "schedule_10k_hybrid_500_oracle_pods_p50",
-        pools, inventory, pods, expect_path="hybrid",
+        pools, inventory, pods, expect_path="hybrid", existing=existing,
     )
 
     pools, inventory, pods = build_coloc_tensor()
@@ -674,6 +728,14 @@ def main() -> None:
     pools, inventory, pods = build_inequiv_coloc()
     _run_scheduler_config(
         "schedule_10k_inequiv_coloc_tensor_p50",
+        pools, inventory, pods, expect_path="tensor",
+    )
+
+    # round 5's hybrid stressor (preference-differing closures), now
+    # compiled too: the members' preferences fold into their own rows
+    pools, inventory, pods = build_prefer_coloc()
+    _run_scheduler_config(
+        "schedule_10k_prefer_coloc_tensor_p50",
         pools, inventory, pods, expect_path="tensor",
     )
 

@@ -523,15 +523,18 @@ def _coloc_component_mergeable(
     a macro can't express).
 
     Node-INEQUIVALENT closures (members differing in node selector,
-    required node affinity, tolerations, or volume requirements) merge
-    too: the whole group must land on ONE node, so the group's feasible
-    config set is exactly the INTERSECTION of its members' sets —
-    compile_problem ANDs the per-signature feasibility rows.  What must
-    stay equal across members is the RELAX-COHESION part (preferences,
-    node-affinity OR-terms, namespace): the solver's relaxation pass
-    re-routes unschedulable relax-eligible pods to the oracle, and a
-    closure whose members differ there would be torn apart by a partial
-    re-route."""
+    required node affinity, tolerations, volume requirements, or
+    PREFERENCES) merge too: the whole group must land on ONE node, so
+    the group's feasible config set is exactly the INTERSECTION of its
+    members' sets — compile_problem ANDs the per-signature feasibility
+    rows, with each member's preferences merged as required into its own
+    row (and peeled per member by the compile-time relaxation ladder
+    when the strict intersection is empty).  OR-terms and namespace must
+    stay equal across members: the term walk is a single index into
+    every member's term list, and selectors are namespace-scoped.  A
+    closure that still proves unschedulable relaxes as a UNIT — the
+    solver's relax pass pulls the whole closure to the oracle, whose
+    gang machinery peels per member (solver.solve)."""
     cohesion_part = None
     for s in comp:
         if reasons[s] and reasons[s] not in _HOST_CURABLE:
@@ -545,10 +548,7 @@ def _coloc_component_mergeable(
         ):
             return False
         sig = rep.constraint_signature()
-        # preferred node affinity, OR-terms, namespace — the parts that
-        # decide relax eligibility (solver.solve's relax pass) and
-        # selector scoping
-        part = (sig[7], sig[9], rep.namespace)
+        part = (sig[9], rep.namespace)
         if cohesion_part is None:
             cohesion_part = part
         elif part != cohesion_part:
@@ -1481,13 +1481,21 @@ def compile_problem(
             # emptiness is exactly the oracle's "proves unschedulable"
             # for these shapes: no node (new or live) admits the pod, so
             # the oracle would relax too.
-            # rep0 speaks for every member: a multi-signature class is a
-            # co-location macro, and the merge's relax-cohesion gate
-            # (_coloc_component_mergeable) requires identical sig[7]
-            # (preferences) and sig[9] (OR-terms) across members
+            # a multi-signature class is a co-location macro: the merge
+            # gate requires identical sig[9] (OR-terms) across members,
+            # so rep0's term count holds for all.  Preference peeling is
+            # walked here only when every member carries the SAME
+            # preference list — a uniform keep index over DIFFERING lists
+            # would peel one member's satisfiable preference because of
+            # another's impossible one; those closures skip the ladder
+            # and relax as a unit through the oracle (solver.solve pulls
+            # the whole closure, whose gang machinery peels per member)
             rep0 = pairs[0][1]
             n_terms = len(rep0.node_affinity_terms())
-            n_prefs = len(rep0.preferred_affinity)
+            uniform_prefs = len({s[7] for s, _ in pairs}) == 1
+            n_prefs = (
+                len(rep0.preferred_affinity) if uniform_prefs else 0
+            )
             for ti in range(n_terms):
                 keeps = [None] if ti else []
                 keeps += list(range(n_prefs - 1, -1, -1))

@@ -119,9 +119,12 @@ class VirtualNode:
     _fit_cache: Dict = field(default_factory=dict)
     # per-axis max allocatable over feasible_types, keyed by list identity
     # (commits replace the list): the O(axes) headroom gate that rejects
-    # probes against full nodes before any Requirements work
+    # probes against full nodes before any Requirements work.  The tensor
+    # decode attaches `_headroom_thunk` instead of the dict (lazy, like
+    # the widen): the first probe materializes it
     _headroom: Optional[Dict[str, float]] = None
     _headroom_key: Optional[object] = None
+    _headroom_thunk: Optional[object] = None
     # cross-NODE scan memo (Scheduler-owned, attached at node creation):
     # (feasible-list identity, requirements snapshot) -> candidate entry.
     # Fresh nodes share the pool template list, and all-fit commits keep
@@ -148,7 +151,14 @@ class VirtualNode:
             # only OVER-admit (the full scan still decides), and only covers
             # the compiled axes — anything else falls through to the thunk
             hi = self._headroom
-            if all(a in hi for a, _ in requests.items()):
+            if hi is None and self._headroom_thunk is not None:
+                hi = self._headroom = self._headroom_thunk()
+                # drop the closure either way: it pins the per-node
+                # class_feas row and the compile arrays
+                self._headroom_thunk = None
+                if hi is None:  # no openable config admits this node's mix
+                    self._headroom_key = None
+            if hi is not None and all(a in hi for a, _ in requests.items()):
                 for axis, v in requests.items():
                     if v + self.used.get(axis) > hi[axis] + 1e-9:
                         return False
@@ -182,6 +192,15 @@ class VirtualNode:
 
     def hi_cpu_mem(self) -> Tuple[float, float, float]:
         if self._hi2 is None:
+            if (
+                self.widen_thunk is not None
+                and self._headroom is None
+                and self._headroom_thunk is not None
+            ):
+                self._headroom = self._headroom_thunk()
+                self._headroom_thunk = None
+                if self._headroom is None:
+                    self._headroom_key = None
             if self.widen_thunk is None:
                 # materialized list: the tight bound (and commits narrow
                 # it, so rebuilding here is what invalidation buys)

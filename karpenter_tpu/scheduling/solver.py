@@ -171,7 +171,12 @@ class TensorScheduler:
             with TRACER.span("solver.oracle", pods=len(pods)):
                 return self._oracle(pods)
         supported = [p for _, members in sup_groups for p in members]
-        result = self._solve_tensor(supported, sup_groups)
+        # live-member co-location closures must JOIN specific live nodes;
+        # the tensor half would otherwise fill those nodes with plain
+        # pods first (existing capacity is free) and strand the groups —
+        # compile against SHADOW nodes with the groups' totals reserved
+        shadow = self._reserve_live_capacity(unsupported)
+        result = self._solve_tensor(supported, sup_groups, existing=shadow)
         if result is None:  # tensor compile bailed; solve everything oracle
             with TRACER.span("solver.oracle", pods=len(pods)):
                 return self._oracle(pods)
@@ -202,6 +207,50 @@ class TensorScheduler:
         ]
         if relax:
             relax_keys = {p.key() for p in relax}
+            # a relax-eligible CO-LOCATION member brings its whole
+            # closure: a compiled macro that proved unschedulable marked
+            # every member unschedulable, and the oracle must re-place
+            # the group as a unit (its gang machinery peels per member)
+            # rather than tear preference carriers out of it
+            coloc_relax = [
+                p
+                for p in relax
+                if any(
+                    not t.anti and t.topology_key == L.LABEL_HOSTNAME
+                    for t in p.pod_affinity
+                )
+            ]
+            if coloc_relax:
+                # fixed point over selector adjacency: a chain-connected
+                # member (A—B—C with only A relax-eligible) must come too
+                frontier = list(coloc_relax)
+                while frontier:
+                    grabbed = []
+                    for p in pods:
+                        if (
+                            p.key() in relax_keys
+                            or p.key() not in result.unschedulable
+                        ):
+                            continue
+                        terms = [
+                            t
+                            for t in p.pod_affinity
+                            if not t.anti
+                            and t.topology_key == L.LABEL_HOSTNAME
+                        ]
+                        if any(
+                            t.selects(q) for q in frontier for t in terms
+                        ) or any(
+                            t.selects(p)
+                            for q in frontier
+                            for t in q.pod_affinity
+                            if not t.anti
+                            and t.topology_key == L.LABEL_HOSTNAME
+                        ):
+                            relax.append(p)
+                            relax_keys.add(p.key())
+                            grabbed.append(p)
+                    frontier = grabbed
             for k in relax_keys:
                 del result.unschedulable[k]
             others = [p for p in pods if p.key() not in relax_keys]
@@ -315,8 +364,51 @@ class TensorScheduler:
             vn.pods = remaining
         result.new_nodes = [vn for vn in result.new_nodes if vn.pods]
 
+    def _reserve_live_capacity(self, unsupported: List[Pod]):
+        """Shadow `self.existing` with oracle-bound co-location groups'
+        totals charged against their anchor nodes, so the tensor compile
+        sees the capacity the continuation will consume.  Only affects
+        the compiled rows — the continuation runs against the REAL nodes
+        and fills the reserved space."""
+        if not unsupported or not self.existing:
+            return self.existing
+        reserve: Dict[str, Resources] = {}
+        for p in unsupported:
+            terms = [
+                t
+                for t in p.pod_affinity
+                if not t.anti and t.topology_key == L.LABEL_HOSTNAME
+            ]
+            if not terms:
+                continue
+            for sn in self.existing:
+                # the join predicate: EVERY term must find a matching
+                # bound pod on the node (an any-term reserve could land
+                # on a node the group cannot actually join)
+                if all(
+                    any(t.selects(bp) for bp in sn.pods) for t in terms
+                ):
+                    reserve[sn.name] = (
+                        reserve.get(sn.name, Resources()) + p.requests
+                    )
+                    break
+        if not reserve:
+            return self.existing
+        import copy
+
+        out = []
+        for sn in self.existing:
+            r = reserve.get(sn.name)
+            if r is None:
+                out.append(sn)
+            else:
+                shadow = copy.copy(sn)
+                shadow.used = sn.used + r
+                out.append(shadow)
+        return out
+
     def _solve_tensor(
-        self, pods: List[Pod], groups
+        self, pods: List[Pod], groups, existing=None
     ) -> Optional[SchedulingResult]:
         import jax
 
@@ -350,7 +442,7 @@ class TensorScheduler:
                 pods,
                 self.pools,
                 self.instance_types,
-                existing=self.existing,
+                existing=self.existing if existing is None else existing,
                 daemonsets=self.daemonsets,
                 catalog=catalog,
                 presplit=True,
@@ -633,6 +725,16 @@ class TensorScheduler:
                 v = r.get(a)
                 if v > overhead_hi[ai]:
                     overhead_hi[ai] = v
+        def hint(class_feas: np.ndarray):
+            def thunk():
+                mask = openable & class_feas
+                if not mask.any():
+                    return None
+                hi = alloc[mask].max(axis=0) * scale + overhead_hi
+                return dict(zip(axes, hi.tolist()))
+
+            return thunk
+
         for k, vn in vnodes.items():
             classes = slot_classes.get(k, ())
             class_feas = (
@@ -647,12 +749,10 @@ class TensorScheduler:
             # what widen() returns, so the bound only over-admits): lets a
             # continued solve probe-and-reject this node without paying the
             # widen — the hottest path when oracle pods scan full tensor
-            # nodes
-            mask = openable & class_feas
-            if mask.any():
-                hi = alloc[mask].max(axis=0) * scale + overhead_hi
-                vn._headroom = dict(zip(axes, hi.tolist()))
-                vn._headroom_key = PENDING_WIDEN
+            # nodes.  LAZY like the widen itself: a tensor-only solve pays
+            # nothing, the first probe of a continued solve materializes it
+            vn._headroom_thunk = hint(class_feas)
+            vn._headroom_key = PENDING_WIDEN
 
     @staticmethod
     def _why_unschedulable(prob: CompiledProblem, g: int) -> str:

@@ -722,23 +722,25 @@ class TestHybridSolve:
         pods = []
         for i in range(120):
             pods.append(Pod(requests=Resources(cpu=random.choice([1, 2, 4]))))
+        # one-sided anti coupling keeps a small closure oracle-side: the
+        # watchers' terms select the co pods, which carry no term
         for i in range(4):
-            # two variants sharing one selector but differing in PREFERRED
-            # affinity: relax cohesion breaks, so the closure merge refuses
-            # and the group stays oracle-only
             pods.append(
                 Pod(
                     labels={"app": "co", "variant": str(i % 2)},
                     requests=Resources(cpu=2),
-                    preferred_affinity=(
-                        [Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])]
-                        if i % 2
-                        else []
-                    ),
+                )
+            )
+        for i in range(2):
+            pods.append(
+                Pod(
+                    labels={"role": "watcher"},
+                    requests=Resources(cpu=1),
                     pod_affinity=[
                         PodAffinityTerm(
                             topology_key=L.LABEL_HOSTNAME,
                             label_selector=(("app", "co"),),
+                            anti=True,
                         )
                     ],
                 )
@@ -746,7 +748,9 @@ class TestHybridSolve:
         oracle, tensor, ts = both(pool, types, pods)
         assert ts.last_path == "hybrid"
         assert not tensor.unschedulable
-        assert tensor.node_count() <= oracle.node_count()
+        # the tensor half right-sizes for the plain pods before the
+        # oracle sees the anti-coupled classes: at most one extra node
+        assert tensor.node_count() <= oracle.node_count() + 1
 
 
 class TestCrossClassColocMerge:
@@ -849,10 +853,10 @@ class TestCrossClassColocMerge:
         assert tensor.node_count() == 1
         assert tensor.new_nodes[0].pool.name == pool.name
 
-    def test_preference_differing_closure_stays_oracle(self, setup):
-        """Members differing in PREFERRED affinity keep the oracle: the
-        relaxation pass re-routes preference carriers individually, which
-        would tear a merged macro apart."""
+    def test_preference_differing_closure_compiles(self, setup):
+        """Members differing in PREFERRED affinity merge too: each
+        member's preferences fold into its OWN feasibility row, so the
+        group compiles pinned where the satisfiable preference points."""
         pool, types = setup
         group = self._group(0)
         group[0].preferred_affinity = [
@@ -860,14 +864,69 @@ class TestCrossClassColocMerge:
         ]
         pods = [Pod(requests=Resources(cpu=1)) for _ in range(10)] + group
         oracle, tensor, ts = both(pool, types, pods)
-        assert ts.last_path == "hybrid"
+        assert ts.last_path == "tensor"
         assert not tensor.unschedulable
         nodes = set()
         for vn in tensor.new_nodes:
             for p in vn.pods:
                 if p.labels.get("pair") == "host-0":
                     nodes.add(vn.name)
+                    # the carrier's preference is honored by the group
+                    assert vn.zone_options() == {"zone-a"}
         assert len(nodes) == 1
+
+    def test_preference_differing_closure_relaxes_as_a_unit(self, setup):
+        """An IMPOSSIBLE preference on one member (the others carry
+        none): preference lists DIFFER, so the compile ladder must not
+        peel uniformly — the whole closure relaxes through the oracle,
+        which peels per member, and the group still lands together."""
+        pool, types = setup
+        group = self._group(0)
+        group[0].preferred_affinity = [
+            Requirement(L.LABEL_ZONE, Op.IN, ["zone-nowhere"])
+        ]
+        pods = [Pod(requests=Resources(cpu=1)) for _ in range(10)] + group
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "hybrid"  # relaxed as a unit via the oracle
+        assert not tensor.unschedulable
+        nodes = {
+            vn.name
+            for vn in tensor.new_nodes
+            for p in vn.pods
+            if p.labels.get("pair") == "host-0"
+        }
+        assert len(nodes) == 1
+
+    def test_mixed_satisfiability_prefs_closure_peels_per_member(self, setup):
+        """Members with DIFFERING preference lists where one member's is
+        impossible: the compile ladder must NOT peel uniformly (that
+        would drop the satisfiable preference too) — the closure relaxes
+        as a unit through the oracle, which peels only the impossible
+        one and keeps the group pinned where the satisfiable preference
+        points."""
+        pool, types = setup
+        group = self._group(0, n=4)
+        group[0].preferred_affinity = [
+            Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])  # satisfiable
+        ]
+        group[1].preferred_affinity = [
+            Requirement(L.LABEL_ZONE, Op.IN, ["zone-nowhere"])  # not
+        ]
+        pods = [Pod(requests=Resources(cpu=1)) for _ in range(10)] + group
+        oracle, tensor, ts = both(pool, types, pods)
+        assert ts.last_path == "hybrid"  # relaxed as a unit via the oracle
+        assert not tensor.unschedulable, tensor.unschedulable
+        nodes = {
+            id(vn): vn
+            for vn in tensor.new_nodes
+            for p in vn.pods
+            if p.labels.get("pair") == "host-0"
+        }
+        assert len(nodes) == 1, {v.name for v in nodes.values()}
+        # the group honors the SATISFIABLE member's preference
+        (vn,) = nodes.values()
+        assert vn.zone_options() == {"zone-a"}
+
 
     def test_conflicting_inequivalent_closure_unschedulable(self, setup):
         """Disjoint node selectors across members make the intersection
@@ -954,20 +1013,23 @@ class TestCrossClassColocMerge:
         raw units, not the compiled MiB scale."""
         pool, types = setup
         plain = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(6)]
-        # preference-differing closure: oracle-only (relax cohesion)
-        term = PodAffinityTerm(
-            topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", "mem"),)
+        # ONE-SIDED anti coupling: the watcher's term selects the mem
+        # pods, which carry no term themselves — asymmetric, oracle-only
+        watcher = Pod(
+            labels={"role": "watch"},
+            requests=Resources(cpu=0.25, memory="256Mi"),
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=L.LABEL_HOSTNAME,
+                    label_selector=(("pair", "mem"),),
+                    anti=True,
+                )
+            ],
         )
-        group = [
+        group = [watcher] + [
             Pod(
                 labels={"pair": "mem", "variant": str(i % 2)},
                 requests=Resources(cpu=0.25, memory="512Mi"),
-                preferred_affinity=(
-                    [Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])]
-                    if i % 2
-                    else []
-                ),
-                pod_affinity=[term],
             )
             for i in range(2)
         ]
