@@ -45,29 +45,38 @@ ZONES = ("zone-a", "zone-b", "zone-c")
 
 
 def _emit(
-    metric: str, p50_ms: float, path: str, kernel: str, nodes: int, **extra
+    metric: str,
+    p50_ms: float,
+    path: str,
+    kernel: str,
+    nodes: int,
+    noise_ms: Optional[float] = None,
+    **extra,
 ) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(p50_ms, 2),
-                "unit": "ms",
-                "vs_baseline": round(BUDGET_MS / p50_ms, 3),
-                "path": path,
-                "kernel": kernel,
-                "nodes": nodes,
-                **extra,
-            }
-        ),
-        flush=True,
-    )
+    line = {
+        "metric": metric,
+        "value": round(p50_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(BUDGET_MS / p50_ms, 3),
+        "path": path,
+        "kernel": kernel,
+        "nodes": nodes,
+        **extra,
+    }
+    if noise_ms is not None:
+        # measurement uncertainty (IQR of the samples): readings moving
+        # less than this are link jitter, not regressions
+        line["noise_ms"] = round(noise_ms, 2)
+    print(json.dumps(line), flush=True)
 
 
-def _measure(solve, warmup: int = 3, iters: int = 21) -> float:
-    """p50 over 21 samples after 3 warmups: the tunneled device's
-    round-trip latency jitters by tens of ms, and a small sample lets a
-    single spike move the reported median."""
+def _measure(solve, warmup: int = 3, iters: int = 21) -> Tuple[float, float]:
+    """(p50, noise) over 21 samples after 3 warmups: the tunneled
+    device's round-trip latency jitters by tens of ms, and a small sample
+    lets a single spike move the reported median.  ``noise`` is the
+    inter-quartile range in ms — the per-line uncertainty every emitted
+    metric carries, so a consumer can tell a real regression from link
+    jitter."""
     for _ in range(warmup):
         solve()
     samples = []
@@ -75,7 +84,8 @@ def _measure(solve, warmup: int = 3, iters: int = 21) -> float:
         t0 = time.perf_counter()
         solve()
         samples.append(time.perf_counter() - t0)
-    return statistics.median(samples) * 1000.0
+    q = statistics.quantiles(samples, n=4)
+    return statistics.median(samples) * 1000.0, (q[2] - q[0]) * 1000.0
 
 
 def _run_scheduler_config(
@@ -89,6 +99,7 @@ def _run_scheduler_config(
     pack_fn=None,
     expect_relaxed: int = 0,
     device_ms=None,
+    device_ms_floor=None,
     existing=(),
 ) -> None:
     from karpenter_tpu.scheduling import TensorScheduler
@@ -117,13 +128,18 @@ def _run_scheduler_config(
         )
         nodes_out[0] = len(result.new_nodes)
 
-    p50 = _measure(solve_once)
+    p50, noise = _measure(solve_once)
     extra = (
         {"relaxed": ts.last_compile_relaxed} if expect_relaxed else {}
     )
     if device_ms is not None:
         extra["device_ms"] = device_ms
-    _emit(metric, p50, ts.last_path, ts.last_kernel, nodes_out[0], **extra)
+    if device_ms_floor is not None:
+        extra["device_ms_floor"] = device_ms_floor
+    _emit(
+        metric, p50, ts.last_path, ts.last_kernel, nodes_out[0],
+        noise_ms=noise, **extra,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -581,26 +597,35 @@ def run_consolidation_repack() -> None:
         # simulation packs all 5k pods onto hypothetical fresh capacity
         dc._simulate(candidates)
 
-    p50 = _measure(simulate_once)
+    p50, noise = _measure(simulate_once)
     sched = dc._scheduler
     _emit(
         "consolidation_repack_5k_pods_p50", p50, sched.last_path,
-        sched.last_kernel, n_nodes,
+        sched.last_kernel, n_nodes, noise_ms=noise,
     )
 
 
 # ---------------------------------------------------------------------------
 
 
-def _device_ms(kind: str, pools, inventory, pods, chain: int = 6) -> float:
-    """Marginal per-solve kernel cost with the link round trip amortized
-    out: enqueue `chain` solves back-to-back (async dispatch), fetch only
-    the last, and compare against a single solve — the fixed ~100ms
-    tunnel RTT cancels in the difference, leaving per-solve host prep
-    (which overlaps device execution) + upload + device compute.  This is
-    the only way to compare kernels on this link: block_until_ready does
-    not sync the remote device, so device-only timing is unmeasurable
-    end-to-end."""
+def _device_ms(
+    kind: str, pools, inventory, pods, chain: int = 6
+) -> Tuple[float, float]:
+    """(marginal per-solve kernel cost, noise floor), with the link round
+    trip amortized out: enqueue `chain` solves back-to-back (async
+    dispatch), fetch only the last, and compare against a single solve —
+    the fixed ~100ms tunnel RTT cancels in the difference, leaving
+    per-solve host prep (which overlaps device execution) + upload +
+    device compute.  This is the only way to compare kernels on this
+    link: block_until_ready does not sync the remote device, so
+    device-only timing is unmeasurable end-to-end.
+
+    The estimate is a difference of two noisy minima, so it can come out
+    NEGATIVE when the kernel cost is below the link jitter; it is clamped
+    at 0 and the returned noise floor (second-lowest-minus-lowest spread
+    of both endpoints, scaled per solve) says how much of the reading is
+    indistinguishable from measurement noise — a device_ms below its
+    floor means "too fast to measure on this link", not a real time."""
     from karpenter_tpu.ops.tensorize import build_catalog, compile_problem, partition_groups
     from karpenter_tpu.ops.packer import fetch_bundled, run_pack
 
@@ -644,7 +669,10 @@ def _device_ms(kind: str, pools, inventory, pods, chain: int = 6) -> float:
     # least-contaminated observation and their difference is the cleanest
     # marginal estimate (min of the per-pair deltas would instead favor
     # pairs whose BASELINE was noise-inflated)
-    return (min(tks) - min(t1s)) / (chain - 1) * 1000.0
+    est = (min(tks) - min(t1s)) / (chain - 1) * 1000.0
+    s1, sk = sorted(t1s), sorted(tks)
+    floor = ((sk[1] - sk[0]) + (s1[1] - s1[0])) / (chain - 1) * 1000.0
+    return max(0.0, est), floor
 
 
 def _forced_pack(kind: str):
@@ -675,13 +703,18 @@ def main() -> None:
     # kernel at this depth (PALLAS_MIN_CLASSES) and the pallas line runs
     # FORCED for the honest comparison.
     pools, inventory, pods = build_heterogeneous()
-    dev_pallas = _device_ms("pallas", pools, inventory, pods) if on_tpu else 0.0
-    dev_scan = _device_ms("scan", pools, inventory, pods) if on_tpu else 0.0
+    dev_pallas, floor_pallas = (
+        _device_ms("pallas", pools, inventory, pods) if on_tpu else (0.0, 0.0)
+    )
+    dev_scan, floor_scan = (
+        _device_ms("scan", pools, inventory, pods) if on_tpu else (0.0, 0.0)
+    )
     _run_scheduler_config(
         "schedule_10k_heterogeneous_taints_300_types_p50",
         pools, inventory, pods,
         expect_kernel="scan",
         device_ms=round(dev_scan, 2) if on_tpu else None,
+        device_ms_floor=round(floor_scan, 2) if on_tpu else None,
     )
     if on_tpu:  # the interpreter path off-TPU is not a perf comparison
         _run_scheduler_config(
@@ -689,6 +722,7 @@ def main() -> None:
             pools, inventory, pods,
             pack_fn=_forced_pack("pallas"), expect_kernel="pallas",
             device_ms=round(dev_pallas, 2),
+            device_ms_floor=round(floor_pallas, 2),
         )
 
     pools, inventory, pods = build_affinity_topology()

@@ -56,6 +56,14 @@ def _metrics_server(port: int) -> ThreadingHTTPServer:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "store-server":
+        # shared cluster-store server mode: own the one durable KubeStore
+        # that --store-address controllers (and their Lease election)
+        # share — the kube-apiserver analogue (service/store_server.py)
+        from karpenter_tpu.service.store_server import main as store_main
+
+        return store_main(argv[1:])
     parser = argparse.ArgumentParser(prog="python -m karpenter_tpu")
     parser.add_argument(
         "--settings-file",
@@ -78,15 +86,26 @@ def main(argv=None) -> int:
         "in-process kernel is used when omitted",
     )
     parser.add_argument(
+        "--store-address",
+        default="",
+        help="host:port of a shared cluster-store server "
+        "(`python -m karpenter_tpu store-server`); this process becomes a "
+        "store CLIENT (state/remote.py) so multiple replicas share one "
+        "durable state and the Lease election is real.  The in-process "
+        "store is used when omitted — then each replica simulates an "
+        "independent cluster and replicas MUST be 1",
+    )
+    parser.add_argument(
         "--leader-elect",
         action=argparse.BooleanOptionalAction,
         default=True,
         help="take the store-backed Lease before reconciling; non-leaders "
         "idle-watch (the chart runs two replicas on this basis). The "
-        "election coordinates replicas SHARING the durable store — any "
-        "real backend, where the store is the cluster apiserver; the "
-        "bundled simulation backend's store is in-process, so simulator "
-        "replicas are independent clusters and each leads its own",
+        "election coordinates replicas SHARING the durable store — pass "
+        "--store-address so the Lease lives in the shared store server; "
+        "without it the bundled simulation backend's store is in-process, "
+        "so simulator replicas are independent clusters and each leads "
+        "its own",
     )
     parser.add_argument(
         "--dump-settings", action="store_true",
@@ -111,22 +130,30 @@ def main(argv=None) -> int:
     from karpenter_tpu.cloud.fake.backend import generate_catalog
     from karpenter_tpu.utils.clock import Clock
 
+    import os
+    import socket
+
+    identity = f"{socket.gethostname()}-{os.getpid()}"
     cloud = FakeCloud(
         Clock(), shapes=generate_catalog()
     ).with_default_topology()
-    kube = KubeStore()
+    if args.store_address:
+        from karpenter_tpu.state.remote import RemoteKubeStore
+
+        host, _, port = args.store_address.partition(":")
+        kube = (
+            RemoteKubeStore(host, int(port), identity=identity)
+            if port
+            else RemoteKubeStore(host, identity=identity)
+        )
+        log.info("shared cluster store at %s", args.store_address)
+    else:
+        kube = KubeStore()
     elector = None
     if args.leader_elect:
-        import os
-        import socket
-
         from karpenter_tpu.utils.leader import LeaderElector
 
-        elector = LeaderElector(
-            kube,
-            cloud.clock,
-            identity=f"{socket.gethostname()}-{os.getpid()}",
-        )
+        elector = LeaderElector(kube, cloud.clock, identity=identity)
     operator = Operator(cloud, kube, settings=settings, elector=elector)
 
     if args.solver_address:
@@ -159,6 +186,8 @@ def main(argv=None) -> int:
         # graceful handoff: free the Lease so the standby takes over
         # immediately instead of waiting out the expiry
         elector.release()
+    if hasattr(kube, "close"):  # store client: stop the watch stream
+        kube.close()
     if server is not None:
         server.shutdown()
     if operator.tracer.enabled:

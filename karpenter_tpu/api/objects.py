@@ -20,12 +20,15 @@ import dataclasses
 import hashlib
 import itertools
 import json
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 from karpenter_tpu.api import labels as L
 from karpenter_tpu.api.requirements import Op, Requirement, Requirements
 from karpenter_tpu.api.resources import Resources
+
+log = logging.getLogger(__name__)
 
 # ---------------------------------------------------------------------------
 # Taints and tolerations
@@ -70,13 +73,37 @@ def tolerates_all(tolerations: Sequence[Toleration], taints: Sequence[Taint]) ->
 # ---------------------------------------------------------------------------
 
 
+_EXPR_OPS = frozenset(("In", "NotIn", "Exists", "DoesNotExist"))
+_warned_expr_ops = set()
+
+
+def validate_match_expressions(exprs: Iterable[Tuple], context: str) -> None:
+    """Construction-time check for matchExpressions operators: an unknown
+    operator keeps kube's invalid-selector contract (match nothing — see
+    _expr_matches), but a typo'd operator BUILT IN CODE must surface
+    loudly instead of silently matching nothing forever, so it logs once
+    per operator string here (ADVICE r5 low)."""
+    for expr in exprs:
+        op = expr[1] if len(expr) > 1 else None
+        if op not in _EXPR_OPS and op not in _warned_expr_ops:
+            _warned_expr_ops.add(op)
+            log.warning(
+                "unknown label-selector operator %r in %s matchExpressions "
+                "(valid: %s); the selector will match nothing",
+                op, context, "/".join(sorted(_EXPR_OPS)),
+            )
+
+
 def _expr_matches(labels: Mapping[str, str], expr: Tuple) -> bool:
     """One matchExpressions entry — (key, operator, values) with kube's
     label-selector operators (In/NotIn/Exists/DoesNotExist).
 
     An unknown operator makes the selector INVALID, and kube's contract
     for an invalid selector is to match nothing — returning False keeps
-    one malformed pod spec from throwing inside the scheduling loop."""
+    one malformed pod spec from throwing inside the scheduling loop.
+    Objects carrying match_expressions validate the operators once at
+    construction (validate_match_expressions) so code-built typos still
+    surface in the logs."""
     key, op, values = expr
     v = labels.get(key)
     if op == "In":
@@ -112,6 +139,11 @@ class TopologySpreadConstraint:
     # (key, operator, values) triples; operator: In/NotIn/Exists/DoesNotExist
     match_expressions: Tuple[Tuple, ...] = ()
 
+    def __post_init__(self):
+        validate_match_expressions(
+            self.match_expressions, "TopologySpreadConstraint"
+        )
+
     def selects(self, pod: "Pod") -> bool:
         return selector_matches(
             pod.labels, self.label_selector, self.match_expressions
@@ -128,6 +160,9 @@ class PodAffinityTerm:
     namespaces: Tuple[str, ...] = ()
     # (key, operator, values) triples; operator: In/NotIn/Exists/DoesNotExist
     match_expressions: Tuple[Tuple, ...] = ()
+
+    def __post_init__(self):
+        validate_match_expressions(self.match_expressions, "PodAffinityTerm")
 
     def selects(self, pod: "Pod") -> bool:
         if self.namespaces and pod.namespace not in self.namespaces:

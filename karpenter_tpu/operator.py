@@ -239,8 +239,11 @@ class Operator:
         for name, controller in sequence:
             # mid-tick abdication: the background renewal thread flips
             # `leading` False the moment the lease is lost, and the tick
-            # stops before the next controller mutates anything
-            if self.elector is not None and not self.elector.leading:
+            # stops before the next controller mutates anything.  The
+            # still_leading() gate also self-fences a WEDGED renewal
+            # thread: once the lease could have expired, the standby may
+            # legitimately hold it, so this replica must stop writing
+            if self.elector is not None and not self.elector.still_leading():
                 return
             self._reconcile(name, controller)
         # 12h pricing refresh (reference pricing/controller.go:39-41)

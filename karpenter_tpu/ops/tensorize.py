@@ -41,9 +41,18 @@ allowed zones against the shared per-group accumulator — and zone-keyed
 pod affinity (compile-time domain anchoring).  Anything else — one-sided
 cross-class couplings, zone-affinity+spread combos, exotic topology
 keys, live-member co-location, closures whose members differ in
-preferences/OR-terms — is reported via ``unsupported_reason`` and routed
-to the pure-Python oracle (scheduling/scheduler.py), whole or as the
-hybrid continuation of a split batch.
+OR-terms or namespace — is reported via ``unsupported_reason`` and
+routed to the pure-Python oracle (scheduling/scheduler.py), whole or as
+the hybrid continuation of a split batch.  (Closures whose members
+differ only in PREFERENCES compile: each member's preferences merge as
+required into its own ANDed feasibility row, and the compile-time
+relaxation ladder peels them when the strict intersection is empty —
+see _coloc_component_mergeable.)
+
+Routing-spec guard: tests/test_router_spec.py greps this docstring's
+oracle-shape list against the router's actual behavior
+(class_unsupported_reason / _coloc_component_mergeable / the cure
+functions) — edit both together.
 """
 
 from __future__ import annotations

@@ -1,0 +1,480 @@
+"""Shared cluster-store server: one durable KubeStore, many replicas.
+
+The reference gets HA for free because durable state lives in the
+kube-apiserver and the election Lease is a shared coordination/v1 object;
+each controller replica is a thin client.  This server is that apiserver
+analogue for the simulation backend: it owns ONE durable `KubeStore`
+(wrapped in `VersionedStore` for resourceVersion bookkeeping) and serves
+it over the same length-prefixed socket protocol as the solver sidecar
+(service/codec.py), so `replicas: 2` behind the store-backed Lease
+election becomes real — the Lease CAS and every object write land in one
+place, and standby replicas keep their mirrors warm over a watch stream.
+
+Methods (JSON header, no array blobs):
+
+- ``ping``                          liveness
+- ``stat``                          {rv, event_count}
+- ``put``    {kind, obj, base_rv}   optimistic-concurrency write
+- ``delete`` {kind, key, base_rv}   delete (cascades run server-side)
+- ``bind_pod`` / ``evict_pod``      semantic pod verbs (base_rv-fenced)
+- ``record_event``                  append a store event
+- ``lease_acquire`` / ``lease_renew`` / ``lease_release``
+                                    the coordination/v1 Lease CAS surface
+                                    (utils/leader.py), atomic server-side
+- ``watch``  {identity, }           long-lived: full snapshot frame, then
+                                    pushed event frames as mutations land
+
+Every mutation is assigned a monotonically increasing resourceVersion;
+``put`` with a stale ``base_rv`` returns ``status: conflict`` with the
+current object so the writer can resync instead of clobbering — the
+single-writer invariant for competing replicas comes from the Lease, the
+rv check fences the deposed leader's stragglers.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socketserver
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
+from karpenter_tpu.state.kube import KubeStore
+from karpenter_tpu.state.wire import STORE_KINDS, from_wire, to_wire
+
+log = logging.getLogger(__name__)
+
+
+class VersionedStore:
+    """A KubeStore plus resourceVersion bookkeeping and watch broadcast.
+
+    Survives server restarts: constructing a new `StoreServer` over the
+    same `VersionedStore` keeps both the objects and their rvs, so
+    reconnecting clients resync consistently (the durable half of the
+    store lives here, the serving half in `StoreServer`).
+    """
+
+    def __init__(self, kube: Optional[KubeStore] = None):
+        self.kube = kube or KubeStore()
+        self.lock = threading.RLock()
+        self.rv = 0
+        self.rvs: Dict[Tuple[str, str], int] = {}
+        # per-lease CAS sequence, SEPARATE from the broadcast rv space:
+        # silent renewals (no watch event) must not advance `rv`, or
+        # other clients could never sync up to the stat rv
+        self.lease_seq: Dict[str, int] = {}
+        self.event_rv = 0
+        self._subscribers: List["_Subscriber"] = []
+        self._recorded: List[dict] = []
+        self.kube.watch(self._record)
+
+    # ------------------------------------------------------------ recording
+    def _record(self, kind: str, verb: str, obj) -> None:
+        """KubeStore notification hook: capture every mutation a verb
+        application produced (bind_pod touches a Pod and maybe a PVC;
+        delete_node re-pends its pods) as state-based events."""
+        spec = STORE_KINDS.get(kind)
+        if spec is None:
+            return
+        cls, attr, key_fn = spec
+        key = key_fn(obj)
+        self.rv += 1
+        self.rvs[(kind, key)] = self.rv
+        deleted = key not in getattr(self.kube, attr)
+        self._recorded.append(
+            {
+                "rv": self.rv,
+                "kind": kind,
+                "verb": "delete" if deleted else "put",
+                "key": key,
+                "obj": None if deleted else to_wire(obj),
+            }
+        )
+
+    def mutate(self, fn, origin: str = "") -> List[dict]:
+        """Run `fn()` (KubeStore verbs) under the lock; collect the
+        resulting events, broadcast them to every subscriber except the
+        originator, and return them (for the originator's RPC response)."""
+        with self.lock:
+            self._recorded = []
+            fn()
+            events = self._recorded
+            self._recorded = []
+            if events:
+                for sub in self._subscribers:
+                    if sub.identity != origin:
+                        sub.q.put(events)
+            return events
+
+    # ------------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        kinds: Dict[str, dict] = {}
+        for kind, (_cls, attr, key_fn) in STORE_KINDS.items():
+            kinds[kind] = {
+                key_fn(obj): {
+                    "rv": self.rvs.get((kind, key_fn(obj)), 0),
+                    "obj": to_wire(obj),
+                }
+                for obj in getattr(self.kube, attr).values()
+            }
+        return {
+            "rv": self.rv,
+            "event_rv": self.event_rv,
+            "kinds": kinds,
+            "events": [to_wire(tuple(e)) for e in self.kube.events],
+        }
+
+    def subscribe(self, identity: str) -> Tuple[dict, "_Subscriber"]:
+        """Atomically snapshot + register, so the stream has no gap."""
+        with self.lock:
+            snap = self.snapshot()
+            sub = _Subscriber(identity)
+            self._subscribers.append(sub)
+            return snap, sub
+
+    def unsubscribe(self, sub: "_Subscriber") -> None:
+        with self.lock:
+            if sub in self._subscribers:
+                self._subscribers.remove(sub)
+
+
+class _Subscriber:
+    def __init__(self, identity: str):
+        self.identity = identity
+        self.q: "queue.Queue[Optional[List[dict]]]" = queue.Queue()
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        while True:
+            try:
+                payload = recv_frame(self.request)
+            except (ConnectionError, OSError):
+                return
+            except ValueError as exc:
+                log.warning("dropping malformed store frame: %s", exc)
+                return
+            header, _ = decode(payload)
+            if header.get("method") == "watch":
+                self.server.serve_watch(self.request, header)  # type: ignore[attr-defined]
+                return
+            try:
+                response = self.server.dispatch(header)  # type: ignore[attr-defined]
+            except Exception as exc:
+                log.exception("store request failed")
+                response = {"status": "error", "error": str(exc)}
+            try:
+                send_frame(self.request, encode(response, {}))
+            except (ConnectionError, OSError):
+                return
+
+
+class StoreServer(socketserver.ThreadingTCPServer):
+    """Serve the shared store on (host, port); port 0 picks a free port."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        store: Optional[VersionedStore] = None,
+    ):
+        super().__init__((host, port), _Handler)
+        self.store = store or VersionedStore()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- dispatch
+    def dispatch(self, header: dict) -> dict:
+        method = header.get("method")
+        store = self.store
+        if method == "ping":
+            return {"status": "ok"}
+        if method == "stat":
+            with store.lock:
+                return {
+                    "status": "ok",
+                    "rv": store.rv,
+                    "event_count": len(store.kube.events),
+                }
+        if method == "put":
+            return self._put(header)
+        if method == "delete":
+            return self._delete(header)
+        if method == "bind_pod":
+            # store.lock held across fence AND mutate (as in _put): a
+            # fence that releases the lock before the mutation is a
+            # TOCTOU hole for the stale write it exists to stop
+            with store.lock:
+                conflict = self._fence(
+                    "Pod", header["key"], header.get("base_rv")
+                )
+                if conflict is not None:
+                    return conflict
+                events = store.mutate(
+                    lambda: store.kube.bind_pod(
+                        header["key"], header["node_name"]
+                    ),
+                    origin=header.get("identity", ""),
+                )
+            return {"status": "ok", "events": events}
+        if method == "evict_pod":
+            with store.lock:
+                conflict = self._fence(
+                    "Pod", header["key"], header.get("base_rv")
+                )
+                if conflict is not None:
+                    return conflict
+                events = store.mutate(
+                    lambda: store.kube.evict_pod(header["key"]),
+                    origin=header.get("identity", ""),
+                )
+            return {"status": "ok", "events": events}
+        if method == "record_event":
+            return self._record_event(header)
+        if method == "lease_acquire":
+            return self._lease_acquire(header)
+        if method == "lease_renew":
+            return self._lease_renew(header)
+        if method == "lease_release":
+            return self._lease_release(header)
+        return {"status": "error", "error": f"unknown method {method}"}
+
+    def _put(self, header: dict) -> dict:
+        store = self.store
+        kind = header["kind"]
+        spec = STORE_KINDS.get(kind)
+        if spec is None or kind == "Lease":
+            return {"status": "error", "error": f"unwritable kind {kind}"}
+        cls, attr, key_fn = spec
+        obj = from_wire(header["obj"])
+        if not isinstance(obj, cls):
+            return {"status": "error", "error": f"object is not a {kind}"}
+        key = key_fn(obj)
+        with store.lock:
+            conflict = self._fence(kind, key, header.get("base_rv"))
+            if conflict is not None:
+                return conflict
+            verb = {
+                "Pod": store.kube.put_pod,
+                "Node": store.kube.put_node,
+                "NodeClaim": store.kube.put_node_claim,
+                "NodePool": store.kube.put_node_pool,
+                "NodeClass": store.kube.put_node_class,
+                "PodDisruptionBudget": store.kube.put_pdb,
+                "StorageClass": store.kube.put_storage_class,
+                "PersistentVolumeClaim": store.kube.put_pvc,
+            }[kind]
+            events = store.mutate(
+                lambda: verb(obj), origin=header.get("identity", "")
+            )
+            return {"status": "ok", "events": events}
+
+    def _fence(self, kind: str, key: str, base_rv) -> Optional[dict]:
+        """Optimistic-concurrency check shared by delete/bind/evict: a
+        deposed leader's straggler verb (stale base_rv) gets ``conflict``
+        with the current object instead of clobbering the new leader's
+        state — the same fencing ``put`` applies."""
+        store = self.store
+        with store.lock:
+            cur = store.rvs.get((kind, key), 0)
+            if base_rv is None or base_rv == cur:
+                return None
+            _cls, attr, _key_fn = STORE_KINDS[kind]
+            existing = getattr(store.kube, attr).get(key)
+            return {
+                "status": "conflict",
+                "rv": cur,
+                "obj": to_wire(existing) if existing is not None else None,
+            }
+
+    def _delete(self, header: dict) -> dict:
+        store = self.store
+        kind, key = header["kind"], header["key"]
+        spec = STORE_KINDS.get(kind)
+        if spec is None or kind == "Lease":
+            return {"status": "error", "error": f"undeletable kind {kind}"}
+        _cls, attr, _key_fn = spec
+        kube = store.kube
+
+        def apply() -> None:
+            if kind == "Pod":
+                kube.delete_pod(key)
+            elif kind == "Node":
+                kube.delete_node(key)
+            elif kind == "NodeClaim":
+                kube.delete_node_claim(key)
+            else:
+                obj = getattr(kube, attr).pop(key, None)
+                if obj is not None:
+                    kube._notify(kind, "delete", obj)
+
+        with store.lock:  # fence + mutate atomically (see bind_pod)
+            conflict = self._fence(kind, key, header.get("base_rv"))
+            if conflict is not None:
+                return conflict
+            events = store.mutate(apply, origin=header.get("identity", ""))
+        return {"status": "ok", "events": events}
+
+    def _record_event(self, header: dict) -> dict:
+        store = self.store
+        with store.lock:
+            store.kube.record_event(
+                header["kind"],
+                header["reason"],
+                header["obj_name"],
+                header.get("message", ""),
+            )
+            store.event_rv += 1
+            ev = {
+                "event_rv": store.event_rv,
+                "event": to_wire(tuple(store.kube.events[-1])),
+            }
+            for sub in store._subscribers:
+                if sub.identity != header.get("identity", ""):
+                    sub.q.put([{"kind": "Event", "verb": "append", **ev}])
+            return {"status": "ok", **ev}
+
+    # --------------------------------------------------------------- leases
+    def _lease_acquire(self, header: dict) -> dict:
+        store = self.store
+        name = header["name"]
+        with store.lock:
+            acquired = None
+
+            def apply() -> None:
+                nonlocal acquired
+                acquired = store.kube.try_acquire_lease(
+                    name,
+                    header["holder"],
+                    header["now"],
+                    header["duration_s"],
+                )
+                if acquired:
+                    # every successful acquire-or-renew advances the CAS
+                    # sequence so a competing renewer's base_rv goes stale
+                    store.lease_seq[name] = store.lease_seq.get(name, 0) + 1
+
+            events = store.mutate(apply, origin=header.get("identity", ""))
+            lease = store.kube.leases.get(name)
+            return {
+                "status": "ok",
+                "acquired": bool(acquired),
+                "rv": store.lease_seq.get(name, 0),
+                # rv of THIS call's broadcast Lease event (fresh acquire
+                # only; silent renewals broadcast nothing) — the
+                # originator credits exactly this toward synced_rv
+                "lease_event_rv": max((e["rv"] for e in events), default=0),
+                "lease": to_wire(lease) if lease is not None else None,
+            }
+
+    def _lease_renew(self, header: dict) -> dict:
+        store = self.store
+        name = header["name"]
+        with store.lock:
+            cur = store.lease_seq.get(name, 0)
+            base_rv = header.get("base_rv")
+            if base_rv is not None and base_rv != cur:
+                # someone else mutated the lease since this renewer last
+                # saw it — the renewal loses cleanly (optimistic CAS)
+                return {
+                    "status": "ok",
+                    "renewed": False,
+                    "conflict": True,
+                    "rv": cur,
+                }
+            renewed = store.kube.renew_lease(
+                name, header["holder"], header["now"]
+            )
+            if renewed:
+                store.lease_seq[name] = cur + 1
+            return {
+                "status": "ok",
+                "renewed": renewed,
+                "rv": store.lease_seq.get(name, 0),
+            }
+
+    def _lease_release(self, header: dict) -> dict:
+        store = self.store
+        name = header["name"]
+        with store.lock:
+            lease = store.kube.leases.get(name)
+            held = lease is not None and lease.holder == header["holder"]
+            events = store.mutate(
+                lambda: store.kube.release_lease(name, header["holder"]),
+                origin=header.get("identity", ""),
+            )
+            if held:
+                # only a release that actually freed the lease advances
+                # the CAS sequence: a retried/stale release from a
+                # non-holder is a no-op, and bumping the seq for it would
+                # stale-out the REAL holder's next renewal base_rv
+                store.lease_seq[name] = store.lease_seq.get(name, 0) + 1
+            return {
+                "status": "ok",
+                "rv": store.lease_seq.get(name, 0),
+                "lease_event_rv": max((e["rv"] for e in events), default=0),
+            }
+
+    # ---------------------------------------------------------------- watch
+    def serve_watch(self, sock, header: dict) -> None:
+        identity = header.get("identity", "")
+        snap, sub = self.store.subscribe(identity)
+        try:
+            send_frame(sock, encode({"status": "ok", "snapshot": snap}, {}))
+            while True:
+                events = sub.q.get()
+                if events is None:  # shutdown sentinel
+                    return
+                send_frame(sock, encode({"type": "events", "events": events}, {}))
+        except (ConnectionError, OSError):
+            return
+        finally:
+            self.store.unsubscribe(sub)
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server_address  # type: ignore[return-value]
+
+    def start_background(self) -> "StoreServer":
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="store-server"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self.store.lock:
+            for sub in self.store._subscribers:
+                sub.q.put(None)
+        self.shutdown()
+        self.server_close()
+
+
+def main(argv=None) -> int:
+    """``python -m karpenter_tpu store-server`` (also reachable as
+    ``python -m karpenter_tpu.service.store_server``)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu store-server",
+        description="karpenter-tpu shared cluster-store server",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8082)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = StoreServer(args.host, args.port)
+    log.info("cluster store listening on %s:%d", *server.address)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:  # pragma: no cover - CLI path
+        pass
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -170,9 +170,12 @@ class KubeStore:
         if node is not None:
             for p in self.pods_on_node(name):
                 # pods on a deleted node go back to pending (controller-owned
-                # pods are recreated by their controller in a real cluster)
+                # pods are recreated by their controller in a real cluster);
+                # each re-pend notifies so store replication (state/remote.py)
+                # ships the cascade, not just the node deletion
                 p.node_name = ""
                 p.phase = "Pending"
+                self._notify("Pod", "put", p)
             self._notify("Node", "delete", node)
 
     def node_by_provider_id(self, provider_id: str) -> Optional[Node]:
@@ -245,6 +248,7 @@ class KubeStore:
 
     def put_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
         self.pdbs[pdb.name] = pdb
+        self._notify("PodDisruptionBudget", "put", pdb)
         return pdb
 
     def daemonset_pods(self) -> List[Pod]:

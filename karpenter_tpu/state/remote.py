@@ -1,0 +1,653 @@
+"""Remote cluster-store client: a KubeStore mirror over the wire.
+
+`RemoteKubeStore` is a drop-in `KubeStore` (the Operator takes it
+unchanged): reads serve from a local mirror, every mutation verb applies
+locally AND forwards to the shared `StoreServer`
+(service/store_server.py), and a background watch stream applies other
+replicas' writes into the mirror — so a standby replica's caches stay
+warm and a failover leader starts from the durable state, exactly like
+the reference's informer-fed controllers over the kube-apiserver.
+
+Consistency model:
+
+- **Verbs** (put/delete/bind/evict/record_event) run the same
+  deterministic KubeStore logic locally, then forward; the server is
+  authoritative and assigns each object a resourceVersion.  Local object
+  IDENTITY is preserved — controllers that hold a reference to an object
+  they just put keep mutating the live mirror object.
+- **In-place mutations** (controllers stamp conditions/labels directly,
+  e.g. lifecycle.py) are picked up by shadow-diffing: before every Lease
+  operation — i.e. at least once per reconcile tick and per renewal —
+  `_flush_dirty` pushes every mirror object whose canonical encoding
+  drifted from the server's last-known bytes.  A leader crash loses at
+  most the unflushed tail of its last tick, the same as crashing before
+  those writes.
+- **Conflicts**: pushes carry the base resourceVersion; a stale write
+  (a deposed leader's straggler) gets ``conflict`` back and the client
+  adopts the server's object instead of clobbering.
+- **Leases** are never written generically: acquire/renew/release are
+  dedicated CAS RPCs, atomic server-side.  A store outage during a lease
+  call returns False — a leader that cannot prove its lease abdicates
+  (safety over liveness).
+- **Failures**: transient socket errors retry with bounded backoff;
+  request timeouts raise `StoreUnavailableError` (retryable) instead of
+  hanging.  The watch thread reconnects and resyncs from a fresh
+  snapshot, so a store restart mid-watch heals itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
+from karpenter_tpu.state.kube import KubeStore
+from karpenter_tpu.state.wire import STORE_KINDS, canonical, from_wire, to_wire
+
+log = logging.getLogger(__name__)
+
+RETRIES = 3
+BACKOFF_S = 0.05  # doubles per attempt
+
+
+class StoreUnavailableError(ConnectionError):
+    """The shared store could not be reached (after retries) or timed
+    out.  Retryable: the caller may re-issue the request."""
+
+
+class RemoteKubeStore(KubeStore):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8082,
+        identity: str = "",
+        connect_timeout: float = 5.0,
+        request_timeout: float = 10.0,
+        start_watch: bool = True,
+    ):
+        super().__init__()
+        self.host = host
+        self.port = port
+        self.identity = identity or f"client-{id(self):x}"
+        self.connect_timeout = connect_timeout
+        self.request_timeout = request_timeout
+        self._sock: Optional[socket.socket] = None
+        self._rpc_lock = threading.Lock()  # one in-flight RPC per conn
+        self._mirror_lock = threading.RLock()  # mirror + rv bookkeeping
+        self._lease_mutex = threading.Lock()  # lease ops end-to-end
+        self._rvs: Dict[Tuple[str, str], int] = {}
+        self._shadow: Dict[Tuple[str, str], str] = {}
+        self._lease_rvs: Dict[str, int] = {}
+        self._event_rv = 0
+        self.synced_rv = 0
+        self._stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        self._watch_sock: Optional[socket.socket] = None
+        if start_watch:
+            self.start_watch()
+
+    # ------------------------------------------------------------- transport
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.connect_timeout
+                )
+                self._sock.settimeout(self.request_timeout)
+            except OSError as exc:
+                raise StoreUnavailableError(
+                    f"cluster store at {self.host}:{self.port}: {exc}"
+                ) from exc
+        return self._sock
+
+    def _close_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _rpc(self, header: dict) -> dict:
+        """One request/response with bounded retry on transient errors.
+        Mutations here are idempotent re-applied (puts/deletes/lease CAS);
+        a retried record_event may at worst duplicate an event line."""
+        header = dict(header, identity=self.identity)
+        last: Optional[Exception] = None
+        for attempt in range(RETRIES):
+            with self._rpc_lock:
+                try:
+                    sock = self._connect()
+                    send_frame(sock, encode(header, {}))
+                    response, _ = decode(recv_frame(sock))
+                    break
+                except socket.timeout as exc:
+                    # a timed-out request must surface as retryable, not
+                    # hang or half-read the next response off the socket
+                    self._close_sock()
+                    raise StoreUnavailableError(
+                        f"store request {header.get('method')} timed out "
+                        f"after {self.request_timeout}s"
+                    ) from exc
+                except (ConnectionError, OSError) as exc:
+                    self._close_sock()
+                    last = exc
+            if attempt < RETRIES - 1:  # no pointless sleep after the last try
+                time.sleep(BACKOFF_S * (2**attempt))
+        else:
+            raise StoreUnavailableError(
+                f"cluster store at {self.host}:{self.port}: {last}"
+            ) from last
+        if response.get("status") == "error":
+            raise RuntimeError(f"store error: {response.get('error')}")
+        return response
+
+    # ------------------------------------------------------------ mirroring
+    def _record_applied(self, kind: str, key: str, obj, rv: int) -> None:
+        if obj is None:
+            self._rvs.pop((kind, key), None)
+            self._shadow.pop((kind, key), None)
+        else:
+            self._rvs[(kind, key)] = rv
+            self._shadow[(kind, key)] = canonical(obj)
+        self.synced_rv = max(self.synced_rv, rv)
+
+    def _locally_dirty(self, kind: str, key: str, obj) -> bool:
+        """Whether the mirror object carries state the server has not
+        acknowledged yet: its bytes differ from the last server-confirmed
+        encoding (or it was never pushed at all — an in-flight create).
+        Replication must never overwrite dirty local state; it reconciles
+        through the flush -> conflict -> adopt path instead."""
+        return self._shadow.get((kind, key)) != canonical(obj)
+
+    def _absorb_events(self, events, remote: bool) -> None:
+        """Apply server events to the mirror.
+
+        Own RPC responses (`remote=False`): the local verb already ran —
+        keep the local object (identity preserved for callers holding a
+        reference) and record rv + the SERVER's bytes as the shadow, so a
+        caller mutating the object right after the verb still diffs dirty
+        against what the server actually holds.
+
+        Watch events (`remote=True`): another replica wrote.  A clean
+        local entry adopts the server object; a DIRTY one is left alone —
+        this replica believes it is (or was) the writer, and the next
+        flush's rv conflict decides who wins without ever silently
+        clobbering either side."""
+        with self._mirror_lock:
+            for ev in events:
+                kind = ev["kind"]
+                if kind == "Event":
+                    if ev["event_rv"] > self._event_rv:
+                        self._event_rv = ev["event_rv"]
+                        if remote:
+                            self.events.append(from_wire(ev["event"]))
+                    continue
+                spec = STORE_KINDS.get(kind)
+                if spec is None:
+                    continue
+                _cls, attr, _key_fn = spec
+                key, rv = ev["key"], ev["rv"]
+                store_dict = getattr(self, attr)
+                if ev["verb"] == "delete":
+                    local = store_dict.get(key)
+                    if rv <= self._rvs.get((kind, key), 0):
+                        # a stale echo must not delete a newer object
+                        self.synced_rv = max(self.synced_rv, rv)
+                        continue
+                    if (
+                        remote
+                        and local is not None
+                        and self._locally_dirty(kind, key, local)
+                    ):
+                        # same dirty protection as the put path: an
+                        # in-flight local create/mutation is never
+                        # silently dropped by a watch delete — the next
+                        # flush's rv conflict resolves who wins
+                        self.synced_rv = max(self.synced_rv, rv)
+                        continue
+                    store_dict.pop(key, None)
+                    self._record_applied(kind, key, None, rv)
+                    if remote and local is not None:
+                        self._notify(kind, "delete", local)
+                    continue
+                if rv <= self._rvs.get((kind, key), 0):
+                    self.synced_rv = max(self.synced_rv, rv)
+                    continue
+                local = store_dict.get(key)
+                server_obj = from_wire(ev["obj"])  # decoded once, reused
+                server_enc = canonical(server_obj)
+                if not remote:
+                    # own write: local object IS the source of this event
+                    if local is None:  # deleted locally since; keep that
+                        self.synced_rv = max(self.synced_rv, rv)
+                        continue
+                    self._rvs[(kind, key)] = rv
+                    self._shadow[(kind, key)] = server_enc
+                    self.synced_rv = max(self.synced_rv, rv)
+                    continue
+                if local is not None and self._locally_dirty(kind, key, local):
+                    self.synced_rv = max(self.synced_rv, rv)
+                    continue
+                if local is not None and canonical(local) == server_enc:
+                    self._record_applied(kind, key, local, rv)
+                    continue
+                store_dict[key] = server_obj
+                self._record_applied(kind, key, server_obj, rv)
+                self._notify(kind, "put", server_obj)
+
+    def _forward(self, header: dict) -> dict:
+        response = self._rpc(header)
+        if response.get("status") == "conflict":
+            kind = header["kind"]
+            key = header.get("key")
+            if key is None:  # put headers carry the object, not the key
+                key = STORE_KINDS[kind][2](from_wire(header["obj"]))
+            # Whose write won?  If the server's bytes equal what WE tried
+            # to push, the "conflict" is our own racing flush (the verb's
+            # forward and the renewal thread's flush both shipping the
+            # same object): keep the LOCAL object so callers holding a
+            # reference keep mutating live state, and just record rv +
+            # server bytes.  Only a genuinely foreign write adopts the
+            # server's clone.
+            server_wire = response.get("obj")
+            pushed_wire = header.get("obj")
+            if (
+                server_wire is not None
+                and pushed_wire is not None
+                and canonical(from_wire(server_wire))
+                == canonical(from_wire(pushed_wire))
+            ):
+                with self._mirror_lock:
+                    local = getattr(self, STORE_KINDS[kind][1]).get(key)
+                    if local is not None:
+                        self._rvs[(kind, key)] = response["rv"]
+                        self._shadow[(kind, key)] = canonical(
+                            from_wire(server_wire)
+                        )
+                        return response
+            log.warning(
+                "store write conflict on %s/%s (rv %s); adopting server state",
+                kind, key, response.get("rv"),
+            )
+            self._adopt(kind, key, server_wire, response["rv"])
+            return response
+        self._absorb_events(response.get("events", ()), remote=False)
+        return response
+
+    def _adopt(self, kind: str, key: str, obj_wire, rv: int) -> None:
+        _cls, attr, _key_fn = STORE_KINDS[kind]
+        with self._mirror_lock:
+            store_dict = getattr(self, attr)
+            if obj_wire is None:
+                store_dict.pop(key, None)
+                self._record_applied(kind, key, None, rv)
+                self.synced_rv = max(self.synced_rv, rv)
+            else:
+                obj = from_wire(obj_wire)
+                store_dict[key] = obj
+                self._record_applied(kind, key, obj, rv)
+
+    # -------------------------------------------------------------- flushing
+    def _flush_dirty(self) -> None:
+        """Push every mirror object whose canonical bytes drifted from the
+        server's last-known encoding (in-place mutations by controllers).
+        Runs before every lease operation — at least once per tick.
+
+        Cost note: this is an O(mirror) encode per lease operation — the
+        full sweep is deliberate, because in-place mutations by design
+        leave no hook to mark keys dirty; encoding is the only general
+        detector.  The scan runs concurrently with the reconcile thread's
+        unlocked in-place mutations, so a single object's encode can
+        observe a torn state or raise (dict mutated during iteration):
+        such objects are simply skipped this round — they are still dirty
+        next round, and the background renewal retries within
+        RETRY_PERIOD."""
+        with self._mirror_lock:
+            dirty = []
+            for kind, (_cls, attr, key_fn) in STORE_KINDS.items():
+                if kind == "Lease":
+                    continue  # leases only move through the CAS RPCs
+                for key, obj in list(getattr(self, attr).items()):
+                    try:
+                        enc = canonical(obj)
+                    except RuntimeError:  # torn concurrent mutation
+                        continue
+                    if self._shadow.get((kind, key)) != enc:
+                        dirty.append((kind, key, obj))
+        for kind, key, obj in dirty:
+            try:
+                wire_obj = to_wire(obj)
+            except RuntimeError:  # torn since the scan; next round
+                continue
+            try:
+                self._forward(
+                    {
+                        "method": "put",
+                        "kind": kind,
+                        "obj": wire_obj,
+                        "base_rv": self._rvs.get((kind, key), 0),
+                    }
+                )
+            except StoreUnavailableError:
+                raise  # the lease op turns this into abdication
+            except Exception:
+                # e.g. server-side validation rejecting one object must
+                # not abort the rest of the flush or kill a renewal
+                log.exception("flush of %s/%s failed; skipping", kind, key)
+
+    # ------------------------------------------------------ overridden verbs
+    def _put_and_forward(self, kind: str, obj, local_put) -> object:
+        with self._mirror_lock:
+            result = local_put(obj)
+            base = self._rvs.get((kind, STORE_KINDS[kind][2](obj)), 0)
+        self._forward(
+            {"method": "put", "kind": kind, "obj": to_wire(obj), "base_rv": base}
+        )
+        return result
+
+    def put_pod(self, pod):
+        return self._put_and_forward("Pod", pod, super().put_pod)
+
+    def put_node(self, node):
+        return self._put_and_forward("Node", node, super().put_node)
+
+    def put_node_claim(self, claim):
+        return self._put_and_forward("NodeClaim", claim, super().put_node_claim)
+
+    def put_node_pool(self, pool):
+        return self._put_and_forward("NodePool", pool, super().put_node_pool)
+
+    def put_node_class(self, nc):
+        return self._put_and_forward("NodeClass", nc, super().put_node_class)
+
+    def put_storage_class(self, sc):
+        return self._put_and_forward(
+            "StorageClass", sc, super().put_storage_class
+        )
+
+    def put_pvc(self, pvc):
+        return self._put_and_forward(
+            "PersistentVolumeClaim", pvc, super().put_pvc
+        )
+
+    def put_pdb(self, pdb):
+        return self._put_and_forward("PodDisruptionBudget", pdb, super().put_pdb)
+
+    def _delete_and_forward(self, kind: str, key: str, local_delete) -> None:
+        with self._mirror_lock:
+            base = self._rvs.get((kind, key), 0)
+            local_delete(key)
+        # base_rv fences a deposed leader's straggler deletes exactly like
+        # stale puts: the server rejects if someone wrote the object since
+        self._forward(
+            {"method": "delete", "kind": kind, "key": key, "base_rv": base}
+        )
+
+    def delete_pod(self, key: str) -> None:
+        self._delete_and_forward("Pod", key, super().delete_pod)
+
+    def delete_node(self, name: str) -> None:
+        self._delete_and_forward("Node", name, super().delete_node)
+
+    def delete_node_claim(self, name: str) -> None:
+        self._delete_and_forward("NodeClaim", name, super().delete_node_claim)
+
+    def bind_pod(self, key: str, node_name: str) -> None:
+        with self._mirror_lock:
+            base = self._rvs.get(("Pod", key), 0)
+            super().bind_pod(key, node_name)
+        self._forward(
+            {
+                "method": "bind_pod",
+                "kind": "Pod",
+                "key": key,
+                "node_name": node_name,
+                "base_rv": base,
+            }
+        )
+
+    def evict_pod(self, key: str) -> None:
+        with self._mirror_lock:
+            base = self._rvs.get(("Pod", key), 0)
+            super().evict_pod(key)
+        self._forward(
+            {"method": "evict_pod", "kind": "Pod", "key": key, "base_rv": base}
+        )
+
+    def record_event(self, kind, reason, obj_name, message=""):
+        super().record_event(kind, reason, obj_name, message)
+        try:
+            response = self._rpc(
+                {
+                    "method": "record_event",
+                    "kind": kind,
+                    "reason": reason,
+                    "obj_name": obj_name,
+                    "message": message,
+                }
+            )
+        except StoreUnavailableError as exc:
+            # events are advisory; a store blip must not fail a reconcile
+            log.warning("event %s/%s not recorded remotely: %s", kind, reason, exc)
+            return
+        self._event_rv = max(self._event_rv, response.get("event_rv", 0))
+
+    # ---------------------------------------------------------------- leases
+    # _lease_mutex serializes each lease operation END-TO-END (header
+    # construction through _lease_rvs update): without it the background
+    # renewal thread can read its base_rv, lose the CPU to the tick's
+    # acquire (which bumps the server's lease_seq), and then land a
+    # stale-base renewal — a spurious conflict that abdicates a healthy
+    # leader mid-tick.
+
+    def try_acquire_lease(self, name, holder, now, duration_s) -> bool:
+        with self._lease_mutex:
+            try:
+                self._flush_dirty()
+                response = self._rpc(
+                    {
+                        "method": "lease_acquire",
+                        "name": name,
+                        "holder": holder,
+                        "now": now,
+                        "duration_s": duration_s,
+                    }
+                )
+            except StoreUnavailableError as exc:
+                log.warning("lease acquire unavailable (%s); abdicating", exc)
+                return False
+            self._lease_rvs[name] = response.get("rv", 0)
+            # a fresh acquire's broadcast event is not echoed back to the
+            # originator, so credit exactly THAT event's rv here or
+            # wait_synced stalls on our own acquires.  (Never the server's
+            # global rv: that would claim sync for other replicas' events
+            # still queued on our watch socket.)
+            self.synced_rv = max(
+                self.synced_rv, response.get("lease_event_rv", 0)
+            )
+            if response.get("lease") is not None:
+                with self._mirror_lock:
+                    lease = from_wire(response["lease"])
+                    self.leases[name] = lease
+                    # record rv/shadow too: an installed-but-untracked
+                    # Lease reads as permanently dirty, which would make
+                    # _absorb_events skip every later foreign Lease event
+                    # and freeze a stale holder into this mirror forever
+                    self._record_applied(
+                        "Lease",
+                        name,
+                        lease,
+                        max(
+                            self._rvs.get(("Lease", name), 0),
+                            response.get("lease_event_rv", 0),
+                        ),
+                    )
+            return bool(response["acquired"])
+
+    def renew_lease(self, name, holder, now) -> bool:
+        with self._lease_mutex:
+            try:
+                self._flush_dirty()
+                response = self._rpc(
+                    {
+                        "method": "lease_renew",
+                        "name": name,
+                        "holder": holder,
+                        "now": now,
+                        "base_rv": self._lease_rvs.get(name),
+                    }
+                )
+            except StoreUnavailableError as exc:
+                log.warning("lease renew unavailable (%s); abdicating", exc)
+                return False
+            self._lease_rvs[name] = response.get("rv", 0)
+            self.synced_rv = max(
+                self.synced_rv, response.get("lease_event_rv", 0)
+            )
+            return bool(response["renewed"])
+
+    def release_lease(self, name, holder) -> None:
+        with self._lease_mutex:
+            try:
+                self._flush_dirty()
+                response = self._rpc(
+                    {"method": "lease_release", "name": name, "holder": holder}
+                )
+                self._lease_rvs[name] = response.get("rv", 0)
+                self.synced_rv = max(
+                    self.synced_rv, response.get("lease_event_rv", 0)
+                )
+            except StoreUnavailableError as exc:  # best-effort: expiry fences
+                log.warning("lease release unavailable (%s)", exc)
+            with self._mirror_lock:
+                lease = self.leases.get(name)
+                if lease is not None and lease.holder == holder:
+                    lease.holder = ""
+                    lease.renewed_at = 0.0
+                    # refresh the shadow so the mirror entry stays clean
+                    # for later foreign Lease events (see try_acquire)
+                    self._record_applied(
+                        "Lease",
+                        name,
+                        lease,
+                        self._rvs.get(("Lease", name), 0),
+                    )
+
+    # ----------------------------------------------------------------- watch
+    def start_watch(self) -> None:
+        if self._watch_thread is not None:
+            return
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop,
+            daemon=True,
+            name=f"store-watch-{self.identity}",
+        )
+        self._watch_thread.start()
+
+    def _watch_loop(self) -> None:
+        import struct
+
+        backoff = BACKOFF_S
+        while not self._stop.is_set():
+            sock = None
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.connect_timeout
+                )
+                send_frame(
+                    sock,
+                    encode({"method": "watch", "identity": self.identity}, {}),
+                )
+                header, _ = decode(recv_frame(sock))
+                self._apply_snapshot(header["snapshot"])
+                backoff = BACKOFF_S
+                # BLOCKING reads: a short recv timeout could fire
+                # mid-frame and desync the stream (the consumed prefix is
+                # lost and the next read parses payload bytes as a length
+                # header).  close() interrupts the blocking recv by
+                # closing this socket instead.
+                sock.settimeout(None)
+                self._watch_sock = sock
+                while not self._stop.is_set():
+                    frame, _ = decode(recv_frame(sock))
+                    self._absorb_events(frame.get("events", ()), remote=True)
+            except (ConnectionError, OSError, ValueError, struct.error):
+                if self._stop.wait(backoff):
+                    break
+                backoff = min(backoff * 2, 1.0)
+            finally:
+                self._watch_sock = None
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+    def _apply_snapshot(self, snap: dict) -> None:
+        """Full-state resync: adopt the server's objects, drop mirror
+        entries the server no longer has (store restart / reconnect).
+        Locally DIRTY entries are kept as-is — in-flight creates and
+        unflushed in-place mutations reconcile through the next flush,
+        never by a racing snapshot clobbering them (lost-update hazard)."""
+        with self._mirror_lock:
+            for kind, (_cls, attr, _key_fn) in STORE_KINDS.items():
+                entries = snap["kinds"].get(kind, {})
+                store_dict = getattr(self, attr)
+                for key in list(store_dict):
+                    # drop only keys the server has acknowledged before
+                    # (recorded rv): an absent rv means an in-flight local
+                    # create the server simply hasn't seen yet
+                    if key not in entries and (kind, key) in self._rvs:
+                        old = store_dict.pop(key)
+                        self._record_applied(kind, key, None, 0)
+                        self._notify(kind, "delete", old)
+                for key, entry in entries.items():
+                    obj_wire, rv = entry["obj"], entry["rv"]
+                    local = store_dict.get(key)
+                    if local is not None and (
+                        rv <= self._rvs.get((kind, key), 0)
+                        or self._locally_dirty(kind, key, local)
+                    ):
+                        self.synced_rv = max(self.synced_rv, rv)
+                        continue
+                    server_obj = from_wire(obj_wire)  # decoded once, reused
+                    if local is not None and canonical(local) == canonical(
+                        server_obj
+                    ):
+                        self._record_applied(kind, key, local, rv)
+                        continue
+                    store_dict[key] = server_obj
+                    self._record_applied(kind, key, server_obj, rv)
+                    self._notify(kind, "put", server_obj)
+            self.events = [from_wire(e) for e in snap.get("events", [])]
+            self._event_rv = snap.get("event_rv", self._event_rv)
+            self.synced_rv = max(self.synced_rv, snap.get("rv", 0))
+
+    def wait_synced(self, min_rv: Optional[int] = None, timeout: float = 5.0) -> bool:
+        """Block until the mirror has applied every server mutation up to
+        ``min_rv`` (default: the server's current rv).  Test/handoff
+        helper: a standby asserts its mirror is warm before acting."""
+        if min_rv is None:
+            min_rv = self._rpc({"method": "stat"})["rv"]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.synced_rv >= min_rv:
+                return True
+            time.sleep(0.005)
+        return self.synced_rv >= min_rv
+
+    def close(self) -> None:
+        self._stop.set()
+        self._close_sock()
+        watch_sock = self._watch_sock
+        if watch_sock is not None:  # interrupt the blocking watch recv
+            try:
+                watch_sock.close()
+            except OSError:
+                pass
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2.0)
+            self._watch_thread = None

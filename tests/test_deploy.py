@@ -107,7 +107,14 @@ class TestChart:
             if not t.startswith("waived")
         }
         extras = have - mapped
-        assert extras == {"solver-deployment.yaml"}, extras
+        # solver sidecar + store backend: no reference counterparts — the
+        # reference's solver doesn't exist and its durable store IS the
+        # kube-apiserver; both are this build's own distributed halves
+        assert extras == {
+            "solver-deployment.yaml",
+            "store-deployment.yaml",
+            "store-service.yaml",
+        }, extras
 
     def test_rendered_settings_load_as_real_settings(self, tmp_path):
         """The configmap's settings.json must be accepted verbatim by
@@ -127,11 +134,16 @@ class TestChart:
     def test_controller_matches_entry_point_contract(self):
         docs = _docs()
         dep = docs[("Deployment", "karpenter-tpu")]
-        assert dep["spec"]["replicas"] == 2  # reference Makefile:25-28
+        # SAFE default: 1 replica — the reference ships 2, but its durable
+        # store is the apiserver; here 2 is legitimate only with the
+        # shared-store backend (see TestStoreBackend.test_ha_render)
+        assert dep["spec"]["replicas"] == 1
         (c,) = dep["spec"]["template"]["spec"]["containers"]
         assert c["command"] == ["python", "-m", "karpenter_tpu"]
         assert any(a.startswith("--settings-file=") for a in c["args"])
         assert any(a.startswith("--solver-address=") for a in c["args"])
+        # no store backend by default -> no store client flag
+        assert not any(a.startswith("--store-address=") for a in c["args"])
         port = c["ports"][0]["containerPort"]
         assert c["livenessProbe"]["httpGet"]["port"] == port
         assert c["resources"]["requests"] == {"cpu": "1", "memory": "1Gi"}
@@ -171,7 +183,14 @@ class TestChart:
     def test_set_overrides(self):
         docs = {}
         for rendered in render_chart(
-            CHART, {**SET, "replicas": "3", "solver.port": "9999"}
+            CHART,
+            {
+                **SET,
+                "replicas": "3",
+                "solver.port": "9999",
+                # replicas > 1 only renders with the shared store backend
+                "store.enabled": "true",
+            },
         ):
             for d in yaml.safe_load_all(rendered):
                 if d:
@@ -202,6 +221,58 @@ class TestChart:
     def test_bad_json_in_settings_fails_at_render_time(self):
         with pytest.raises(ValueError, match="not valid JSON"):
             render_chart(CHART, {"settings.cluster_name": 'evil"quote'})
+
+
+class TestStoreBackend:
+    """The shared cluster-store backend (ADVICE r5 medium): replicas > 1
+    is legitimate only when the replicas actually share durable state."""
+
+    def _docs(self, overrides):
+        docs = {}
+        for rendered in render_chart(CHART, {**SET, **overrides}):
+            for d in yaml.safe_load_all(rendered):
+                if d:
+                    docs[(d["kind"], d["metadata"]["name"])] = d
+        return docs
+
+    def test_default_renders_no_store(self):
+        docs = self._docs({})
+        assert ("Deployment", "karpenter-tpu-store") not in docs
+        assert ("Service", "karpenter-tpu-store") not in docs
+
+    def test_two_replicas_without_store_refuses_to_render(self):
+        with pytest.raises(ValueError, match="store.enabled"):
+            render_chart(CHART, {**SET, "replicas": "2"})
+
+    def test_ha_render(self):
+        """replicas: 2 + store.enabled: the full HA shape — shared store
+        Deployment/Service, controllers dialing it as clients."""
+        docs = self._docs({"replicas": "2", "store.enabled": "true"})
+        dep = docs[("Deployment", "karpenter-tpu")]
+        assert dep["spec"]["replicas"] == 2
+        (c,) = dep["spec"]["template"]["spec"]["containers"]
+        assert "--store-address=karpenter-tpu-store:8082" in c["args"]
+        store = docs[("Deployment", "karpenter-tpu-store")]
+        # the store is the durable single point: one replica, Recreate
+        assert store["spec"]["replicas"] == 1
+        assert store["spec"]["strategy"]["type"] == "Recreate"
+        (sc,) = store["spec"]["template"]["spec"]["containers"]
+        assert sc["command"][-1] == "store-server"
+        assert "--host=0.0.0.0" in sc["args"]
+        svc = docs[("Service", "karpenter-tpu-store")]
+        assert (
+            svc["spec"]["selector"].items()
+            <= store["spec"]["template"]["metadata"]["labels"].items()
+        )
+        assert svc["spec"]["ports"][0]["port"] == 8082
+
+    def test_store_alone_renders_without_ha(self):
+        """store.enabled with replicas: 1 is fine (rolling toward HA)."""
+        docs = self._docs({"store.enabled": "true"})
+        assert ("Deployment", "karpenter-tpu-store") in docs
+        dep = docs[("Deployment", "karpenter-tpu")]
+        (c,) = dep["spec"]["template"]["spec"]["containers"]
+        assert any(a.startswith("--store-address=") for a in c["args"])
 
 
 class TestCRDs:

@@ -1,0 +1,421 @@
+"""Election storm (VERDICT r5 item 6): two real Operators over ONE
+shared StoreServer under the shuffled-controller fuzzer.
+
+This is the proof the shared-store subsystem exists for: with durable
+state in one place (service/store_server.py) and both replicas dialing
+it as clients (state/remote.py), the Lease election is real — so the
+single-writer invariant must hold through every failover mode:
+
+- **crash**: the leader stops ticking and renewing; the standby takes
+  over after lease expiry.
+- **graceful release**: the leader frees the Lease mid-tick (the SIGTERM
+  path); the standby takes over immediately.
+- **renewal loss**: the leader's renewal thread is lost mid-tick and the
+  clock jumps past expiry; the standby legitimately acquires, and the
+  old leader must self-fence (LeaderElector.still_leading) before its
+  next controller mutates anything.
+
+Invariants asserted: every NodeClaim launch came from the replica that
+held a valid lease in that round and no claim launches twice; nomination
+writers never overlap in a round; and the converged end state passes the
+consistency checker with kube and cloud in agreement.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import NodeClass, NodePool, Pod, Resources, Settings
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import SelectorTerm, tolerates_all
+from karpenter_tpu.cloud.fake.backend import FakeCloud, generate_catalog
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.service.store_server import StoreServer
+from karpenter_tpu.state.kube import Node
+from karpenter_tpu.state.remote import RemoteKubeStore
+from karpenter_tpu.testing import FAST_BATCH_WINDOWS
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.leader import LEASE_DURATION_S, LeaderElector
+
+N_TICKS = 46
+CRASH_AT, REJOIN_AT, RELEASE_AT, RENEWAL_LOSS_AT = 10, 18, 26, 36
+
+
+class StormHarness:
+    """Two real Operators + electors over one StoreServer and one cloud,
+    driven deterministically from the test thread (the same shape as
+    tests/test_race.py's fuzzer, with the store crossing real sockets)."""
+
+    def __init__(self):
+        self.server = StoreServer().start_background()
+        host, port = self.server.address
+        self.clock = FakeClock()
+        self.cloud = FakeCloud(
+            self.clock, shapes=generate_catalog()
+        ).with_default_topology()
+        settings = Settings(cluster_name="storm")
+        self.ops = {}
+        self.kubes = {}
+        self.launches = []  # (round, replica, claim_name)
+        self.round_no = 0
+        for name in ("replica-a", "replica-b"):
+            kube = RemoteKubeStore(host, port, identity=name)
+            elector = LeaderElector(kube, self.clock, name)
+            op = Operator(
+                self.cloud,
+                kube,
+                settings=settings,
+                clock=self.clock,
+                registry=Registry(),
+                batch_windows=FAST_BATCH_WINDOWS,
+                elector=elector,
+            )
+            self._instrument_launches(op, name)
+            self.kubes[name] = kube
+            self.ops[name] = op
+        # cluster defaults through one client; the other sees them via
+        # its watch stream
+        kube = self.kubes["replica-a"]
+        kube.put_node_class(
+            NodeClass(
+                name="default",
+                subnet_selector_terms=[SelectorTerm.of(Name="*")],
+                security_group_selector_terms=[SelectorTerm.of(Name="*")],
+            )
+        )
+        kube.put_node_pool(NodePool(name="default", node_class_ref="default"))
+        self.sync()
+
+    def _instrument_launches(self, op, name):
+        orig = op.cloud_provider.create
+
+        def create(claim, _orig=orig, _name=name):
+            self.launches.append((self.round_no, _name, claim.name))
+            return _orig(claim)
+
+        op.cloud_provider.create = create
+
+    # ------------------------------------------------------------- plumbing
+    def close(self):
+        for kube in self.kubes.values():
+            kube.close()
+        self.server.stop()
+
+    def sync(self, note: str = ""):
+        for name, kube in self.kubes.items():
+            if not kube.wait_synced(timeout=10.0):
+                from karpenter_tpu.state.wire import STORE_KINDS, canonical
+
+                dirty = [
+                    (kind, key, kube._rvs.get((kind, key)))
+                    for kind, (_c, attr, _k) in STORE_KINDS.items()
+                    for key, obj in getattr(kube, attr).items()
+                    if kube._shadow.get((kind, key)) != canonical(obj)
+                ]
+                raise AssertionError(
+                    f"mirror {name} failed to sync ({note}): "
+                    f"synced_rv={kube.synced_rv} "
+                    f"server_rv={self.server.store.rv} dirty={dirty[:6]}"
+                )
+
+    def _controllers(self, op):
+        return [
+            ("nodeclass", op.node_class_controller),
+            ("provisioner", op.provisioner),
+            ("lifecycle", op.lifecycle),
+            ("disruption", op.disruption),
+            ("termination", op.termination),
+            ("link", op.link),
+            ("garbagecollection", op.garbage_collection),
+            ("tagging", op.tagging),
+        ]
+
+    def shuffled_tick(self, name, rng, mid_tick_hook=None):
+        """One elector-gated tick with shuffled controller order —
+        operator.reconcile_once's gating (acquire, then a still_leading
+        check before every controller) with the fuzzer's shuffle."""
+        op = self.ops[name]
+        if not op.elector.acquire_or_renew():
+            return None
+        ran = []
+        seq = self._controllers(op)
+        rng.shuffle(seq)
+        for i, (cname, controller) in enumerate(seq):
+            if not op.elector.still_leading():
+                break
+            controller.reconcile()
+            ran.append(cname)
+            if mid_tick_hook is not None:
+                mid_tick_hook(i)
+        return ran
+
+    def kubelet_step(self):
+        """FakeKubelet's job over the shared store: register Nodes for
+        running instances, bind pods the CURRENT leader nominated."""
+        kube = self.kubes["replica-a"]  # any client: writes replicate
+        now = self.clock.now()
+        for claim in list(kube.node_claims.values()):
+            if not claim.provider_id or claim.deleted_at is not None:
+                continue
+            inst = self.cloud.instances.get(claim.provider_id)
+            if inst is None or inst.state != "running":
+                continue
+            if kube.node_by_provider_id(claim.provider_id) is not None:
+                continue
+            labels = dict(claim.labels)
+            labels[L.LABEL_HOSTNAME] = claim.name
+            kube.put_node(
+                Node(
+                    name=claim.name,
+                    provider_id=claim.provider_id,
+                    labels=labels,
+                    taints=list(claim.taints),
+                    capacity=claim.capacity,
+                    allocatable=claim.allocatable,
+                    ready=True,
+                    created_at=now,
+                )
+            )
+        # leader-first nomination lookup: a deposed replica's in-memory
+        # nominations are inert, exactly like a deposed process's heap
+        ordered = sorted(
+            self.ops.items(), key=lambda kv: not kv[1].elector.leading
+        )
+        for pod in list(kube.pods.values()):
+            if pod.node_name or pod.phase != "Pending":
+                continue
+            for _name, op in ordered:
+                target = op.cluster.nominated_node(pod.key())
+                if target is None:
+                    continue
+                node = kube.nodes.get(target)
+                if node is None or not node.ready or node.cordoned:
+                    continue
+                if not tolerates_all(pod.tolerations, node.taints):
+                    continue
+                kube.bind_pod(pod.key(), node.name)
+                op.cluster.clear_nomination(pod.key())
+                break
+
+    def settle(self, max_rounds=40):
+        for _ in range(max_rounds):
+            if not self.kubes["replica-a"].pending_pods():
+                break
+            self.clock.step(2.0)
+            self.sync()
+            self.kubelet_step()
+            self.sync()
+            for op in self.ops.values():
+                op.reconcile_once()
+            self.sync()
+            self.kubelet_step()
+            self.sync()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_election_storm_single_writer_invariant(seed):
+    rng = random.Random(seed)
+    h = StormHarness()
+    try:
+        live_pods = []
+        crashed = set()
+        round_writers = {}  # round -> [replica names that ran controllers]
+        failover_rounds = set()
+
+        for tick in range(N_TICKS):
+            h.round_no = tick
+            # -- workload churn (through one client; replicates to both)
+            kube = h.kubes["replica-a"]
+            ev = rng.random()
+            if ev < 0.45:
+                p = Pod(
+                    requests=Resources(
+                        cpu=rng.choice([0.5, 1, 2]), memory="1Gi"
+                    )
+                )
+                kube.put_pod(p)
+                live_pods.append(p)
+            elif ev < 0.55 and live_pods:
+                kube.delete_pod(live_pods.pop().key())
+            elif ev < 0.60:
+                running = [
+                    i
+                    for i in h.cloud.instances.values()
+                    if i.state == "running"
+                ]
+                if running:  # out-of-band kill
+                    h.cloud.terminate_instances([rng.choice(running).id])
+
+            h.clock.step(rng.choice([0.5, 1.0, 2.0]))
+            h.sync(f"tick {tick} pre-kubelet")
+            h.kubelet_step()
+            h.sync(f"tick {tick} post-kubelet")
+
+            # -- forced failover modes
+            if tick == CRASH_AT:
+                leader = next(
+                    n for n, op in h.ops.items() if op.elector.leading
+                )
+                crashed.add(leader)  # stops ticking AND renewing
+                failover_rounds.add(tick)
+            if tick == REJOIN_AT:
+                crashed.clear()  # deposed replica rejoins as standby
+            # expire the crashed leader's lease so the standby takes over
+            if CRASH_AT <= tick < REJOIN_AT:
+                h.clock.step(LEASE_DURATION_S / 3 + 1)
+
+            writers = []
+            noms_added = {}
+            order = sorted(h.ops)  # a then b; shuffle the order too
+            rng.shuffle(order)
+            for name in order:
+                if name in crashed:
+                    continue
+                op = h.ops[name]
+                before = set(op.cluster._nominations)
+
+                hook = None
+                if tick == RELEASE_AT and op.elector.leading:
+                    # graceful handoff forced mid-tick (the SIGTERM path)
+                    failover_rounds.add(tick)
+
+                    def hook(i, _op=op):
+                        if i == 2:
+                            _op.elector.release()
+
+                if tick == RENEWAL_LOSS_AT and op.elector.leading:
+                    # renewal thread lost: the clock jumps past expiry
+                    # mid-tick and the standby immediately acquires; the
+                    # old leader must run ZERO further controllers
+                    failover_rounds.add(tick)
+                    standby = next(n for n in h.ops if n != name)
+
+                    def hook(i, _standby=standby):
+                        if i == 2:
+                            h.clock.step(LEASE_DURATION_S + 1)
+                            assert h.ops[_standby].elector.acquire_or_renew()
+
+                ran = h.shuffled_tick(name, rng, mid_tick_hook=hook)
+                if ran is not None:
+                    writers.append(name)
+                    if tick == RENEWAL_LOSS_AT and len(ran) >= 3 and hook:
+                        # self-fence: exactly the 3 pre-expiry controllers
+                        assert len(ran) == 3, (
+                            f"deposed leader kept reconciling: {ran}"
+                        )
+                added = set(op.cluster._nominations) - before
+                if added:
+                    noms_added[name] = added
+
+            round_writers[tick] = writers
+            # single-writer: two replicas may run in one round only
+            # across an explicit failover handoff
+            if len(writers) > 1:
+                assert tick in failover_rounds, (tick, writers)
+            # no duplicate nomination: writers in one round never
+            # nominate the same pod
+            if len(noms_added) > 1:
+                keys = list(noms_added.values())
+                assert not (keys[0] & keys[1]), noms_added
+
+            h.sync(f"tick {tick} post-ticks")
+            h.kubelet_step()
+            h.sync(f"tick {tick} final")
+
+        # -- every launch came from a replica that was a writer that round
+        for rnd, name, claim in h.launches:
+            assert name in round_writers.get(rnd, ()), (rnd, name, claim)
+        # -- no NodeClaim double-launch, across every failover
+        names = [c for _, _, c in h.launches]
+        assert len(names) == len(set(names)), (
+            f"double-launched claims: {[c for c in names if names.count(c) > 1]}"
+        )
+        assert len({n for _, n, _ in h.launches}) == 2, (
+            "failovers should have made BOTH replicas lead at some point"
+        )
+
+        # -- convergence: settle under the final leader, then check the
+        # usual kube<->cloud agreement and the consistency oracle
+        h.settle()
+        for _ in range(3):
+            h.clock.step(35.0)
+            h.sync()
+            h.kubelet_step()
+            for op in h.ops.values():
+                op.reconcile_once()
+            h.sync()
+            h.kubelet_step()
+            h.sync()
+        h.settle(max_rounds=20)
+        kube = h.kubes["replica-a"]
+        assert not kube.pending_pods()
+        live_claims = {
+            c.provider_id
+            for c in kube.node_claims.values()
+            if c.deleted_at is None and c.provider_id
+        }
+        running = {
+            i.id for i in h.cloud.instances.values() if i.state == "running"
+        }
+        assert live_claims <= running
+        # the consistency checker is the invariant oracle
+        from karpenter_tpu.controllers.consistency import CHECK_PERIOD
+
+        leader = next(
+            (op for op in h.ops.values() if op.elector.leading), None
+        )
+        assert leader is not None, "storm ended with no leader"
+        h.clock.step(CHECK_PERIOD + 1)
+        leader.consistency.reconcile()
+        h.sync()
+        violations = [
+            e for e in kube.events if e[1] == "ConsistencyViolation"
+        ]
+        assert not violations, violations
+    finally:
+        h.close()
+
+
+def test_failover_preserves_scheduled_state():
+    """The regression the shared store exists to prevent: after a leader
+    crash, the NEW leader sees the old leader's claims through the store
+    and does NOT re-launch capacity for pods that are already placed."""
+    h = StormHarness()
+    try:
+        rng = random.Random(7)
+        kube_a = h.kubes["replica-a"]
+        for _ in range(6):
+            kube_a.put_pod(Pod(requests=Resources(cpu=1, memory="2Gi")))
+        # A leads and provisions; kubelet registers + binds
+        assert h.shuffled_tick("replica-a", rng) is not None
+        for _ in range(10):
+            h.clock.step(2.0)
+            h.sync()
+            h.kubelet_step()
+            h.sync()
+            h.shuffled_tick("replica-a", rng)
+            if not kube_a.pending_pods():
+                break
+        h.sync()
+        h.kubelet_step()
+        h.sync()
+        assert not kube_a.pending_pods()
+        launched_before = len(h.launches)
+        claims_before = set(kube_a.node_claims)
+        # A crashes; the lease expires; B takes over with a warm mirror
+        h.clock.step(LEASE_DURATION_S + 1)
+        assert h.shuffled_tick("replica-b", rng) is not None
+        assert h.ops["replica-b"].elector.leading
+        for _ in range(4):
+            h.clock.step(2.0)
+            h.sync()
+            h.kubelet_step()
+            h.sync()
+            h.shuffled_tick("replica-b", rng)
+        # B saw the placed pods + claims via the store: nothing re-launched
+        assert len(h.launches) == launched_before, h.launches[launched_before:]
+        kube_b = h.kubes["replica-b"]
+        assert set(kube_b.node_claims) == claims_before
+    finally:
+        h.close()

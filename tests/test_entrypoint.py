@@ -109,6 +109,120 @@ class TestEntryPoint:
                 proc.wait(timeout=10)
 
 
+class TestSharedStoreEndToEnd:
+    def test_store_server_and_two_controllers(self):
+        """The full HA shape as real processes: `python -m karpenter_tpu
+        store-server` plus two `--store-address` controllers.  Exactly one
+        controller leads; SIGTERM-ing it releases the Lease and the
+        standby takes over (the chart's replicas: 2 + store.enabled
+        deployment, in miniature)."""
+        import signal
+        import socket
+        import time
+        import urllib.request
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        store_port, m1, m2 = free_port(), free_port(), free_port()
+        env = {"KARPENTER_CLUSTER_NAME": "e2e-ha", "PATH": "/usr/bin:/bin"}
+
+        def controller(metrics_port):
+            return subprocess.Popen(
+                [
+                    sys.executable, "-m", "karpenter_tpu",
+                    "--interval", "0.05",
+                    "--metrics-port", str(metrics_port),
+                    "--store-address", f"127.0.0.1:{store_port}",
+                ],
+                env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+
+        def leading(metrics_port):
+            """parse karpenter_leader_election_leading off /metrics."""
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{metrics_port}/metrics", timeout=2
+                ) as resp:
+                    body = resp.read().decode()
+            except OSError:
+                return None
+            for line in body.splitlines():
+                if line.startswith("karpenter_leader_election_leading"):
+                    return line.rsplit(" ", 1)[1] == "1"
+            return None
+
+        store = subprocess.Popen(
+            [
+                sys.executable, "-m", "karpenter_tpu", "store-server",
+                "--port", str(store_port),
+            ],
+            env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        procs = [store]
+        try:
+            # wait for the store socket before starting controllers
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    socket.create_connection(
+                        ("127.0.0.1", store_port), timeout=1
+                    ).close()
+                    break
+                except OSError:
+                    time.sleep(0.3)
+            else:
+                raise AssertionError(
+                    f"store-server never listened: {store.stderr.read()[:800]}"
+                )
+
+            c1, c2 = controller(m1), controller(m2)
+            procs += [c1, c2]
+            # exactly one leader between the two replicas
+            deadline = time.time() + 90
+            states = (None, None)
+            while time.time() < deadline:
+                states = (leading(m1), leading(m2))
+                if sorted(filter(lambda s: s is not None, states)) == [
+                    False, True,
+                ]:
+                    break
+                time.sleep(0.5)
+            assert sorted(
+                s for s in states if s is not None
+            ) == [False, True], (
+                states,
+                c1.poll() and c1.stderr.read()[:500],
+                c2.poll() and c2.stderr.read()[:500],
+            )
+            leader, standby = (
+                (c1, m2) if states[0] else (c2, m1)
+            )
+            # graceful failover: SIGTERM releases the Lease; the standby
+            # must take over well inside the 15s lease duration
+            leader.send_signal(signal.SIGTERM)
+            leader.wait(timeout=30)
+            deadline = time.time() + 60
+            took_over = False
+            while time.time() < deadline:
+                if leading(standby):
+                    took_over = True
+                    break
+                time.sleep(0.5)
+            assert took_over, "standby never took over after SIGTERM handoff"
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+
+
 class TestPreflight:
     def test_empty_catalog_fails_fast(self):
         """Reference operator.go:190-200 dry-runs DescribeInstanceTypes at

@@ -138,11 +138,18 @@ class TestFleetTagging:
         Name/nodeclaim tags — the shared fleet request holds only pool-level
         tags (the reference merges only fully-identical CreateFleetInputs)."""
         pool, nc = setup
-        claims = [make_claim(pool) for _ in range(6)]
-        with ThreadPoolExecutor(max_workers=6) as ex:
-            outs = list(ex.map(env.cloud_provider.create, claims))
-        # coalescing actually happened
-        assert env.cloud.recorder.count("CreateFleet") < 6
+        # the FAST_BATCH_WINDOWS idle window is 2ms; on a loaded machine
+        # a burst can miss coalescing entirely, so retry the burst — the
+        # tagging assertion below needs a batch that actually merged
+        for attempt in range(3):
+            before = env.cloud.recorder.count("CreateFleet")
+            claims = [make_claim(pool) for _ in range(6)]
+            with ThreadPoolExecutor(max_workers=6) as ex:
+                outs = list(ex.map(env.cloud_provider.create, claims))
+            if env.cloud.recorder.count("CreateFleet") - before < 6:
+                break  # coalescing actually happened
+        else:
+            raise AssertionError("CreateFleet never coalesced in 3 bursts")
         for claim, out in zip(claims, outs):
             inst = env.cloud.instances[out.provider_id]
             assert inst.tags["karpenter.sh/nodeclaim"] == claim.name

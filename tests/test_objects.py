@@ -14,7 +14,12 @@ from karpenter_tpu.api import (
     Toleration,
 )
 from karpenter_tpu.api import labels as L
-from karpenter_tpu.api.objects import SelectorTerm, tolerates_all
+from karpenter_tpu.api.objects import (
+    PodAffinityTerm,
+    SelectorTerm,
+    TopologySpreadConstraint,
+    tolerates_all,
+)
 
 
 def test_tolerations():
@@ -107,3 +112,54 @@ def test_selector_wildcard_requires_key():
     term = SelectorTerm.of(environment="*")
     assert term.matches("id", "n", {"environment": "anything"})
     assert not term.matches("id", "n", {})  # key must exist
+
+
+class TestSelectorOperatorValidation:
+    """ADVICE r5 low: an unknown matchExpressions operator keeps kube's
+    invalid-selector contract (match nothing) but must surface loudly —
+    once — when the object is BUILT in code, so a typo'd operator doesn't
+    silently match nothing forever."""
+
+    def test_unknown_operator_matches_nothing_and_warns_once(self, caplog):
+        import logging
+
+        from karpenter_tpu.api import objects as O
+
+        O._warned_expr_ops.discard("Inn")  # isolate from other specs
+        with caplog.at_level(logging.WARNING, logger="karpenter_tpu.api.objects"):
+            tsc = TopologySpreadConstraint(
+                1, "zone", match_expressions=(("k", "Inn", ("v",)),)
+            )
+            # match-nothing semantics preserved
+            assert not tsc.selects(Pod(labels={"k": "v"}))
+            warnings = [
+                r for r in caplog.records if "unknown label-selector" in r.message
+            ]
+            assert len(warnings) == 1, warnings
+            assert "Inn" in warnings[0].message
+            # a second object with the same typo does not spam the log
+            PodAffinityTerm(
+                topology_key="zone",
+                match_expressions=(("k", "Inn", ("v",)),),
+            )
+            warnings = [
+                r for r in caplog.records if "unknown label-selector" in r.message
+            ]
+            assert len(warnings) == 1
+
+    def test_valid_operators_do_not_warn(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="karpenter_tpu.api.objects"):
+            PodAffinityTerm(
+                topology_key="zone",
+                match_expressions=(
+                    ("a", "In", ("v",)),
+                    ("b", "NotIn", ("v",)),
+                    ("c", "Exists", ()),
+                    ("d", "DoesNotExist", ()),
+                ),
+            )
+        assert not [
+            r for r in caplog.records if "unknown label-selector" in r.message
+        ]

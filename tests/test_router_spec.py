@@ -1,0 +1,154 @@
+"""Doc-rot guard for the tensor/oracle routing spec (VERDICT r5 item 7).
+
+ops/tensorize.py's module docstring enumerates which constraint shapes
+route to the pure-Python oracle.  That list rotted once already: it kept
+claiming preference-differing co-location closures go to the oracle
+after the compile-time relaxation ladder learned to compile them.  This
+suite greps the docstring's oracle-shape list AND probes the router
+(class_unsupported_reason / partition_groups) for each listed shape, so
+the spec and the code can only change together.
+"""
+
+import re
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.api.requirements import Op, Requirement
+from karpenter_tpu.ops import tensorize
+from karpenter_tpu.ops.tensorize import class_unsupported_reason, partition_groups
+
+
+def _oracle_sentence() -> str:
+    """The docstring sentence that enumerates oracle-routed shapes."""
+    doc = tensorize.__doc__
+    m = re.search(r"Anything else —(.*?)— is reported", doc, re.DOTALL)
+    assert m, "routing docstring lost its oracle-shape list sentence"
+    return " ".join(m.group(1).split())
+
+
+def _coloc(prefs=(), terms=None):
+    """One member of a mutual hostname co-location closure."""
+    pod = Pod(
+        labels={"app": "g"},
+        requests=Resources(cpu=1),
+        pod_affinity=[
+            PodAffinityTerm(
+                topology_key=L.LABEL_HOSTNAME, label_selector=(("app", "g"),)
+            )
+        ],
+        preferred_affinity=list(prefs),
+    )
+    if terms is not None:
+        pod.affinity_terms = terms
+    return pod
+
+
+class TestDocstringMatchesRouter:
+    def test_oracle_list_names_the_routed_shapes(self):
+        sentence = _oracle_sentence()
+        for shape in (
+            "one-sided cross-class couplings",
+            "zone-affinity+spread combos",
+            "exotic topology keys",
+            "live-member co-location",
+            "OR-terms",
+        ):
+            assert shape in sentence, (shape, sentence)
+
+    def test_oracle_list_does_not_claim_preference_closures(self):
+        """The round-5 rot: preference-differing closures COMPILE now
+        (member preferences merge as required into ANDed rows, peeled by
+        the compile-time ladder) — the oracle list must not claim them."""
+        sentence = _oracle_sentence()
+        assert "preferences" not in sentence, sentence
+        # and the docstring documents the compiled behavior explicitly
+        assert "differ only in PREFERENCES compile" in " ".join(
+            tensorize.__doc__.split()
+        )
+
+    # -- behavior probes: one per listed oracle shape ----------------------
+
+    def test_exotic_topology_key_routes_to_oracle(self):
+        pod = Pod(
+            labels={"app": "x"},
+            requests=Resources(cpu=1),
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key="rack", label_selector=(("app", "x"),)
+                )
+            ],
+        )
+        assert "topology key rack" in class_unsupported_reason(pod)
+
+    def test_zone_affinity_plus_spread_routes_to_oracle(self):
+        pod = Pod(
+            labels={"app": "y"},
+            requests=Resources(cpu=1),
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=L.LABEL_ZONE, label_selector=(("app", "y"),)
+                )
+            ],
+            topology_spread=[
+                TopologySpreadConstraint(
+                    1, L.LABEL_ZONE, label_selector=(("app", "y"),)
+                )
+            ],
+        )
+        reason = class_unsupported_reason(pod)
+        assert "zone affinity combined" in reason
+
+    def test_one_sided_coupling_routes_to_oracle(self):
+        """An anti-affinity selector reaching OTHER pods (not its own
+        class) is a one-sided cross-class coupling."""
+        attacker = Pod(
+            labels={"app": "attacker"},
+            requests=Resources(cpu=1),
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key=L.LABEL_HOSTNAME,
+                    anti=True,
+                    label_selector=(("app", "victim"),),
+                )
+            ],
+        )
+        victim = Pod(labels={"app": "victim"}, requests=Resources(cpu=2))
+        groups, unsupported, why = partition_groups([attacker, victim])
+        assert unsupported, "one-sided coupling stayed on the tensor path"
+        assert why
+
+    def test_preference_differing_closure_compiles(self):
+        """The shape the stale comment mis-routed: same OR-terms and
+        namespace, different preference lists — merges into ONE macro
+        unit on the tensor path."""
+        a = _coloc(prefs=[Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])])
+        b = _coloc()
+        groups, unsupported, why = partition_groups([a, b])
+        assert not unsupported, why
+        assert len(groups) == 1, "closure should merge into one macro unit"
+
+    def test_or_term_differing_closure_routes_to_oracle(self):
+        a = _coloc()
+        b = _coloc(
+            terms=[
+                (Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"]),),
+                (),
+            ]
+        )
+        groups, unsupported, why = partition_groups([a, b])
+        assert unsupported, "OR-term-differing closure must keep the oracle"
+
+    def test_reason_strings_exist_in_router_source(self):
+        """Every docstring-listed shape corresponds to a live code path:
+        the reason strings the probes hit are produced by
+        class_unsupported_reason / the partition passes, not leftovers."""
+        import inspect
+
+        src = inspect.getsource(tensorize)
+        for snippet in (
+            "pod affinity on topology key",
+            "zone affinity combined with another zone constraint",
+            "topology spread on key",
+        ):
+            assert snippet in src, snippet

@@ -1,0 +1,343 @@
+"""Shared cluster-store subsystem: server, client mirror, failure modes.
+
+The StoreServer (service/store_server.py) is the kube-apiserver analogue:
+one durable KubeStore behind the socket protocol; RemoteKubeStore
+(state/remote.py) is the controller-side mirror.  These specs cover the
+replication contract (writes visible across clients, watch keeps standby
+mirrors warm, in-place mutations flush on lease ops) and the failure
+modes a production deployment hits: store restart mid-watch (client
+resyncs), request timeout (retryable error, not a hang), and
+resourceVersion conflict on concurrent Lease renewal (loses cleanly).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api import NodeClass, NodePool, Pod, Resources
+from karpenter_tpu.api.objects import SelectorTerm
+from karpenter_tpu.service.store_server import StoreServer, VersionedStore
+from karpenter_tpu.state.kube import Node
+from karpenter_tpu.state.remote import RemoteKubeStore, StoreUnavailableError
+from karpenter_tpu.state.wire import canonical, from_wire, to_wire
+
+
+@pytest.fixture
+def server():
+    srv = StoreServer().start_background()
+    yield srv
+    srv.stop()
+
+
+def _client(server, **kw):
+    host, port = server.address
+    return RemoteKubeStore(host, port, **kw)
+
+
+def _default_objects(kube):
+    kube.put_node_class(
+        NodeClass(
+            name="default",
+            subnet_selector_terms=[SelectorTerm.of(Name="*")],
+            security_group_selector_terms=[SelectorTerm.of(Name="*")],
+        )
+    )
+    kube.put_node_pool(NodePool(name="default", node_class_ref="default"))
+
+
+class TestWireCodec:
+    def test_round_trip_preserves_scheduling_semantics(self):
+        from karpenter_tpu.api.objects import (
+            PodAffinityTerm,
+            TopologySpreadConstraint,
+        )
+        from karpenter_tpu.api.requirements import Op, Requirement
+
+        pod = Pod(
+            requests=Resources(cpu=1, memory="2Gi"),
+            node_selector={"a": "b"},
+            required_affinity=[Requirement("z", Op.IN, ["z1", "z2"])],
+            preferred_affinity=[Requirement("z", Op.IN, ["z1"])],
+            topology_spread=[
+                TopologySpreadConstraint(
+                    1, "zone", label_selector=(("x", "y"),)
+                )
+            ],
+            pod_affinity=[
+                PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    anti=True,
+                    label_selector=(("x", "y"),),
+                )
+            ],
+        )
+        back = from_wire(to_wire(pod))
+        assert canonical(back) == canonical(pod)
+        # the deep equality that matters: identical grouping signature
+        assert back.constraint_signature() == pod.constraint_signature()
+
+    def test_no_arbitrary_class_instantiation(self):
+        with pytest.raises(ValueError, match="unknown wire dataclass"):
+            from_wire({"!dc": "Settings", "f": {}})
+        with pytest.raises(ValueError, match="untagged"):
+            from_wire({"cmd": "rm -rf /"})
+
+
+class TestReplication:
+    def test_writes_visible_across_clients(self, server):
+        a = _client(server, identity="a")
+        b = _client(server, identity="b")
+        try:
+            _default_objects(a)
+            a.put_pod(Pod(name="p1", requests=Resources(cpu=1)))
+            assert b.wait_synced()
+            assert "default" in b.node_pools
+            assert "default/p1" in b.pods
+            a.delete_pod("default/p1")
+            assert b.wait_synced()
+            assert "default/p1" not in b.pods
+        finally:
+            a.close()
+            b.close()
+
+    def test_semantic_verbs_run_server_side(self, server):
+        """bind_pod's cascades (pod phase, zone anchoring) replicate."""
+        a = _client(server, identity="a")
+        b = _client(server, identity="b")
+        try:
+            a.put_node(Node(name="n1", ready=True))
+            a.put_pod(Pod(name="p1", requests=Resources(cpu=1)))
+            a.bind_pod("default/p1", "n1")
+            assert b.wait_synced()
+            assert b.pods["default/p1"].node_name == "n1"
+            assert b.pods["default/p1"].phase == "Running"
+            assert server.store.kube.pods["default/p1"].node_name == "n1"
+        finally:
+            a.close()
+            b.close()
+
+    def test_in_place_mutations_flush_on_lease_ops(self, server):
+        """Controllers stamp conditions/labels without calling put (e.g.
+        lifecycle.py); the shadow diff pushes them before every lease
+        operation — at least once per tick."""
+        a = _client(server, identity="a")
+        b = _client(server, identity="b")
+        try:
+            pod = a.put_pod(Pod(name="p1", requests=Resources(cpu=1)))
+            pod.phase = "Running"
+            pod.node_name = "nx"  # in-place, no verb
+            assert a.try_acquire_lease("el", "a", 100.0, 15.0)
+            assert b.wait_synced()
+            assert b.pods["default/p1"].node_name == "nx"
+        finally:
+            a.close()
+            b.close()
+
+    def test_stale_write_conflicts_and_adopts_server_state(self, server):
+        """rv fencing: a deposed writer's straggler put loses to the
+        newer write and the client adopts the server's object."""
+        a = _client(server, identity="a")
+        b = _client(server, identity="b")
+        try:
+            pod = a.put_pod(Pod(name="p1", requests=Resources(cpu=1)))
+            assert b.wait_synced()
+            # b writes a newer version
+            newer = b.pods["default/p1"]
+            newer.labels["winner"] = "b"
+            assert b.try_acquire_lease("el", "b", 1.0, 15.0)  # flush
+            # a mutates its (now-stale) object and flushes with a stale rv
+            a._shadow.pop(("Pod", "default/p1"), None)  # force dirty
+            pod.labels["winner"] = "a"
+            # stale base_rv: a hasn't absorbed b's write yet in the worst
+            # case; simulate by pinning a's recorded rv backwards
+            a.wait_synced()
+            a._rvs[("Pod", "default/p1")] = 0
+            a._flush_dirty()
+            a.wait_synced()
+            assert a.pods["default/p1"].labels.get("winner") == "b"
+            assert (
+                server.store.kube.pods["default/p1"].labels["winner"] == "b"
+            )
+        finally:
+            a.close()
+            b.close()
+
+
+class TestStragglerFencing:
+    def test_stale_delete_conflicts_and_restores(self, server):
+        """A deposed leader's straggler DELETE is fenced exactly like a
+        stale put: the server keeps the newer object and the straggler
+        adopts it back into its mirror."""
+        a = _client(server, identity="a")
+        b = _client(server, identity="b")
+        try:
+            a.put_pod(Pod(name="p1", requests=Resources(cpu=1)))
+            assert b.wait_synced()
+            newer = b.pods["default/p1"]
+            newer.labels["owner"] = "b"
+            assert b.try_acquire_lease("el", "b", 1.0, 15.0)  # flush
+            # the deposed replica still holds the pre-update rv
+            a._rvs[("Pod", "default/p1")] = 1
+            a.delete_pod("default/p1")
+            # server kept b's object; a adopted it back
+            assert "default/p1" in server.store.kube.pods
+            assert (
+                server.store.kube.pods["default/p1"].labels["owner"] == "b"
+            )
+            assert "default/p1" in a.pods
+        finally:
+            a.close()
+            b.close()
+
+
+class TestFailureModes:
+    def test_store_restart_mid_watch_resyncs(self):
+        """The durable half (VersionedStore) survives; a new StoreServer
+        over it comes back on the same port and the client's watch loop
+        reconnects and resyncs — including writes it missed."""
+        store = VersionedStore()
+        srv = StoreServer(store=store).start_background()
+        host, port = srv.address
+        a = RemoteKubeStore(host, port, identity="a")
+        b = RemoteKubeStore(host, port, identity="b")
+        try:
+            a.put_pod(Pod(name="p1", requests=Resources(cpu=1)))
+            assert b.wait_synced()
+            srv.stop()
+            # server-side write while b's watch is down (a new client on
+            # the restarted server): b must pick it up after resync
+            srv = StoreServer(host, port, store=store).start_background()
+            c = RemoteKubeStore(host, port, identity="c")
+            c.put_pod(Pod(name="p2", requests=Resources(cpu=1)))
+            c.close()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if "default/p2" in b.pods:
+                    break
+                time.sleep(0.02)
+            assert "default/p2" in b.pods, "watch did not resync"
+            assert "default/p1" in b.pods
+        finally:
+            a.close()
+            b.close()
+            srv.stop()
+
+    def test_request_timeout_is_retryable_error_not_hang(self):
+        """A server that accepts but never answers must surface a
+        StoreUnavailableError within ~the request timeout."""
+        sink = socket.socket()
+        sink.bind(("127.0.0.1", 0))
+        sink.listen(4)
+        host, port = sink.getsockname()
+        held = []
+        stop = threading.Event()
+
+        def hold():
+            sink.settimeout(0.2)
+            while not stop.is_set():
+                try:
+                    conn, _ = sink.accept()
+                    held.append(conn)  # read nothing, answer nothing
+                except socket.timeout:
+                    continue
+
+        t = threading.Thread(target=hold, daemon=True)
+        t.start()
+        kube = RemoteKubeStore(
+            host, port, identity="t", request_timeout=0.3, start_watch=False
+        )
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(StoreUnavailableError, match="timed out"):
+                kube.put_pod(Pod(name="p1", requests=Resources(cpu=1)))
+            assert time.monotonic() - t0 < 5.0, "timeout did not bound the call"
+            # and the elector surface degrades to abdication, not a hang
+            t0 = time.monotonic()
+            assert kube.try_acquire_lease("el", "t", 1.0, 15.0) is False
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            stop.set()
+            kube.close()
+            for c in held:
+                c.close()
+            sink.close()
+
+    def test_dead_port_retries_then_raises_retryable(self):
+        free = socket.socket()
+        free.bind(("127.0.0.1", 0))
+        host, port = free.getsockname()
+        free.close()  # nothing listens here
+        kube = RemoteKubeStore(
+            host, port, identity="t", connect_timeout=0.2, start_watch=False
+        )
+        with pytest.raises(StoreUnavailableError):
+            kube.put_pod(Pod(name="p1", requests=Resources(cpu=1)))
+        kube.close()
+
+    def test_concurrent_lease_renewal_conflict_loses_cleanly(self, server):
+        """resourceVersion CAS on the Lease: a renewal based on a stale
+        rv returns False (conflict) — no exception, no clobber — and a
+        refreshed renewal succeeds."""
+        r1 = server.dispatch(
+            {
+                "method": "lease_acquire",
+                "name": "el",
+                "holder": "a",
+                "now": 100.0,
+                "duration_s": 15.0,
+            }
+        )
+        assert r1["acquired"] and r1["rv"] > 0
+        # the tick's acquire bumped the rv; a renewal still carrying the
+        # pre-acquire rv (the background thread racing the tick) loses
+        stale = server.dispatch(
+            {
+                "method": "lease_renew",
+                "name": "el",
+                "holder": "a",
+                "now": 101.0,
+                "base_rv": r1["rv"] - 1,
+            }
+        )
+        assert stale["renewed"] is False and stale.get("conflict") is True
+        fresh = server.dispatch(
+            {
+                "method": "lease_renew",
+                "name": "el",
+                "holder": "a",
+                "now": 101.0,
+                "base_rv": r1["rv"],
+            }
+        )
+        assert fresh["renewed"] is True
+        # and through the client surface: the loser returns False cleanly
+        a = _client(server, identity="a2")
+        b = _client(server, identity="b2")
+        try:
+            assert not a.try_acquire_lease("el", "a2", 102.0, 15.0)
+            assert a.renew_lease("el", "a2", 103.0) is False  # not holder
+        finally:
+            a.close()
+            b.close()
+
+
+class TestLeaseHandoffOverTheWire:
+    def test_acquire_exclusion_release_expiry(self, server):
+        a = _client(server, identity="a")
+        b = _client(server, identity="b")
+        try:
+            assert a.try_acquire_lease("el", "a", 100.0, 15.0)
+            assert not b.try_acquire_lease("el", "b", 105.0, 15.0)
+            assert a.renew_lease("el", "a", 110.0)
+            # graceful release hands over immediately
+            a.release_lease("el", "a")
+            assert b.try_acquire_lease("el", "b", 110.1, 15.0)
+            # expiry fences a crashed holder
+            assert not a.try_acquire_lease("el", "a", 115.0, 15.0)
+            assert a.try_acquire_lease("el", "a", 110.1 + 15.1, 15.0)
+        finally:
+            a.close()
+            b.close()
