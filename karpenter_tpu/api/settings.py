@@ -27,6 +27,20 @@ class Settings:
     # for solver dispatches (TensorBoard-readable)
     enable_profiling: bool = False
     profile_dir: str = ""
+    # cloud-API resilience (cloud/retry.py): classified retries with
+    # exponential backoff + full jitter under a per-tick budget, and a
+    # per-API circuit breaker — the AWS-SDK retry / circuit behavior the
+    # reference gets for free under its providers
+    cloud_max_retries: int = 3
+    cloud_retry_budget_per_tick: int = 50
+    cloud_backoff_base: float = 0.1
+    cloud_backoff_max: float = 5.0
+    cloud_circuit_failure_threshold: int = 5
+    cloud_circuit_reset_timeout: float = 30.0
+    # crash-contained reconcile loop (operator.py): a failing controller is
+    # requeued with exponential backoff while the rest of the tick proceeds
+    controller_backoff_base: float = 1.0
+    controller_backoff_max: float = 300.0
 
     @classmethod
     def from_file(cls, path: str) -> "Settings":
@@ -80,3 +94,18 @@ class Settings:
             raise ValueError("batch_max_duration must be >= batch_idle_duration")
         if self.reserved_enis < 0:
             raise ValueError("reserved_enis must be >= 0")
+        if self.cloud_max_retries < 0 or self.cloud_retry_budget_per_tick < 0:
+            raise ValueError("cloud retry knobs must be >= 0")
+        if self.cloud_backoff_base < 0 or self.cloud_backoff_max < self.cloud_backoff_base:
+            raise ValueError("cloud_backoff_max must be >= cloud_backoff_base >= 0")
+        if self.cloud_circuit_failure_threshold < 1:
+            raise ValueError("cloud_circuit_failure_threshold must be >= 1")
+        if self.cloud_circuit_reset_timeout < 0:
+            raise ValueError("cloud_circuit_reset_timeout must be >= 0")
+        if (
+            self.controller_backoff_base <= 0
+            or self.controller_backoff_max < self.controller_backoff_base
+        ):
+            raise ValueError(
+                "controller_backoff_max must be >= controller_backoff_base > 0"
+            )

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import random
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
@@ -145,24 +146,156 @@ class QueueMessage:
     invisible_until: float = 0.0
 
 
+class ChaosEngine:
+    """Seeded, scriptable fault schedules for the fake cloud — the analogue
+    of an AWS region having a bad day, sustained rather than one-shot.
+
+    Every trigger fires inside `_CallRecorder.record`, i.e. at API entry and
+    BEFORE the backend mutates anything, so a chaos-failed call never
+    half-applies.  Latency rides the injected `Clock` (`clock.sleep`), so a
+    `FakeClock` suite experiences it as time passing, not wall waiting.
+    Schedules compose: latency applies first, then blackouts, then throttle
+    bursts, then per-API error rates.  `"*"` targets every API.
+    """
+
+    def __init__(self, clock: Clock, seed: int = 0):
+        self.clock = clock
+        self.rng = random.Random(seed)
+        self.enabled = True
+        # api (or "*") -> (probability, error code)
+        self.error_rates: Dict[str, Tuple[float, str]] = {}
+        # api (or "*") -> injected seconds per call
+        self.latency: Dict[str, float] = {}
+        # (start, end, apis-or-None, code): every matching call raises
+        self.blackouts: List[Tuple[float, float, Optional[frozenset], str]] = []
+        # (start, end, apis-or-None): RequestLimitExceeded burst windows
+        self.throttles: List[Tuple[float, float, Optional[frozenset]]] = []
+        # probability each requested CreateFleet instance is withheld
+        self.partial_fleet_rate = 0.0
+
+    # ----------------------------------------------------------- scripting
+    def reseed(self, seed: int) -> None:
+        self.rng = random.Random(seed)
+
+    def set_error_rate(self, api: str, rate: float, code: str = "InternalError"):
+        self.error_rates[api] = (rate, code)
+
+    def set_latency(self, api: str, seconds: float) -> None:
+        self.latency[api] = seconds
+
+    def add_blackout(
+        self,
+        start: float,
+        duration: float,
+        apis: Optional[Iterable[str]] = None,
+        code: str = "ServiceUnavailable",
+    ) -> None:
+        self.blackouts.append(
+            (start, start + duration, None if apis is None else frozenset(apis), code)
+        )
+
+    def add_throttle_burst(
+        self, start: float, duration: float, apis: Optional[Iterable[str]] = None
+    ) -> None:
+        self.throttles.append(
+            (start, start + duration, None if apis is None else frozenset(apis))
+        )
+
+    def set_partial_fleet(self, rate: float) -> None:
+        self.partial_fleet_rate = rate
+
+    def clear(self) -> None:
+        """Drop every schedule (the faults 'clearing'); keeps the RNG
+        stream so a seeded run stays reproducible across the clear."""
+        self.error_rates = {}
+        self.latency = {}
+        self.blackouts = []
+        self.throttles = []
+        self.partial_fleet_rate = 0.0
+
+    # ------------------------------------------------------------- firing
+    def on_call(self, api: str) -> None:
+        if not self.enabled:
+            return
+        lat = self.latency.get(api, self.latency.get("*"))
+        if lat:
+            self.clock.sleep(lat)
+        now = self.clock.now()
+        for start, end, apis, code in self.blackouts:
+            if start <= now < end and (apis is None or api in apis):
+                raise CloudAPIError(code, f"chaos blackout: {api}")
+        for start, end, apis in self.throttles:
+            if start <= now < end and (apis is None or api in apis):
+                raise CloudAPIError(
+                    "RequestLimitExceeded", f"chaos throttle: {api}"
+                )
+        rate = self.error_rates.get(api, self.error_rates.get("*"))
+        if rate is not None and self.rng.random() < rate[0]:
+            raise CloudAPIError(rate[1], f"chaos error: {api}")
+
+    def fleet_shortfall(self, count: int) -> int:
+        """How many of `count` requested CreateFleet instances chaos
+        withholds (partial fulfillment, reported as per-pool errors)."""
+        if not self.enabled or not self.partial_fleet_rate:
+            return 0
+        return sum(
+            1 for _ in range(count) if self.rng.random() < self.partial_fleet_rate
+        )
+
+
 class _CallRecorder:
-    """MockedFunction-style call capture (reference pkg/fake/utils.go)."""
+    """MockedFunction-style call capture (reference pkg/fake/utils.go).
+
+    Error injection is layered: explicit sequences (`set_error_sequence`,
+    with `set_next_error` as its one-shot wrapper), call-count triggers
+    (`set_error_at_call`), then the sustained chaos schedule.  Thread-safe:
+    the batcher and the interruption worker pool drive APIs from threads.
+    """
 
     def __init__(self):
         self.calls: Dict[str, List[tuple]] = {}
-        self._next_error: Dict[str, Exception] = {}
+        self._error_seq: Dict[str, List[Exception]] = {}
+        self._error_at: Dict[str, Dict[int, Exception]] = {}
+        self._lock = threading.Lock()
+        self.chaos: Optional[ChaosEngine] = None  # wired by FakeCloud
 
     def record(self, api: str, *args) -> None:
-        self.calls.setdefault(api, []).append(args)
-        err = self._next_error.pop(api, None)
+        with self._lock:
+            self.calls.setdefault(api, []).append(args)
+            n = len(self.calls[api])
+            err: Optional[Exception] = None
+            seq = self._error_seq.get(api)
+            if seq:
+                err = seq.pop(0)
+                if not seq:
+                    del self._error_seq[api]
+            if err is None:
+                err = self._error_at.get(api, {}).pop(n, None)
         if err is not None:
             raise err
+        if self.chaos is not None:
+            self.chaos.on_call(api)
 
     def set_next_error(self, api: str, err: Exception) -> None:
-        self._next_error[api] = err
+        """One-shot injection — thin wrapper over `set_error_sequence`."""
+        self.set_error_sequence(api, [err])
+
+    def set_error_sequence(self, api: str, errs: Sequence[Exception]) -> None:
+        """Fail the next len(errs) calls of `api` in order (appended to any
+        errors already pending)."""
+        with self._lock:
+            self._error_seq.setdefault(api, []).extend(errs)
+
+    def set_error_at_call(self, api: str, nth: int, err: Exception) -> None:
+        """Fail the nth FUTURE call of `api` (1 = the very next call);
+        calls in between succeed."""
+        with self._lock:
+            trigger = len(self.calls.get(api, ())) + nth
+            self._error_at.setdefault(api, {})[trigger] = err
 
     def count(self, api: str) -> int:
-        return len(self.calls.get(api, ()))
+        with self._lock:
+            return len(self.calls.get(api, ()))
 
 
 class FakeCloud:
@@ -200,6 +333,8 @@ class FakeCloud:
         self.queue: List[QueueMessage] = []
         self.kube_version = "1.28"
         self.recorder = _CallRecorder()
+        self.chaos = ChaosEngine(clock)
+        self.recorder.chaos = self.chaos
         self._seq = itertools.count(1)
         self._lock = threading.RLock()
 
@@ -224,69 +359,88 @@ class FakeCloud:
         return self
 
     def add_subnet(self, s: FakeSubnet) -> None:
-        s.tags.setdefault("Name", s.name or s.id)
-        self.subnets[s.id] = s
+        with self._lock:
+            s.tags.setdefault("Name", s.name or s.id)
+            self.subnets[s.id] = s
 
     def add_security_group(self, g: FakeSecurityGroup) -> None:
-        g.tags.setdefault("Name", g.name or g.id)
-        self.security_groups[g.id] = g
+        with self._lock:
+            g.tags.setdefault("Name", g.name or g.id)
+            self.security_groups[g.id] = g
 
     def add_image(self, im: FakeImage) -> None:
-        self.images[im.id] = im
+        with self._lock:
+            self.images[im.id] = im
 
     def set_capacity(self, instance_type: str, zone: str, capacity_type: str, n: int):
-        self.capacity_pools[(instance_type, zone, capacity_type)] = n
+        with self._lock:
+            self.capacity_pools[(instance_type, zone, capacity_type)] = n
 
     def mark_insufficient(self, instance_type: str, zone: str, capacity_type: str):
-        self.insufficient_pools.add((instance_type, zone, capacity_type))
+        with self._lock:
+            self.insufficient_pools.add((instance_type, zone, capacity_type))
 
     # -------------------------------------------------------------- catalog
     def describe_instance_types(self) -> List[MachineShape]:
-        self.recorder.record("DescribeInstanceTypes")
-        return list(self.shapes.values())
+        with self._lock:
+            self.recorder.record("DescribeInstanceTypes")
+            return list(self.shapes.values())
 
     def describe_instance_type_offerings(self) -> List[Tuple[str, str]]:
         """(instance_type, zone) pairs currently offered."""
-        self.recorder.record("DescribeInstanceTypeOfferings")
-        if self.offerings:
-            return [k for k, v in self.offerings.items() if v]
-        return [(t, z) for t in self.shapes for z in self.zones]
+        with self._lock:
+            self.recorder.record("DescribeInstanceTypeOfferings")
+            if self.offerings:
+                return [k for k, v in self.offerings.items() if v]
+            return [(t, z) for t in self.shapes for z in self.zones]
 
     # -------------------------------------------------------------- network
     def describe_subnets(self, selector_terms) -> List[FakeSubnet]:
-        self.recorder.record("DescribeSubnets", tuple(selector_terms))
-        return [
-            s
-            for s in self.subnets.values()
-            if any(t.matches(s.id, s.name, s.tags) for t in selector_terms)
-        ]
+        with self._lock:
+            self.recorder.record("DescribeSubnets", tuple(selector_terms))
+            return [
+                s
+                for s in self.subnets.values()
+                if any(t.matches(s.id, s.name, s.tags) for t in selector_terms)
+            ]
 
     def describe_security_groups(self, selector_terms) -> List[FakeSecurityGroup]:
-        self.recorder.record("DescribeSecurityGroups", tuple(selector_terms))
-        return [
-            g
-            for g in self.security_groups.values()
-            if any(t.matches(g.id, g.name, g.tags) for t in selector_terms)
-        ]
+        with self._lock:
+            self.recorder.record("DescribeSecurityGroups", tuple(selector_terms))
+            return [
+                g
+                for g in self.security_groups.values()
+                if any(t.matches(g.id, g.name, g.tags) for t in selector_terms)
+            ]
 
     def describe_images(self, selector_terms) -> List[FakeImage]:
-        self.recorder.record("DescribeImages", tuple(selector_terms))
-        return [
-            im
-            for im in self.images.values()
-            if any(t.matches(im.id, im.name, im.tags) for t in selector_terms)
-        ]
+        with self._lock:
+            self.recorder.record("DescribeImages", tuple(selector_terms))
+            return [
+                im
+                for im in self.images.values()
+                if any(t.matches(im.id, im.name, im.tags) for t in selector_terms)
+            ]
 
     def latest_image(self, family: str, arch: str) -> Optional[FakeImage]:
         """SSM-parameter analogue: newest non-deprecated image of a family
         (reference pkg/providers/amifamily/ami.go:65-79)."""
-        self.recorder.record("GetParameter", family, arch)
-        cands = [
-            im
-            for im in self.images.values()
-            if im.family == family and im.arch == arch and not im.deprecated
-        ]
-        return max(cands, key=lambda im: im.created_at, default=None)
+        with self._lock:
+            self.recorder.record("GetParameter", family, arch)
+            cands = [
+                im
+                for im in self.images.values()
+                if im.family == family and im.arch == arch and not im.deprecated
+            ]
+            return max(cands, key=lambda im: im.created_at, default=None)
+
+    # -------------------------------------------------------------- cluster
+    def describe_cluster_version(self) -> str:
+        """Control-plane version discovery (the DescribeCluster analogue the
+        version provider polls)."""
+        with self._lock:
+            self.recorder.record("DescribeCluster")
+            return self.kube_version
 
     # -------------------------------------------------------------- pricing
     def on_demand_price(self, instance_type: str) -> float:
@@ -299,42 +453,47 @@ class FakeCloud:
         return self.shapes[instance_type].od_price * self.spot_discount
 
     def describe_spot_price_history(self) -> Dict[Tuple[str, str], float]:
-        self.recorder.record("DescribeSpotPriceHistory")
-        return {
-            (t, z): self.spot_price(t, z) for t in self.shapes for z in self.zones
-        }
+        with self._lock:
+            self.recorder.record("DescribeSpotPriceHistory")
+            return {
+                (t, z): self.spot_price(t, z) for t in self.shapes for z in self.zones
+            }
 
     def get_products(self) -> Dict[str, float]:
-        self.recorder.record("GetProducts")
-        return {t: s.od_price for t, s in self.shapes.items()}
+        with self._lock:
+            self.recorder.record("GetProducts")
+            return {t: s.od_price for t, s in self.shapes.items()}
 
     # ----------------------------------------------------- launch templates
     def create_launch_template(self, lt: FakeLaunchTemplate) -> FakeLaunchTemplate:
-        self.recorder.record("CreateLaunchTemplate", lt.name)
-        if not lt.created_at:
-            lt.created_at = self.clock.now()
-        self.launch_templates[lt.name] = lt
-        return lt
+        with self._lock:
+            self.recorder.record("CreateLaunchTemplate", lt.name)
+            if not lt.created_at:
+                lt.created_at = self.clock.now()
+            self.launch_templates[lt.name] = lt
+            return lt
 
     def describe_launch_templates(
         self, tag_filters: Optional[Mapping[str, str]] = None
     ) -> List[FakeLaunchTemplate]:
-        self.recorder.record(
-            "DescribeLaunchTemplates", tuple((tag_filters or {}).items())
-        )
-        out = []
-        for lt in self.launch_templates.values():
-            if tag_filters and not all(
-                lt.tags.get(k) == v or (v == "*" and k in lt.tags)
-                for k, v in tag_filters.items()
-            ):
-                continue
-            out.append(lt)
-        return out
+        with self._lock:
+            self.recorder.record(
+                "DescribeLaunchTemplates", tuple((tag_filters or {}).items())
+            )
+            out = []
+            for lt in self.launch_templates.values():
+                if tag_filters and not all(
+                    lt.tags.get(k) == v or (v == "*" and k in lt.tags)
+                    for k, v in tag_filters.items()
+                ):
+                    continue
+                out.append(lt)
+            return out
 
     def delete_launch_template(self, name: str) -> None:
-        self.recorder.record("DeleteLaunchTemplate", name)
-        self.launch_templates.pop(name, None)
+        with self._lock:
+            self.recorder.record("DeleteLaunchTemplate", name)
+            self.launch_templates.pop(name, None)
 
     # -------------------------------------------------------------- tagging
     def create_tags(self, resource_id: str, tags: Mapping[str, str]) -> None:
@@ -380,7 +539,24 @@ class FakeCloud:
                     else self.on_demand_price(o["instance_type"]),
                 ),
             )
-            for _ in range(count):
+            # chaos partial fulfillment: withheld instances surface as a
+            # capacity error on the pool that would have served them — the
+            # shape a real CreateFleet takes when a pool runs dry MID
+            # request (earlier instances landed there, the rest ICE'd).
+            # Attributed to the first pool not already known-unavailable so
+            # the error carries new information for the caller's ICE cache.
+            shortfall = self.chaos.fleet_shortfall(count)
+            if shortfall and ordered:
+                for o in ordered:
+                    pool = (o["instance_type"], o["zone"], capacity_type)
+                    if pool in self.insufficient_pools:
+                        continue
+                    remaining = self.capacity_pools.get(pool)
+                    if remaining is not None and remaining <= 0:
+                        continue
+                    errors[pool] = InsufficientCapacityError(pool)
+                    break
+            for _ in range(count - shortfall):
                 placed = False
                 for o in ordered:
                     pool = (o["instance_type"], o["zone"], capacity_type)
@@ -455,13 +631,15 @@ class FakeCloud:
 
     # -------------------------------------------------------------- IAM
     def ensure_instance_profile(self, name: str, role: str) -> str:
-        self.recorder.record("CreateInstanceProfile", name, role)
-        self.instance_profiles[name] = role
-        return name
+        with self._lock:
+            self.recorder.record("CreateInstanceProfile", name, role)
+            self.instance_profiles[name] = role
+            return name
 
     def delete_instance_profile(self, name: str) -> None:
-        self.recorder.record("DeleteInstanceProfile", name)
-        self.instance_profiles.pop(name, None)
+        with self._lock:
+            self.recorder.record("DeleteInstanceProfile", name)
+            self.instance_profiles.pop(name, None)
 
     # -------------------------------------------------------------- queue
     def send_message(self, body: dict) -> None:

@@ -12,13 +12,15 @@ goroutines; `run` loops it for real deployments.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import Settings
 from karpenter_tpu.cloud.fake.backend import FakeCloud
 from karpenter_tpu.cloud.provider import CloudProvider, ProviderBundle
+from karpenter_tpu.cloud.retry import RetryingCloud
 from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
 from karpenter_tpu.controllers.disruption import DisruptionController
 from karpenter_tpu.controllers.garbagecollection import GarbageCollectionController
@@ -38,13 +40,19 @@ from karpenter_tpu.providers.instance import InstanceProvider
 from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
 from karpenter_tpu.providers.instancetype import InstanceTypeProvider
 from karpenter_tpu.providers.launchtemplate import LaunchTemplateProvider
-from karpenter_tpu.providers.pricing import PRICING_UPDATE_PERIOD, PricingProvider
+from karpenter_tpu.providers.pricing import (
+    PRICING_RETRY_PERIOD,
+    PRICING_UPDATE_PERIOD,
+    PricingProvider,
+)
 from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
 from karpenter_tpu.providers.subnet import SubnetProvider
 from karpenter_tpu.providers.version import VersionProvider
 from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.state.kube import KubeStore
 from karpenter_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
 
 
 class Operator:
@@ -82,11 +90,19 @@ class Operator:
         self.tracer.profile_dir = (
             self.settings.profile_dir if self.settings.enable_profiling else ""
         )
+        # resilience layer (cloud/retry.py): every provider talks to the
+        # cloud through classified retries + per-API circuit breakers — the
+        # AWS-SDK retry behavior the reference relies on implicitly
+        self.retrying = RetryingCloud(
+            cloud, clock=self.clock, settings=self.settings, registry=registry
+        )
         # connectivity preflight (reference operator.go:190-200's dry-run
         # DescribeInstanceTypes): an early, actionable failure beats every
-        # controller erroring on its first reconcile
+        # controller erroring on its first reconcile.  Routed through the
+        # retry layer so a transient flake at boot is retried with backoff
+        # instead of permanently aborting construction.
         try:
-            shapes = cloud.describe_instance_types()
+            shapes = self.retrying.describe_instance_types()
         except Exception as exc:
             raise RuntimeError(
                 f"cloud connectivity preflight failed: {exc}"
@@ -98,20 +114,23 @@ class Operator:
             )
 
         # ---- caches + providers, dependency order (operator.go:126-165)
+        rcloud = self.retrying
         self.unavailable = UnavailableOfferings(self.clock)
-        self.pricing = PricingProvider(cloud)
+        self.pricing = PricingProvider(rcloud, registry=registry)
         self.pricing.update_on_demand()
         self.pricing.update_spot()
-        self.subnets = SubnetProvider(cloud, self.clock)
-        self.security_groups = SecurityGroupProvider(cloud, self.clock)
-        self.version = VersionProvider(cloud, self.clock)
-        self.instance_profiles = InstanceProfileProvider(
-            cloud, self.clock, self.settings.cluster_name
+        self.subnets = SubnetProvider(rcloud, self.clock, registry=registry)
+        self.security_groups = SecurityGroupProvider(
+            rcloud, self.clock, registry=registry
         )
-        self.images = ImageProvider(cloud, self.clock)
+        self.version = VersionProvider(rcloud, self.clock, registry=registry)
+        self.instance_profiles = InstanceProfileProvider(
+            rcloud, self.clock, self.settings.cluster_name
+        )
+        self.images = ImageProvider(rcloud, self.clock, registry=registry)
         self.resolver = Resolver(self.images)
         self.launch_templates = LaunchTemplateProvider(
-            cloud,
+            rcloud,
             self.resolver,
             self.security_groups,
             self.clock,
@@ -119,11 +138,11 @@ class Operator:
             cluster_endpoint=self.settings.cluster_endpoint,
         )
         self.instance_types = InstanceTypeProvider(
-            cloud, self.pricing, self.subnets, self.unavailable,
+            rcloud, self.pricing, self.subnets, self.unavailable,
             self.settings, self.clock, registry=registry,
         )
         self.instances = InstanceProvider(
-            cloud, self.subnets, self.launch_templates, self.unavailable,
+            rcloud, self.subnets, self.launch_templates, self.unavailable,
             tags=self.settings.tags, batch_windows=batch_windows,
             registry=registry,
         )
@@ -131,7 +150,7 @@ class Operator:
         # (metrics.Decorate(cloudProvider))
         self.cloud_provider = MetricsCloudProvider(
             CloudProvider(
-                cloud,
+                rcloud,
                 kube,
                 ProviderBundle(
                     instance_types=self.instance_types,
@@ -159,7 +178,7 @@ class Operator:
         self.garbage_collection = GarbageCollectionController(
             kube, self.cloud_provider, self.clock, registry
         )
-        self.tagging = TaggingController(kube, cloud)
+        self.tagging = TaggingController(kube, rcloud)
         self.link = LinkController(kube, self.cloud_provider, registry)
         self.node_class_controller = NodeClassController(
             kube, self.subnets, self.security_groups, self.images,
@@ -173,7 +192,7 @@ class Operator:
         self.interruption: Optional[InterruptionController] = None
         if self.settings.interruption_queue_name:
             self.interruption = InterruptionController(
-                kube, cloud, self.termination, self.unavailable, registry
+                kube, rcloud, self.termination, self.unavailable, registry
             )
         self.metrics_state = MetricsStateController(
             kube, self.cluster, self.clock, registry
@@ -182,25 +201,50 @@ class Operator:
             kube, self.cluster, self.cloud_provider, self.clock, registry
         )
         self._pricing_updated_at = self.clock.now()
+        # per-controller requeue backoff: name -> (retry_at, current delay)
+        self._ctrl_backoff: Dict[str, Tuple[float, float]] = {}
         self._stop = threading.Event()
 
     # ------------------------------------------------------------------ loop
     def _reconcile(self, name: str, controller) -> None:
         """One controller tick with reconcile metrics (the analogue of the
         controller-runtime `controller_runtime_reconcile_*` series every
-        reference controller exports)."""
+        reference controller exports).
+
+        Crash-contained: a raising controller is caught here — error metric,
+        log, health gauge, and a per-controller exponential requeue backoff —
+        while the rest of the tick's sequence proceeds, the containment
+        controller-runtime gives every reference controller for free.  A
+        controller still inside its backoff window is skipped entirely."""
+        now = self.clock.now()
+        entry = self._ctrl_backoff.get(name)
+        if entry is not None and now < entry[0]:
+            return  # requeued; not yet due
         labels = {"controller": name}
         self.registry.inc("karpenter_controller_reconcile_total", labels)
-        with self.tracer.span(f"controller.{name}"), self.registry.time(
-            "karpenter_controller_reconcile_time_seconds", labels
-        ):
-            try:
+        try:
+            with self.tracer.span(f"controller.{name}"), self.registry.time(
+                "karpenter_controller_reconcile_time_seconds", labels
+            ):
                 controller.reconcile()
-            except Exception:
-                self.registry.inc(
-                    "karpenter_controller_reconcile_errors_total", labels
-                )
-                raise
+        except Exception:
+            self.registry.inc(
+                "karpenter_controller_reconcile_errors_total", labels
+            )
+            delay = (
+                min(entry[1] * 2, self.settings.controller_backoff_max)
+                if entry is not None
+                else self.settings.controller_backoff_base
+            )
+            self._ctrl_backoff[name] = (now + delay, delay)
+            self.registry.set("karpenter_tpu_controller_healthy", 0.0, labels)
+            log.exception(
+                "controller %s reconcile failed; requeued in %.1fs", name, delay
+            )
+            return
+        if entry is not None:
+            del self._ctrl_backoff[name]
+        self.registry.set("karpenter_tpu_controller_healthy", 1.0, labels)
 
     def reconcile_once(self) -> None:
         """One tick of every control loop, in a stable order: status
@@ -219,6 +263,8 @@ class Operator:
             if not leading:
                 return
 
+        # re-arm the shared cloud-API retry budget for this tick
+        self.retrying.begin_tick()
         sequence = [
             ("nodeclass", self.node_class_controller),
             ("provisioner", self.provisioner),
@@ -246,20 +292,39 @@ class Operator:
             if self.elector is not None and not self.elector.still_leading():
                 return
             self._reconcile(name, controller)
-        # 12h pricing refresh (reference pricing/controller.go:39-41)
+        # 12h pricing refresh (reference pricing/controller.go:39-41).  The
+        # provider degrades to last-good prices on API failure, and the
+        # belt-and-suspenders except below keeps even an unexpected error
+        # from killing the tick — pricing staleness must never stop
+        # scheduling.  A refresh that did NOT land (last_update unmoved)
+        # is re-attempted after PRICING_RETRY_PERIOD, not another 12h.
         if self.clock.now() - self._pricing_updated_at >= PRICING_UPDATE_PERIOD:
-            if not self.settings.isolated_vpc:
-                self.pricing.update_on_demand()
-                self.pricing.update_spot()
-            self._pricing_updated_at = self.clock.now()
+            ok = True
+            try:
+                if not self.settings.isolated_vpc:
+                    ok = self.pricing.update_on_demand()
+                    ok = self.pricing.update_spot() and ok
+            except Exception:
+                ok = False
+                log.exception("pricing refresh failed; keeping last prices")
+            now = self.clock.now()
+            self._pricing_updated_at = (
+                now if ok else now - PRICING_UPDATE_PERIOD + PRICING_RETRY_PERIOD
+            )
 
     def run(self, interval_s: float = 1.0) -> None:
-        """Blocking controller-manager loop for real deployments."""
+        """Blocking controller-manager loop for real deployments.  A tick
+        that still manages to raise (controller failures are already
+        contained in _reconcile) is logged and the loop continues — the
+        loop itself must survive anything the cloud does."""
         if self.elector is not None:
             # keep the lease fresh through ticks longer than its duration
             self.elector.start_background_renewal(self._stop)
         while not self._stop.is_set():
-            self.reconcile_once()
+            try:
+                self.reconcile_once()
+            except Exception:
+                log.exception("reconcile tick failed; continuing")
             self.clock.sleep(interval_s)
 
     def stop(self) -> None:

@@ -30,6 +30,7 @@ from karpenter_tpu.providers.bootstrap import (
     ShellBootstrap,
     TomlBootstrap,
 )
+from karpenter_tpu.providers.stale import StaleGuard
 from karpenter_tpu.utils.clock import Clock
 
 
@@ -101,9 +102,21 @@ class LaunchSpec:
 class ImageProvider:
     """Image discovery with a TTL cache (reference ami.go:118-235)."""
 
-    def __init__(self, cloud: FakeCloud, clock: Clock):
+    def __init__(self, cloud: FakeCloud, clock: Clock, registry=None):
         self.cloud = cloud
         self._cache = TTLCache(clock, DEFAULT_TTL)
+        self._stale = StaleGuard("image", clock, registry)
+
+    def _discover(self, node_class: NodeClass) -> List[FakeImage]:
+        if node_class.image_selector_terms:
+            return self.cloud.describe_images(node_class.image_selector_terms)
+        family = image_family(node_class).name
+        images = []
+        for arch in ("amd64", "arm64"):
+            im = self.cloud.latest_image(family, arch)
+            if im is not None:
+                images.append(im)
+        return images
 
     def list(self, node_class: NodeClass) -> List[ImageCandidate]:
         """Candidate images for a node class, newest-first.
@@ -118,18 +131,13 @@ class ImageProvider:
         cached = self._cache.get(key)
         if cached is not None:
             return cached
-        if node_class.image_selector_terms:
-            images = self.cloud.describe_images(node_class.image_selector_terms)
-        else:
-            family = image_family(node_class).name
-            images = []
-            for arch in ("amd64", "arm64"):
-                im = self.cloud.latest_image(family, arch)
-                if im is not None:
-                    images.append(im)
+        images, fresh = self._stale.fetch(
+            key, lambda: self._discover(node_class)
+        )
         images = sorted(images, key=lambda im: -im.created_at)
         out = [ImageCandidate(im, _image_requirements(im)) for im in images]
-        self._cache.set(key, out)
+        if fresh:
+            self._cache.set(key, out)
         return out
 
     def invalidate(self) -> None:

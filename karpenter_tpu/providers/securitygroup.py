@@ -8,23 +8,29 @@ from typing import List
 from karpenter_tpu.api import NodeClass
 from karpenter_tpu.cache.ttl import DEFAULT_TTL, TTLCache
 from karpenter_tpu.cloud.fake.backend import FakeCloud, FakeSecurityGroup
+from karpenter_tpu.providers.stale import StaleGuard
 from karpenter_tpu.utils.clock import Clock
 
 
 class SecurityGroupProvider:
-    def __init__(self, cloud: FakeCloud, clock: Clock):
+    def __init__(self, cloud: FakeCloud, clock: Clock, registry=None):
         self.cloud = cloud
         self._cache = TTLCache(clock, DEFAULT_TTL)
+        self._stale = StaleGuard("securitygroup", clock, registry)
 
     def list(self, node_class: NodeClass) -> List[FakeSecurityGroup]:
         key = tuple(node_class.security_group_selector_terms)
         cached = self._cache.get(key)
         if cached is not None:
             return cached
-        groups = self.cloud.describe_security_groups(
-            node_class.security_group_selector_terms
+        groups, fresh = self._stale.fetch(
+            key,
+            lambda: self.cloud.describe_security_groups(
+                node_class.security_group_selector_terms
+            ),
         )
-        self._cache.set(key, groups)
+        if fresh:
+            self._cache.set(key, groups)
         return groups
 
     def invalidate(self) -> None:

@@ -14,13 +14,15 @@ from typing import Dict, List, Optional, Sequence
 from karpenter_tpu.api import NodeClass
 from karpenter_tpu.cache.ttl import DEFAULT_TTL, TTLCache
 from karpenter_tpu.cloud.fake.backend import FakeCloud, FakeSubnet
+from karpenter_tpu.providers.stale import StaleGuard
 from karpenter_tpu.utils.clock import Clock
 
 
 class SubnetProvider:
-    def __init__(self, cloud: FakeCloud, clock: Clock):
+    def __init__(self, cloud: FakeCloud, clock: Clock, registry=None):
         self.cloud = cloud
         self._cache = TTLCache(clock, DEFAULT_TTL)
+        self._stale = StaleGuard("subnet", clock, registry)
         # subnet id -> IPs reserved by launches not yet confirmed
         self._inflight: Dict[str, int] = {}
 
@@ -29,8 +31,12 @@ class SubnetProvider:
         cached = self._cache.get(key)
         if cached is not None:
             return cached
-        subnets = self.cloud.describe_subnets(node_class.subnet_selector_terms)
-        self._cache.set(key, subnets)
+        subnets, fresh = self._stale.fetch(
+            key,
+            lambda: self.cloud.describe_subnets(node_class.subnet_selector_terms),
+        )
+        if fresh:
+            self._cache.set(key, subnets)
         return subnets
 
     def zonal_subnets_for_launch(

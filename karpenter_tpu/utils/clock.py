@@ -4,6 +4,7 @@ TTL/expiry; we keep the same test shape)."""
 
 from __future__ import annotations
 
+import threading
 import time as _time
 
 
@@ -17,16 +18,21 @@ class Clock:
 
 class FakeClock(Clock):
     """Manually-stepped clock.  `sleep` advances time instead of blocking so
-    controller loops run instantly under test."""
+    controller loops run instantly under test.  Advancing is locked: the
+    retry layer and chaos latency sleep on this clock from batcher/worker
+    threads, and an unsynchronized `+=` would lose updates."""
 
     def __init__(self, start: float = 1_700_000_000.0):
         self._now = start
+        self._lock = threading.Lock()
 
     def now(self) -> float:
         return self._now
 
     def sleep(self, seconds: float) -> None:
-        self._now += seconds
+        with self._lock:
+            self._now += seconds
 
     def step(self, seconds: float) -> None:
-        self._now += seconds
+        with self._lock:
+            self._now += seconds

@@ -1,0 +1,410 @@
+"""Chaos-hardening suite (ISSUE 2): sustained seeded fault schedules on the
+fake cloud, degraded-but-working provisioning, crash-contained reconcile,
+and the seeded chaos soak that runs the full Operator through an API storm
+and asserts the survival invariants — no duplicate launches, no leaked
+instances, every pod scheduled once the faults clear, no controller
+permanently wedged."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import Pod, Resources, Settings
+from karpenter_tpu.cloud.fake.backend import (
+    ChaosEngine,
+    CloudAPIError,
+    FakeCloud,
+    MachineShape,
+)
+from karpenter_tpu.cloud.retry import OPEN
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.state.kube import KubeStore
+from karpenter_tpu.testing import Environment
+from karpenter_tpu.utils.clock import FakeClock
+
+SHAPES = [
+    MachineShape(name=f"std1.{s}", cpu=float(c), memory=c * 4 * 2**30,
+                 od_price=0.05 * c)
+    for c, s in ((2, "medium"), (4, "large"), (8, "xlarge"), (16, "2xlarge"))
+]
+
+# fast backoffs so fault-heavy suites spend fake seconds, not real ones
+FAST = dict(
+    cloud_max_retries=2,
+    cloud_retry_budget_per_tick=20,
+    cloud_backoff_base=0.005,
+    cloud_backoff_max=0.02,
+    cloud_circuit_failure_threshold=4,
+    cloud_circuit_reset_timeout=5.0,
+    controller_backoff_base=0.5,
+    controller_backoff_max=4.0,
+)
+
+
+def _cloud():
+    clock = FakeClock()
+    return clock, FakeCloud(clock, shapes=SHAPES).with_default_topology()
+
+
+class TestChaosEngine:
+    def test_blackout_window(self):
+        clock, cloud = _cloud()
+        t = clock.now()
+        cloud.chaos.add_blackout(t + 10, 20.0, apis=["DescribeSubnets"])
+        assert cloud.describe_subnets([]) == []  # before the window
+        clock.step(15.0)
+        with pytest.raises(CloudAPIError) as exc:
+            cloud.describe_subnets([])
+        assert exc.value.code == "ServiceUnavailable"
+        cloud.describe_instances()  # other APIs unaffected
+        clock.step(20.0)
+        assert cloud.describe_subnets([]) == []  # window passed
+
+    def test_full_api_blackout(self):
+        clock, cloud = _cloud()
+        cloud.chaos.add_blackout(clock.now(), 10.0)  # apis=None -> everything
+        for call in (
+            lambda: cloud.describe_instance_types(),
+            lambda: cloud.describe_instances(),
+            lambda: cloud.get_products(),
+        ):
+            with pytest.raises(CloudAPIError):
+                call()
+
+    def test_throttle_burst(self):
+        clock, cloud = _cloud()
+        cloud.chaos.add_throttle_burst(clock.now(), 5.0)
+        with pytest.raises(CloudAPIError) as exc:
+            cloud.describe_instances()
+        assert exc.value.code == "RequestLimitExceeded"
+
+    def test_error_rate_is_seeded_and_reproducible(self):
+        outcomes = []
+        for _ in range(2):
+            clock, cloud = _cloud()
+            cloud.chaos.reseed(42)
+            cloud.chaos.set_error_rate("DescribeInstances", 0.5)
+            run = []
+            for _ in range(20):
+                try:
+                    cloud.describe_instances()
+                    run.append(True)
+                except CloudAPIError:
+                    run.append(False)
+            outcomes.append(run)
+        assert outcomes[0] == outcomes[1]
+        assert True in outcomes[0] and False in outcomes[0]
+
+    def test_latency_rides_the_injected_clock(self):
+        clock, cloud = _cloud()
+        cloud.chaos.set_latency("DescribeInstances", 1.5)
+        t0 = clock.now()
+        cloud.describe_instances()
+        assert clock.now() == t0 + 1.5
+
+    def test_partial_fleet(self):
+        clock, cloud = _cloud()
+        overrides = [{"instance_type": "std1.large", "zone": "zone-a",
+                      "subnet_id": "subnet-0"}]
+        cloud.chaos.set_partial_fleet(1.0)  # withhold everything
+        insts, errs = cloud.create_fleet(overrides=overrides,
+                                         capacity_type="on-demand", count=3)
+        assert not insts
+        assert errs and errs[0].code == "InsufficientInstanceCapacity"
+        cloud.chaos.set_partial_fleet(0.0)
+        insts, errs = cloud.create_fleet(overrides=overrides,
+                                         capacity_type="on-demand", count=3)
+        assert len(insts) == 3 and not errs
+
+    def test_clear_stops_everything(self):
+        clock, cloud = _cloud()
+        cloud.chaos.set_error_rate("*", 1.0)
+        cloud.chaos.add_blackout(clock.now(), 1e9)
+        cloud.chaos.set_partial_fleet(1.0)
+        with pytest.raises(CloudAPIError):
+            cloud.describe_instances()
+        cloud.chaos.clear()
+        cloud.describe_instances()
+        assert cloud.chaos.fleet_shortfall(10) == 0
+
+    def test_engine_is_per_cloud_and_detachable(self):
+        clock, cloud = _cloud()
+        assert isinstance(cloud.chaos, ChaosEngine)
+        cloud.chaos.enabled = False
+        cloud.chaos.set_error_rate("*", 1.0)
+        cloud.describe_instances()  # disabled engine injects nothing
+
+
+class TestPreflightRetry:
+    def test_one_shot_transient_error_no_longer_aborts_construction(self):
+        clock, cloud = _cloud()
+        cloud.recorder.set_next_error(
+            "DescribeInstanceTypes", CloudAPIError("InternalError")
+        )
+        op = Operator(
+            cloud, KubeStore(),
+            settings=Settings(cluster_name="t", **FAST),
+        )
+        assert op.instance_types is not None
+        assert cloud.recorder.count("DescribeInstanceTypes") >= 2
+
+    def test_throttle_burst_at_boot_retried(self):
+        clock, cloud = _cloud()
+        cloud.recorder.set_error_sequence(
+            "DescribeInstanceTypes",
+            [CloudAPIError("RequestLimitExceeded")] * 2,
+        )
+        Operator(cloud, KubeStore(), settings=Settings(cluster_name="t", **FAST))
+
+    def test_persistent_failure_still_fails_fast_with_context(self):
+        clock, cloud = _cloud()
+        cloud.recorder.set_next_error(
+            "DescribeInstanceTypes", CloudAPIError("UnauthorizedOperation")
+        )
+        with pytest.raises(RuntimeError, match="preflight"):
+            Operator(cloud, KubeStore(),
+                     settings=Settings(cluster_name="t", **FAST))
+
+
+class TestCrashContainment:
+    def _env(self):
+        env = Environment(
+            shapes=SHAPES, settings=Settings(cluster_name="test", **FAST)
+        )
+        env.default_node_class()
+        env.default_node_pool()
+        return env
+
+    def test_raising_controller_is_contained_and_requeued(self):
+        env = self._env()
+        op = env.operator
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise RuntimeError("boom")
+
+        orig = op.tagging.reconcile
+        op.tagging.reconcile = boom
+        env.kube.put_pod(Pod(requests=Resources(cpu=1, memory="1Gi")))
+        env.step(1.0)  # must not raise out of reconcile_once
+        labels = {"controller": "tagging"}
+        assert env.registry.counter(
+            "karpenter_controller_reconcile_errors_total", labels
+        ) == 1
+        assert env.registry.gauge(
+            "karpenter_tpu_controller_healthy", labels
+        ) == 0.0
+        # the rest of the sequence proceeded: provisioner stayed healthy
+        assert env.registry.gauge(
+            "karpenter_tpu_controller_healthy", {"controller": "provisioner"}
+        ) == 1.0
+        # inside the backoff window the controller is skipped
+        n = calls["n"]
+        env.step(0.1)
+        assert calls["n"] == n
+        # due again after the backoff: it runs (and fails, doubling the delay)
+        env.step(1.0)
+        assert calls["n"] == n + 1
+        first_delay = FAST["controller_backoff_base"]
+        assert op._ctrl_backoff["tagging"][1] == pytest.approx(
+            min(first_delay * 2, FAST["controller_backoff_max"])
+        )
+        # recovery: a clean reconcile clears the backoff and health flips
+        op.tagging.reconcile = orig
+        env.step(10.0)
+        assert "tagging" not in op._ctrl_backoff
+        assert env.registry.gauge(
+            "karpenter_tpu_controller_healthy", labels
+        ) == 1.0
+        # and the pod put down during the crash window still got scheduled
+        env.settle()
+        assert not env.kube.pending_pods()
+
+    def test_backoff_is_capped(self):
+        env = self._env()
+        op = env.operator
+
+        def boom():
+            raise RuntimeError("boom")
+
+        op.tagging.reconcile = boom
+        for _ in range(10):
+            env.step(FAST["controller_backoff_max"] + 1)
+        assert op._ctrl_backoff["tagging"][1] == FAST["controller_backoff_max"]
+
+
+class TestDegradedProvisioning:
+    def test_subnet_outage_serves_last_good_and_reports_staleness(self):
+        env = Environment(
+            shapes=SHAPES,
+            settings=Settings(
+                cluster_name="test", **{**FAST,
+                                        "cloud_circuit_reset_timeout": 1e6}
+            ),
+        )
+        env.default_node_class()
+        env.default_node_pool()
+        env.kube.put_pod(Pod(requests=Resources(cpu=1, memory="1Gi")))
+        env.settle()
+        assert not env.kube.pending_pods()
+        # total, sustained DescribeSubnets outage from here on
+        env.cloud.chaos.add_blackout(env.clock.now(), 1e9,
+                                     apis=["DescribeSubnets"])
+        env.subnets.invalidate()  # force the next list through the dead API
+        env.kube.put_pod(Pod(requests=Resources(cpu=2, memory="1Gi")))
+        env.settle()
+        # provisioning succeeded from the last-good subnet view
+        assert not env.kube.pending_pods()
+        assert env.registry.gauge(
+            "karpenter_provider_cache_stale_seconds", {"provider": "subnet"}
+        ) > 0
+        # the breaker opened, so the dead API is no longer hammered
+        assert env.operator.retrying.circuit_state("describe_subnets") == OPEN
+
+    def test_pricing_refresh_outage_cannot_kill_the_tick(self):
+        env = Environment(
+            shapes=SHAPES, settings=Settings(cluster_name="test", **FAST)
+        )
+        env.default_node_class()
+        env.default_node_pool()
+        env.cloud.chaos.add_blackout(
+            env.clock.now(), 1e12,
+            apis=["GetProducts", "DescribeSpotPriceHistory"],
+        )
+        env.clock.step(12 * 3600 + 1)  # due for the 12h pricing refresh
+        env.operator.reconcile_once()  # must not raise
+        assert env.registry.gauge(
+            "karpenter_provider_cache_stale_seconds", {"provider": "pricing"}
+        ) > 0
+        # scheduling still works on the seeded price table
+        env.kube.put_pod(Pod(requests=Resources(cpu=1, memory="1Gi")))
+        env.settle()
+        assert not env.kube.pending_pods()
+        # a FAILED refresh is re-attempted on the short retry cadence once
+        # the API heals — not after another 12h of stale prices
+        from karpenter_tpu.providers.pricing import PRICING_RETRY_PERIOD
+
+        env.cloud.chaos.clear()
+        env.clock.step(PRICING_RETRY_PERIOD + 1)
+        env.operator.reconcile_once()
+        assert env.registry.gauge(
+            "karpenter_provider_cache_stale_seconds", {"provider": "pricing"}
+        ) == 0.0
+
+
+# --------------------------------------------------------------------- soak
+
+SOAK_CONTROLLERS = (
+    "nodeclass", "provisioner", "lifecycle", "interruption", "disruption",
+    "termination", "link", "garbagecollection", "tagging", "metrics_state",
+    "consistency",
+)
+
+
+def _soak(seed: int, faulty_ticks: int, total_ticks: int) -> Environment:
+    """Run the full Operator under a seeded mixed fault schedule (error
+    rates, throttle bursts, full and partial blackouts, injected latency,
+    partial CreateFleet fulfillment) with workload churn, then clear the
+    faults and give the system the recovery windows its caches need (ICE
+    TTL 180s, GC grace 30s)."""
+    env = Environment(
+        shapes=SHAPES,
+        settings=Settings(cluster_name="test", interruption_queue_name="q",
+                          **FAST),
+    )
+    env.default_node_class()
+    env.default_node_pool()
+    rng = random.Random(seed)
+    chaos = env.cloud.chaos
+    chaos.reseed(seed + 1)
+    t0 = env.clock.now()
+    chaos.set_error_rate("*", 0.05, "InternalError")
+    chaos.set_latency("CreateFleet", 0.002)
+    chaos.set_partial_fleet(0.15)
+    chaos.add_throttle_burst(t0 + 10, 8.0)
+    chaos.add_blackout(t0 + 30, 6.0)  # full API blackout
+    chaos.add_blackout(t0 + 50, 8.0, apis=["DescribeSubnets", "DescribeImages"])
+    live_pods = []
+    for tick in range(total_ticks):
+        if tick == faulty_ticks:
+            chaos.clear()  # the weather breaks
+        r = rng.random()
+        if r < 0.4:
+            p = Pod(requests=Resources(cpu=rng.choice([0.5, 1, 2]),
+                                       memory="1Gi"))
+            env.kube.put_pod(p)
+            live_pods.append(p)
+        elif r < 0.5 and live_pods:
+            env.kube.delete_pod(live_pods.pop().key())
+        elif r < 0.55:
+            running = [i for i in env.cloud.instances.values()
+                       if i.state == "running"]
+            if running:
+                try:  # out-of-band kill (the raw API is chaos-subjected too)
+                    env.cloud.terminate_instances([rng.choice(running).id])
+                except CloudAPIError:
+                    pass
+        elif r < 0.6:
+            claims = [c for c in env.kube.node_claims.values()
+                      if c.provider_id]
+            if claims:
+                env.cloud.send_message({
+                    "kind": "spot_interruption",
+                    "instance_id": rng.choice(claims).provider_id,
+                })
+        env.clock.step(rng.choice([0.5, 1.0, 2.0]))
+        env.kubelet.step()
+        env.operator.reconcile_once()  # ANY raise here fails the soak
+        env.kubelet.step()
+    # recovery: outlast the ICE masks and GC/liveness grace windows
+    for _ in range(8):
+        env.step(35.0)
+    env.settle(max_rounds=40)
+    return env
+
+
+def _assert_invariants(env: Environment) -> None:
+    op = env.operator
+    # every pending pod scheduled once the faults cleared
+    assert not env.kube.pending_pods()
+    # no duplicate launches: live claims map 1:1 onto instances ...
+    pids = [c.provider_id for c in env.kube.node_claims.values()
+            if c.provider_id and c.deleted_at is None]
+    assert len(pids) == len(set(pids))
+    # ... and no two live instances carry the same nodeclaim attribution
+    by_tag = {}
+    for inst in env.cloud.instances.values():
+        if inst.state == "terminated":
+            continue
+        tag = inst.tags.get("karpenter.sh/nodeclaim")
+        if tag:
+            assert by_tag.setdefault(tag, inst.id) == inst.id, (
+                f"claim {tag} backed by {by_tag[tag]} AND {inst.id}"
+            )
+    # no leaked instances: everything still running is claimed
+    running = {i.id for i in env.cloud.instances.values()
+               if i.state == "running"}
+    claimed = {c.provider_id for c in env.kube.node_claims.values()
+               if c.provider_id}
+    assert running <= claimed, f"leaked instances: {running - claimed}"
+    # no controller permanently wedged
+    assert not op._ctrl_backoff, op._ctrl_backoff
+    for name in SOAK_CONTROLLERS:
+        assert env.registry.gauge(
+            "karpenter_tpu_controller_healthy", {"controller": name}
+        ) == 1.0, f"controller {name} unhealthy after recovery"
+
+
+@pytest.mark.chaos
+def test_chaos_soak_short():
+    """Tier-1 seeded soak: ~80 ticks, faults clear at tick 60."""
+    _assert_invariants(_soak(seed=7, faulty_ticks=60, total_ticks=80))
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chaos_soak_long(seed):
+    """The multi-hundred-tick soak (slow): 300 ticks, faults clear at 240."""
+    _assert_invariants(_soak(seed=seed, faulty_ticks=240, total_ticks=300))

@@ -309,6 +309,66 @@ class TestInterruption:
         assert not env.cloud.queue
         assert claim.deleted_at is not None
 
+    def test_undeleted_message_redelivered_then_processed_exactly_once(
+        self, env, ready
+    ):
+        """The handler succeeds but DeleteMessage fails: the message must
+        stay hidden until `invisible_until`, reappear after it, be
+        re-processed (idempotently) exactly once more, and never again
+        after the successful deletion — the SQS visibility-timeout contract
+        (cloud/fake/backend.py receive/delete)."""
+        from karpenter_tpu.cloud.fake.backend import CloudAPIError
+
+        add_pods(env, 2)
+        env.settle()
+        claim = next(iter(env.kube.node_claims.values()))
+        ic = env.operator.interruption
+        env.cloud.send_message(
+            {"kind": "scheduled_change", "instance_id": claim.provider_id}
+        )
+        # outlast the retry layer (1 initial + cloud_max_retries attempts)
+        retries = env.operator.retrying.max_retries
+        env.cloud.recorder.set_error_sequence(
+            "DeleteMessage",
+            [CloudAPIError("InternalError")] * (retries + 1),
+        )
+        ic.reconcile()
+        # handled (the claim was marked) but NOT deleted
+        assert claim.deleted_at is not None
+        assert len(env.cloud.queue) == 1
+        assert env.registry.counter(
+            "karpenter_interruption_message_errors"
+        ) == 1
+        assert env.registry.counter(
+            "karpenter_interruption_deleted_messages"
+        ) == 0
+        # in flight: an immediate poll must not see it
+        ic.reconcile()
+        assert env.registry.counter(
+            "karpenter_interruption_received_messages",
+            {"message_type": "scheduled_change"},
+        ) == 1
+        # past invisible_until it reappears and is re-processed once
+        env.clock.step(env.cloud.visibility_timeout + 1)
+        ic.reconcile()
+        assert not env.cloud.queue
+        assert env.registry.counter(
+            "karpenter_interruption_deleted_messages"
+        ) == 1
+        assert env.registry.counter(
+            "karpenter_interruption_received_messages",
+            {"message_type": "scheduled_change"},
+        ) == 2
+        # after deletion: gone for good
+        ic.reconcile()
+        assert env.registry.counter(
+            "karpenter_interruption_received_messages",
+            {"message_type": "scheduled_change"},
+        ) == 2
+        assert env.registry.counter(
+            "karpenter_interruption_deleted_messages"
+        ) == 1
+
 
 class TestDisruption:
     def test_expiration(self, env):

@@ -82,6 +82,33 @@ def test_next_error_injection(cloud):
     assert cloud.describe_instances() == []  # one-shot
 
 
+def test_error_sequence_injection(cloud):
+    """Sustained injection: the next N calls fail in order, then the API
+    recovers (the one-shot NextError generalized)."""
+    cloud.recorder.set_error_sequence(
+        "DescribeInstances",
+        [CloudAPIError("InternalError"), CloudAPIError("Throttling")],
+    )
+    with pytest.raises(CloudAPIError, match="InternalError"):
+        cloud.describe_instances()
+    with pytest.raises(CloudAPIError, match="Throttling"):
+        cloud.describe_instances()
+    assert cloud.describe_instances() == []
+
+
+def test_error_at_call_injection(cloud):
+    """Call-count-triggered injection: only the nth FUTURE call fails."""
+    cloud.describe_instances()  # prior traffic must not shift the trigger
+    cloud.recorder.set_error_at_call(
+        "DescribeInstances", 3, CloudAPIError("InternalError")
+    )
+    cloud.describe_instances()
+    cloud.describe_instances()
+    with pytest.raises(CloudAPIError):
+        cloud.describe_instances()
+    assert cloud.describe_instances() == []
+
+
 def test_generated_catalog_scale():
     cat = generate_catalog()
     assert len(cat) >= 180  # 6 families x 3 generations x ~10 sizes

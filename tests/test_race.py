@@ -152,6 +152,88 @@ class TestThreadSafety:
             N_THREADS * OPS_PER_THREAD
         )
 
+    def test_fake_cloud_api_storm(self):
+        """Hammer every FakeCloud API surface the batcher / launch pool /
+        interruption workers hit from threads, WHILE other threads mutate
+        the shared catalog/topology dicts — iteration during mutation must
+        never raise (the lock-audit invariant: every API takes self._lock)."""
+        from karpenter_tpu.cloud.fake.backend import (
+            FakeImage,
+            FakeLaunchTemplate,
+            FakeSecurityGroup,
+            FakeSubnet,
+            generate_catalog,
+        )
+        from karpenter_tpu.api.objects import SelectorTerm
+        from karpenter_tpu.cloud.fake.backend import FakeCloud
+
+        cloud = FakeCloud(
+            FakeClock(), shapes=generate_catalog()[:20]
+        ).with_default_topology()
+        term = [SelectorTerm.of(Name="*")]
+
+        def attack(i):
+            rng = random.Random(300 + i)
+            for n in range(OPS_PER_THREAD // 4):
+                op = rng.randrange(12)
+                if op == 0:
+                    cloud.create_launch_template(
+                        FakeLaunchTemplate(name=f"lt-{i}-{n % 8}")
+                    )
+                elif op == 1:
+                    cloud.describe_launch_templates()
+                elif op == 2:
+                    cloud.delete_launch_template(f"lt-{rng.randrange(8)}-{n % 8}")
+                elif op == 3:
+                    cloud.ensure_instance_profile(f"p-{rng.randrange(8)}", "role")
+                elif op == 4:
+                    cloud.delete_instance_profile(f"p-{rng.randrange(8)}")
+                elif op == 5:
+                    cloud.add_image(
+                        FakeImage(id=f"im-{i}-{n % 8}", family="standard")
+                    )
+                elif op == 6:
+                    cloud.describe_images(term)
+                    cloud.latest_image("standard", "amd64")
+                elif op == 7:
+                    cloud.add_subnet(
+                        FakeSubnet(id=f"sn-{i}-{n % 8}", zone="zone-a")
+                    )
+                    cloud.describe_subnets(term)
+                elif op == 8:
+                    cloud.add_security_group(
+                        FakeSecurityGroup(id=f"sg-{i}-{n % 8}")
+                    )
+                    cloud.describe_security_groups(term)
+                elif op == 9:
+                    insts, _ = cloud.create_fleet(
+                        overrides=[{
+                            "instance_type": cloud.describe_instance_types()[0].name,
+                            "zone": "zone-a",
+                            "subnet_id": "subnet-0",
+                        }],
+                        capacity_type="on-demand",
+                    )
+                    if insts and rng.random() < 0.5:
+                        cloud.terminate_instances([insts[0].id])
+                elif op == 10:
+                    cloud.describe_instances()
+                    cloud.describe_instance_type_offerings()
+                else:
+                    cloud.get_products()
+                    cloud.describe_spot_price_history()
+                    cloud.set_capacity(
+                        f"t-{rng.randrange(4)}", "zone-a", "spot", 5
+                    )
+                    cloud.mark_insufficient(
+                        f"t-{rng.randrange(4)}", "zone-a", "spot"
+                    )
+
+        _hammer(N_THREADS, attack)
+        # the cloud still behaves after the storm
+        assert cloud.describe_instance_types()
+        assert cloud.recorder.count("CreateFleet") > 0
+
     def test_queue_drained_nothing_lost(self):
         """Parallel consumers over the fake SQS: the visibility timeout
         hides in-flight messages from other consumers, and no message may

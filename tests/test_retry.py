@@ -1,0 +1,266 @@
+"""RetryingCloud: classified retries, backoff on the injected clock, the
+per-tick retry budget, and the per-API circuit breaker (cloud/retry.py) —
+the AWS-SDK retry behavior the reference's providers get for free."""
+
+import pytest
+
+from karpenter_tpu.api import Settings
+from karpenter_tpu.cloud.fake.backend import (
+    CloudAPIError,
+    FakeCloud,
+    LaunchTemplateNotFoundError,
+    MachineShape,
+)
+from karpenter_tpu.cloud.retry import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    THROTTLE,
+    TRANSIENT,
+    TERMINAL,
+    CircuitOpenError,
+    RetryingCloud,
+    classify,
+)
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _make(settings=None):
+    clock = FakeClock()
+    cloud = FakeCloud(
+        clock,
+        shapes=[MachineShape(name="std1.large", cpu=4, memory=16 * 2**30)],
+        zones=["zone-a"],
+    ).with_default_topology()
+    registry = Registry()
+    retrying = RetryingCloud(
+        cloud,
+        clock=clock,
+        settings=settings
+        or Settings(cluster_name="t", cloud_backoff_base=0.01,
+                    cloud_backoff_max=0.1),
+        registry=registry,
+    )
+    return clock, cloud, registry, retrying
+
+
+class TestClassification:
+    def test_codes(self):
+        assert classify(CloudAPIError("RequestLimitExceeded")) == THROTTLE
+        assert classify(CloudAPIError("Throttling")) == THROTTLE
+        assert classify(CloudAPIError("InternalError")) == TRANSIENT
+        assert classify(CloudAPIError("ServiceUnavailable")) == TRANSIENT
+        assert classify(CloudAPIError("InsufficientInstanceCapacity")) == TERMINAL
+        assert classify(LaunchTemplateNotFoundError("lt")) == TERMINAL
+        assert classify(CircuitOpenError("api", 0.0)) == TERMINAL
+        assert classify(ValueError("bug")) == TERMINAL
+
+    def test_transient_error_retried_to_success(self):
+        clock, cloud, registry, retrying = _make()
+        t0 = clock.now()
+        cloud.recorder.set_error_sequence(
+            "DescribeInstances",
+            [CloudAPIError("InternalError"), CloudAPIError("ServiceUnavailable")],
+        )
+        assert retrying.describe_instances() == []
+        assert cloud.recorder.count("DescribeInstances") == 3
+        # backoff was paced on the injected clock
+        assert clock.now() >= t0
+        assert registry.counter(
+            "karpenter_cloud_api_retries_total",
+            {"api": "describe_instances", "classification": TRANSIENT},
+        ) == 2
+
+    def test_throttle_retried(self):
+        clock, cloud, registry, retrying = _make()
+        cloud.recorder.set_next_error(
+            "GetProducts", CloudAPIError("RequestLimitExceeded")
+        )
+        assert retrying.get_products()
+        assert registry.counter(
+            "karpenter_cloud_api_retries_total",
+            {"api": "get_products", "classification": THROTTLE},
+        ) == 1
+
+    def test_terminal_error_passes_through_unretried(self):
+        clock, cloud, registry, retrying = _make()
+        cloud.recorder.set_next_error(
+            "DescribeSubnets", CloudAPIError("InvalidParameterValue")
+        )
+        with pytest.raises(CloudAPIError, match="InvalidParameterValue"):
+            retrying.describe_subnets([])
+        assert cloud.recorder.count("DescribeSubnets") == 1
+        assert registry.counters.get("karpenter_cloud_api_retries_total") is None
+
+    def test_retries_exhausted_raises_last_error(self):
+        clock, cloud, registry, retrying = _make()
+        cloud.recorder.set_error_sequence(
+            "GetParameter", [CloudAPIError("InternalError")] * 10
+        )
+        with pytest.raises(CloudAPIError, match="InternalError"):
+            retrying.latest_image("standard", "amd64")
+        # 1 initial + cloud_max_retries attempts
+        assert cloud.recorder.count("GetParameter") == 1 + retrying.max_retries
+
+    def test_ice_fleet_errors_flow_in_band_untouched(self):
+        """ICE must reach the caller (and the ICE cache) unretried — both
+        the in-band CreateFleet error list and nothing in the retry
+        counters."""
+        clock, cloud, registry, retrying = _make()
+        cloud.mark_insufficient("std1.large", "zone-a", "on-demand")
+        insts, errs = retrying.create_fleet(
+            overrides=[{"instance_type": "std1.large", "zone": "zone-a",
+                        "subnet_id": "subnet-0"}],
+            capacity_type="on-demand",
+        )
+        assert not insts
+        assert errs and errs[0].code == "InsufficientInstanceCapacity"
+        assert cloud.recorder.count("CreateFleet") == 1
+        assert registry.counters.get("karpenter_cloud_api_retries_total") is None
+
+    def test_non_api_attributes_pass_through(self):
+        clock, cloud, registry, retrying = _make()
+        assert retrying.clock is clock
+        assert retrying.recorder is cloud.recorder
+        assert retrying.instances is cloud.instances
+        assert retrying.zones == cloud.zones
+
+
+class TestRetryBudget:
+    def test_budget_exhaustion_stops_retries_until_next_tick(self):
+        clock, cloud, registry, retrying = _make(
+            Settings(cluster_name="t", cloud_max_retries=3,
+                     cloud_retry_budget_per_tick=1,
+                     cloud_backoff_base=0.01, cloud_backoff_max=0.02)
+        )
+        cloud.recorder.set_error_sequence(
+            "DescribeInstances", [CloudAPIError("InternalError")] * 3
+        )
+        # first call burns the whole budget on its one allowed retry, then
+        # gives up even though max_retries would allow more
+        with pytest.raises(CloudAPIError):
+            retrying.describe_instances()
+        assert cloud.recorder.count("DescribeInstances") == 2
+        # a fresh tick re-arms the budget: one error left, one retry allowed
+        retrying.begin_tick()
+        assert retrying.describe_instances() == []
+        assert cloud.recorder.count("DescribeInstances") == 4
+
+    def test_zero_budget_means_no_retries(self):
+        clock, cloud, registry, retrying = _make(
+            Settings(cluster_name="t", cloud_retry_budget_per_tick=0,
+                     cloud_backoff_base=0.01, cloud_backoff_max=0.02)
+        )
+        cloud.recorder.set_next_error(
+            "DescribeInstances", CloudAPIError("InternalError")
+        )
+        with pytest.raises(CloudAPIError):
+            retrying.describe_instances()
+        assert cloud.recorder.count("DescribeInstances") == 1
+
+
+class TestStaleGuard:
+    def test_gauge_tracks_max_age_across_degraded_keys(self):
+        """One key recovering must not hide another key's ongoing
+        degradation: the staleness gauge is the max age over every key
+        currently served stale."""
+        from karpenter_tpu.providers.stale import STALENESS_METRIC, StaleGuard
+
+        clock, reg = FakeClock(), Registry()
+        g = StaleGuard("subnet", clock, reg)
+        g.fetch("a", lambda: 1)
+        g.fetch("b", lambda: 2)
+        clock.step(100.0)
+
+        def boom():
+            raise CloudAPIError("ServiceUnavailable")
+
+        assert g.fetch("a", boom) == (1, False)
+        assert reg.gauge(STALENESS_METRIC, {"provider": "subnet"}) == 100.0
+        g.fetch("b", lambda: 3)  # b fresh while a still degraded
+        assert reg.gauge(STALENESS_METRIC, {"provider": "subnet"}) == 100.0
+        g.fetch("a", lambda: 4)  # a recovers -> fully fresh
+        assert reg.gauge(STALENESS_METRIC, {"provider": "subnet"}) == 0.0
+        # a key with no last-good value still raises
+        with pytest.raises(CloudAPIError):
+            g.fetch("never-seen", boom)
+
+
+class TestCircuitBreaker:
+    def _settings(self):
+        return Settings(
+            cluster_name="t", cloud_max_retries=0,
+            cloud_circuit_failure_threshold=3,
+            cloud_circuit_reset_timeout=30.0,
+            cloud_backoff_base=0.01, cloud_backoff_max=0.02,
+        )
+
+    def test_opens_after_consecutive_failures_and_fails_fast(self):
+        clock, cloud, registry, retrying = _make(self._settings())
+        cloud.recorder.set_error_sequence(
+            "DescribeSubnets", [CloudAPIError("InternalError")] * 3
+        )
+        for _ in range(3):
+            with pytest.raises(CloudAPIError):
+                retrying.describe_subnets([])
+        assert retrying.circuit_state("describe_subnets") == OPEN
+        assert registry.gauge(
+            "karpenter_cloud_api_circuit_state", {"api": "describe_subnets"}
+        ) == OPEN
+        # while open: fail fast, backend untouched
+        n = cloud.recorder.count("DescribeSubnets")
+        with pytest.raises(CircuitOpenError):
+            retrying.describe_subnets([])
+        assert cloud.recorder.count("DescribeSubnets") == n
+
+    def test_half_open_probe_success_closes(self):
+        clock, cloud, registry, retrying = _make(self._settings())
+        cloud.recorder.set_error_sequence(
+            "DescribeSubnets", [CloudAPIError("InternalError")] * 3
+        )
+        for _ in range(3):
+            with pytest.raises(CloudAPIError):
+                retrying.describe_subnets([])
+        clock.step(31.0)  # past the reset timeout -> half-open probe allowed
+        assert retrying.describe_subnets([]) == []
+        assert retrying.circuit_state("describe_subnets") == CLOSED
+        assert registry.gauge(
+            "karpenter_cloud_api_circuit_state", {"api": "describe_subnets"}
+        ) == CLOSED
+
+    def test_half_open_probe_failure_reopens(self):
+        clock, cloud, registry, retrying = _make(self._settings())
+        cloud.recorder.set_error_sequence(
+            "DescribeSubnets", [CloudAPIError("InternalError")] * 4
+        )
+        for _ in range(3):
+            with pytest.raises(CloudAPIError):
+                retrying.describe_subnets([])
+        clock.step(31.0)
+        with pytest.raises(CloudAPIError):  # the probe fails -> re-open
+            retrying.describe_subnets([])
+        assert retrying.circuit_state("describe_subnets") == OPEN
+
+    def test_terminal_errors_do_not_trip_the_breaker(self):
+        clock, cloud, registry, retrying = _make(self._settings())
+        for _ in range(5):
+            cloud.recorder.set_next_error(
+                "DescribeSubnets", CloudAPIError("InvalidParameterValue")
+            )
+            with pytest.raises(CloudAPIError):
+                retrying.describe_subnets([])
+        assert retrying.circuit_state("describe_subnets") == CLOSED
+
+    def test_breakers_are_per_api(self):
+        clock, cloud, registry, retrying = _make(self._settings())
+        cloud.recorder.set_error_sequence(
+            "DescribeSubnets", [CloudAPIError("InternalError")] * 3
+        )
+        for _ in range(3):
+            with pytest.raises(CloudAPIError):
+                retrying.describe_subnets([])
+        assert retrying.circuit_state("describe_subnets") == OPEN
+        # a different API is unaffected
+        assert retrying.describe_instances() == []
+        assert retrying.circuit_state("describe_instances") == CLOSED
