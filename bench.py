@@ -25,7 +25,11 @@ Configs (BASELINE.md "Benchmark configs to reproduce"):
    distributed-backend boundary's overhead (SURVEY.md §5).
 
 Each line: {"metric", "value", "unit", "vs_baseline", "path", "kernel",
-"nodes"}.  ``vs_baseline`` is the speedup vs the 200 ms north-star budget
+"nodes", "phases"}.  ``phases`` is the per-phase wall-time breakdown (ms)
+of the median sample — the solver's disjoint self-time spans (partition /
+compile / pad / dispatch / device_block / oracle / decode / other, see
+README "solve latency anatomy") plus a "harness" residual, summing to ≈
+the line's p50.  ``vs_baseline`` is the speedup vs the 200 ms north-star budget
 (>1.0 = faster than target; the reference publishes no latency numbers at
 this scale, SURVEY.md §6).  ``path``/``kernel`` record which solver path
 ("tensor" | "hybrid") and which device kernel ("pallas" | "scan")
@@ -43,6 +47,20 @@ from typing import Dict, List, Optional, Tuple
 BUDGET_MS = 200.0
 ZONES = ("zone-a", "zone-b", "zone-c")
 
+# workload scale + sampling knobs: 1.0 / (3, 21) for the real benchmark,
+# shrunk by main(tiny=True) so the tier-1 smoke test can drive the exact
+# same emit path (every builder, every assert, every line field) in
+# seconds instead of minutes
+SCALE = 1.0
+WARMUP = 3
+ITERS = 21
+
+
+def _n(count: int) -> int:
+    """A workload count at the current SCALE (>= 1 so every shape keeps
+    at least one representative)."""
+    return max(1, int(count * SCALE))
+
 
 def _emit(
     metric: str,
@@ -51,8 +69,15 @@ def _emit(
     kernel: str,
     nodes: int,
     noise_ms: Optional[float] = None,
+    phases: Optional[Dict[str, float]] = None,
     **extra,
 ) -> None:
+    dev = extra.get("device_ms")
+    if dev is not None and dev < 0:
+        # the measurement site clamps (see _marginal_estimate); a negative
+        # reading here means a new un-clamped path was added — fail loudly
+        # instead of publishing a nonsense number
+        raise ValueError(f"negative device_ms {dev} for {metric}")
     line = {
         "metric": metric,
         "value": round(p50_ms, 2),
@@ -67,25 +92,44 @@ def _emit(
         # measurement uncertainty (IQR of the samples): readings moving
         # less than this are link jitter, not regressions
         line["noise_ms"] = round(noise_ms, 2)
+    if phases is not None:
+        # per-phase wall-time breakdown (ms) of the median sample — the
+        # solver's disjoint self-time spans (see TensorScheduler.solve)
+        # plus a "harness" residual (bench asserts/bookkeeping), so the
+        # spans sum to ~ the reported p50 by construction
+        pm = {k: round(v * 1000.0, 3) for k, v in phases.items()}
+        pm["harness"] = round(max(0.0, p50_ms - sum(pm.values())), 3)
+        line["phases"] = pm
     print(json.dumps(line), flush=True)
 
 
-def _measure(solve, warmup: int = 3, iters: int = 21) -> Tuple[float, float]:
-    """(p50, noise) over 21 samples after 3 warmups: the tunneled
+def _measure(
+    solve, warmup: Optional[int] = None, iters: Optional[int] = None,
+    phases_fn=None,
+) -> Tuple[float, float, Dict[str, float]]:
+    """(p50, noise, phases) over 21 samples after 3 warmups: the tunneled
     device's round-trip latency jitters by tens of ms, and a small sample
     lets a single spike move the reported median.  ``noise`` is the
     inter-quartile range in ms — the per-line uncertainty every emitted
     metric carries, so a consumer can tell a real regression from link
-    jitter."""
+    jitter.  ``phases`` is the per-phase breakdown (seconds) captured via
+    ``phases_fn`` on the sample CLOSEST TO THE MEDIAN, so its spans sum
+    to ~ the reported p50 rather than to some other sample's total."""
+    warmup = WARMUP if warmup is None else warmup
+    iters = ITERS if iters is None else iters
     for _ in range(warmup):
         solve()
-    samples = []
+    samples: List[float] = []
+    phase_snaps: List[Dict[str, float]] = []
     for _ in range(iters):
         t0 = time.perf_counter()
         solve()
         samples.append(time.perf_counter() - t0)
+        phase_snaps.append(dict(phases_fn()) if phases_fn is not None else {})
     q = statistics.quantiles(samples, n=4)
-    return statistics.median(samples) * 1000.0, (q[2] - q[0]) * 1000.0
+    med = statistics.median(samples)
+    i_med = min(range(len(samples)), key=lambda j: abs(samples[j] - med))
+    return med * 1000.0, (q[2] - q[0]) * 1000.0, phase_snaps[i_med]
 
 
 def _run_scheduler_config(
@@ -128,7 +172,9 @@ def _run_scheduler_config(
         )
         nodes_out[0] = len(result.new_nodes)
 
-    p50, noise = _measure(solve_once)
+    p50, noise, phases = _measure(
+        solve_once, phases_fn=lambda: ts.last_phases
+    )
     extra = (
         {"relaxed": ts.last_compile_relaxed} if expect_relaxed else {}
     )
@@ -138,7 +184,7 @@ def _run_scheduler_config(
         extra["device_ms_floor"] = device_ms_floor
     _emit(
         metric, p50, ts.last_path, ts.last_kernel, nodes_out[0],
-        noise_ms=noise, **extra,
+        noise_ms=noise, phases=phases, **extra,
     )
 
 
@@ -173,7 +219,7 @@ def build_problem():
         Resources(cpu=4, memory="8Gi"),
         Resources(cpu=8, memory="32Gi"),
     ]
-    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(10_000)]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(_n(10_000))]
     return pool, types, pods
 
 
@@ -224,7 +270,7 @@ def build_heterogeneous():
         {L.LABEL_INSTANCE_CATEGORY: "memory"},
     ]
     pods = []
-    for i in range(10_000):
+    for i in range(_n(10_000)):
         # 80 cpu sizes x 4 memory ratios = 320 request classes per signature
         cpu = 0.05 * (1 + i % 80)
         mem_gib = max(0.25, cpu * (1, 2, 4, 8)[(i // 80) % 4])
@@ -274,14 +320,14 @@ def build_affinity_topology():
         Resources(cpu=2, memory="4Gi"),
     ]
     pods: List[Pod] = []
-    for s in range(20):  # spread services: 20 x 400 = 8000
+    for s in range(20):  # spread services: 20 x 400 = 8000 (x SCALE)
         label = {"svc": f"spread-{s}"}
         constraint = TopologySpreadConstraint(
             max_skew=2,
             topology_key=L.LABEL_ZONE,
             label_selector=(("svc", f"spread-{s}"),),
         )
-        for i in range(400):
+        for i in range(_n(400)):
             pods.append(
                 Pod(
                     labels=dict(label),
@@ -289,12 +335,12 @@ def build_affinity_topology():
                     topology_spread=[constraint],
                 )
             )
-    for g in range(10):  # zone-affinity co-location groups: 10 x 90 = 900
+    for g in range(10):  # zone-affinity co-location groups: 10 x 90 (x SCALE)
         label = {"app": f"coloc-{g}"}
         term = PodAffinityTerm(
             topology_key=L.LABEL_ZONE, label_selector=(("app", f"coloc-{g}"),)
         )
-        for i in range(90):
+        for i in range(_n(90)):
             pods.append(
                 Pod(
                     labels=dict(label),
@@ -302,7 +348,7 @@ def build_affinity_topology():
                     pod_affinity=[term],
                 )
             )
-    for i in range(100):  # hostname anti-affinity singletons
+    for i in range(_n(100)):  # hostname anti-affinity singletons
         pods.append(
             Pod(
                 labels={"app": "singleton"},
@@ -316,7 +362,7 @@ def build_affinity_topology():
                 ],
             )
         )
-    for i in range(1000):  # plain filler
+    for i in range(_n(1000)):  # plain filler
         pods.append(Pod(requests=sizes[i % len(sizes)]))
     return [pool], {pool.name: types}, pods
 
@@ -336,7 +382,7 @@ def _coloc_pods(cross_class: bool, node_equiv: bool = True, prefer: bool = False
     from karpenter_tpu.api.requirements import Op
 
     pods = []
-    for g in range(100):
+    for g in range(_n(100)):
         term = PodAffinityTerm(
             topology_key=L.LABEL_HOSTNAME, label_selector=(("pair", f"host-{g}"),)
         )
@@ -375,7 +421,7 @@ def _coloc_problem(cross_class: bool, node_equiv: bool = True, prefer: bool = Fa
         Resources(cpu=1, memory="2Gi"),
         Resources(cpu=2, memory="4Gi"),
     ]
-    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(9_500)]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(_n(9_500))]
     pods += _coloc_pods(cross_class=cross_class, node_equiv=node_equiv, prefer=prefer)
     return [pool], {pool.name: types}, pods
 
@@ -400,9 +446,9 @@ def build_hybrid():
         Resources(cpu=1, memory="2Gi"),
         Resources(cpu=2, memory="4Gi"),
     ]
-    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(9_500)]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(_n(9_500))]
     existing = []
-    for g in range(100):
+    for g in range(_n(100)):
         bound = Pod(
             labels={"pair": f"host-{g}"},
             requests=Resources(cpu=1, memory="2Gi"),
@@ -483,8 +529,8 @@ def build_relax():
         Resources(cpu=1, memory="2Gi"),
         Resources(cpu=2, memory="4Gi"),
     ]
-    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(7_000)]
-    for i in range(2_000):
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(_n(7_000))]
+    for i in range(_n(2_000)):
         prefs = [Requirement(L.LABEL_ZONE, Op.IN, ["zone-nowhere"])]
         if i % 2:
             # a satisfiable higher-priority preference the peel must KEEP
@@ -494,7 +540,7 @@ def build_relax():
         pods.append(
             Pod(requests=sizes[i % len(sizes)], preferred_affinity=prefs)
         )
-    for i in range(1_000):
+    for i in range(_n(1_000)):
         pods.append(
             Pod(
                 requests=sizes[i % len(sizes)],
@@ -550,7 +596,7 @@ def build_multipool_spot():
         Resources(cpu=2, memory="4Gi"),
         Resources(cpu=4, memory="16Gi"),
     ]
-    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(10_000)]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(_n(10_000))]
     return pools, inventory, pods
 
 
@@ -579,7 +625,7 @@ def run_consolidation_repack() -> None:
         Resources(cpu=2, memory="4Gi"),
         Resources(cpu=4, memory="8Gi"),
     ]
-    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(5_000)]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(_n(5_000))]
     for p in pods:
         env.kube.put_pod(p)
     env.settle(max_rounds=60)
@@ -590,18 +636,20 @@ def run_consolidation_repack() -> None:
     candidates = dc._candidates()
     n_nodes = len(candidates)
     n_pods = sum(len(c.reschedulable) for c in candidates)
-    assert n_pods == 5_000, n_pods
+    assert n_pods == _n(5_000), n_pods
 
     def simulate_once():
         # the full-cluster repack: every node is a removal candidate, the
         # simulation packs all 5k pods onto hypothetical fresh capacity
         dc._simulate(candidates)
 
-    p50, noise = _measure(simulate_once)
     sched = dc._scheduler
+    p50, noise, phases = _measure(
+        simulate_once, phases_fn=lambda: sched.last_phases
+    )
     _emit(
         "consolidation_repack_5k_pods_p50", p50, sched.last_path,
-        sched.last_kernel, n_nodes, noise_ms=noise,
+        sched.last_kernel, n_nodes, noise_ms=noise, phases=phases,
     )
 
 
@@ -664,15 +712,31 @@ def _device_ms(
     for _ in range(7):
         t1s.append(run_n(1))
         tks.append(run_n(chain))
-    # min of each endpoint separately: tunnel latency noise is strictly
-    # additive per RUN, so min(t1) and min(tk) are each the
-    # least-contaminated observation and their difference is the cleanest
-    # marginal estimate (min of the per-pair deltas would instead favor
-    # pairs whose BASELINE was noise-inflated)
+    return _marginal_estimate(t1s, tks, chain)
+
+
+def _marginal_estimate(
+    t1s: List[float], tks: List[float], chain: int
+) -> Tuple[float, float]:
+    """(marginal per-solve ms, noise floor ms) from single-solve and
+    chained-solve timings.
+
+    Min of each endpoint separately: tunnel latency noise is strictly
+    additive per RUN, so min(t1) and min(tk) are each the
+    least-contaminated observation and their difference is the cleanest
+    marginal estimate (min of the per-pair deltas would instead favor
+    pairs whose BASELINE was noise-inflated).
+
+    Both outputs are clamped non-negative AT THIS MEASUREMENT SITE: the
+    estimate is a difference of two noisy minima and can come out
+    negative when the kernel cost is below the link jitter (r05 reported
+    device_ms -1.4 exactly this way); a negative reading means "too fast
+    to measure on this link", which the floor already communicates, and
+    no emitted line may carry one (_emit refuses)."""
     est = (min(tks) - min(t1s)) / (chain - 1) * 1000.0
     s1, sk = sorted(t1s), sorted(tks)
     floor = ((sk[1] - sk[0]) + (s1[1] - s1[0])) / (chain - 1) * 1000.0
-    return max(0.0, est), floor
+    return max(0.0, est), max(0.0, floor)
 
 
 def _forced_pack(kind: str):
@@ -689,7 +753,24 @@ def _forced_pack(kind: str):
     return pack
 
 
-def main() -> None:
+def main(tiny: bool = False) -> None:
+    """Run every config and emit one JSON line each.
+
+    ``tiny`` shrinks the workloads (SCALE=0.02 → ~200-pod batches) and
+    the sample counts so the tier-1 smoke test (tests/test_bench_smoke.py)
+    can drive the REAL emit path — same builders, same asserts, same line
+    schema — inside the test-suite time budget."""
+    global SCALE, WARMUP, ITERS
+    if tiny:
+        SCALE, WARMUP, ITERS = 0.02, 1, 3
+    try:
+        _run_all()
+    finally:
+        if tiny:
+            SCALE, WARMUP, ITERS = 1.0, 3, 21
+
+
+def _run_all() -> None:
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -778,7 +859,8 @@ def main() -> None:
     pools, inventory, pods = build_relax()
     _run_scheduler_config(
         "schedule_10k_relax_3k_soft_pods_p50",
-        pools, inventory, pods, expect_path="tensor", expect_relaxed=3_000,
+        pools, inventory, pods, expect_path="tensor",
+        expect_relaxed=_n(2_000) + _n(1_000),
     )
 
     # extra: the flagship solved THROUGH the solver sidecar (socket RPC,

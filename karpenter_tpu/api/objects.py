@@ -231,11 +231,27 @@ class Pod:
 
     def __setattr__(self, name, value):
         if name in Pod._SIG_FIELDS:
-            self.__dict__.pop("_sig", None)
-            self.__dict__.pop("_gkey", None)
+            d = self.__dict__
+            d.pop("_sig", None)
+            d.pop("_gkey", None)
+            # mutation epoch: reassigning a constraint field (or requests,
+            # below) bumps it, so identity-keyed caches above the signature
+            # memo (the solver's incremental compile cache) key on
+            # (id(pod), epoch) and a mutated pod can never serve a stale
+            # compiled entry.  In-place mutation of the dict/list VALUES
+            # remains undetectable, same as the signature memo — replace,
+            # don't mutate.
+            d["_mut"] = d.get("_mut", 0) + 1
         elif name == "requests":
-            self.__dict__.pop("_gkey", None)
+            d = self.__dict__
+            d.pop("_gkey", None)
+            d["_mut"] = d.get("_mut", 0) + 1
         object.__setattr__(self, name, value)
+
+    def mutation_epoch(self) -> int:
+        """Monotonic per-pod counter of constraint/requests reassignments
+        (see __setattr__) — the solver's compile-cache fingerprint input."""
+        return self.__dict__.get("_mut", 0)
 
     def __post_init__(self):
         if not self.name:
@@ -514,6 +530,17 @@ class NodePool:
     kubelet_system_reserved: Optional[Resources] = None
     kubelet_eviction_hard: Optional[Resources] = None
     deleted: bool = False
+
+    def __setattr__(self, name, value):
+        # mutation epoch for the solver's compile cache: identity-based
+        # keys (the catalog cache convention) can't see an in-place field
+        # poke like `pool.weight = 5`, so every reassignment bumps the
+        # epoch and the (id, epoch) pair keys stay sound.
+        self.__dict__["_mut"] = self.__dict__.get("_mut", 0) + 1
+        object.__setattr__(self, name, value)
+
+    def mutation_epoch(self) -> int:
+        return self.__dict__.get("_mut", 0)
 
     def template_requirements(self) -> Requirements:
         reqs = Requirements.from_labels(self.labels)

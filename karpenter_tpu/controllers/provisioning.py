@@ -180,6 +180,15 @@ class Provisioner:
             "karpenter_provisioner_scheduling_simulation_count",
             {"path": scheduler.last_path},
         )
+        # solve latency anatomy: one histogram series per phase (disjoint
+        # self-times summing to the solve's wall clock — see
+        # TensorScheduler.solve / docs "solve latency anatomy")
+        for phase_name, seconds in scheduler.last_phases.items():
+            self.registry.observe(
+                "karpenter_solver_phase_seconds",
+                seconds,
+                {"phase": phase_name},
+            )
         for pod_key, reason in result.unschedulable.items():
             self.kube.record_event("Pod", "FailedScheduling", pod_key, reason)
         # nominate pods placed on existing nodes (the kube-scheduler binds)

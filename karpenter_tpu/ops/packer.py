@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from karpenter_tpu.ops.tensorize import CompiledProblem
+from karpenter_tpu.utils.trace import phase
+
 
 class PackResult(NamedTuple):
     """Device outputs of one packing solve."""
@@ -455,18 +457,27 @@ def expand_take(
 
 # device-resident constant caches, keyed by source-array identity with the
 # sources pinned in the entry so the id-based key stays sound (the same
-# pattern as TensorScheduler's catalog cache)
+# pattern as TensorScheduler's catalog cache).  Eviction is LRU: python
+# dicts iterate in insertion order, so re-inserting on every hit keeps the
+# first key the least-recently-used one.  A wholesale clear() here would
+# evict every HOT device constant the moment a 33rd catalog snapshot
+# appears, forcing re-uploads mid-tick on the high-latency device link.
+_DEVICE_CACHE_CAP = 32
+
+
 def cached_device_put(cache: dict, srcs: tuple, extra_key: tuple, build, shardings=None):
     import jax as _jax
 
     key = tuple(id(s) for s in srcs) + extra_key
     ent = cache.get(key)
     if ent is not None and all(a is b for a, b in zip(ent[0], srcs)):
+        del cache[key]  # re-insert: mark most-recently-used
+        cache[key] = ent
         return ent[1]
     built = build()
     dev = _jax.device_put(built, shardings) if shardings else _jax.device_put(built)
-    if len(cache) > 32:
-        cache.clear()
+    while len(cache) >= _DEVICE_CACHE_CAP:
+        cache.pop(next(iter(cache)))  # evict ONLY the least-recently-used
     cache[key] = (srcs, dev)
     return dev
 
@@ -499,16 +510,17 @@ def run_pack(
     catalog snapshot and reused from the device cache, and the outputs
     come back pre-bundled so the solver's fetch is a single read.
     """
-    args, Kp = pad_problem(prob, k_slots)
-    (req, _cnt, _maxper, _slot, _feas, alloc_h, price_h, openable_h,
-     _used0, _cfg0, _npods0, _e0, sig0) = args
-    alloc, price, openable = _device_constants(
-        prob, alloc_h, price_h, openable_h
-    )
-    Gp, R = req.shape
-    Cp = alloc_h.shape[0]
-    Sp = sig0.shape[0]
-    buf = build_input_buffer(args)
+    with phase("pad"):
+        args, Kp = pad_problem(prob, k_slots)
+        (req, _cnt, _maxper, _slot, _feas, alloc_h, price_h, openable_h,
+         _used0, _cfg0, _npods0, _e0, sig0) = args
+        alloc, price, openable = _device_constants(
+            prob, alloc_h, price_h, openable_h
+        )
+        Gp, R = req.shape
+        Cp = alloc_h.shape[0]
+        Sp = sig0.shape[0]
+        buf = build_input_buffer(args)
     bundle, res = pack_kernel_buffered(
         buf, alloc, price, openable,
         Gp=Gp, Cp=Cp, Kp=Kp, R=R, Sp=Sp, objective=objective,

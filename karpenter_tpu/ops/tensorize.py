@@ -524,6 +524,7 @@ def _coloc_component_mergeable(
     sig_rep: Sequence[Pod],
     reasons: Sequence[str],
     live_labels: Sequence[dict],
+    live_match=None,
 ) -> bool:
     """Whether a hostname-affinity coupled component compiles as ONE macro
     placement unit: every sig carries only hostname-affinity terms, every
@@ -562,14 +563,16 @@ def _coloc_component_mergeable(
             cohesion_part = part
         elif part != cohesion_part:
             return False
+    if live_match is None:
+        live_match = lambda t: any(  # noqa: E731
+            selector_matches(lbl, t.label_selector, t.match_expressions)
+            for lbl in live_labels
+        )
     for s in comp:
         for t in sig_rep[s].pod_affinity:
             if not any(t.selects(sig_rep[j]) for j in comp):
                 return False
-            if live_labels and any(
-                selector_matches(lbl, t.label_selector, t.match_expressions)
-                for lbl in live_labels
-            ):
+            if live_labels and live_match(t):
                 return False
     return True
 
@@ -637,8 +640,41 @@ def partition_groups(
             if reasons[i].startswith("topology spread on key") and \
                     _custom_spread_curable(r, alive_pools):
                 reasons[i] = ""
-    # built ONCE for the live-member checks below
+    # built ONCE for the live-member checks below, with an inverted label
+    # index so each selector scan is a set intersection over candidate
+    # bound pods instead of an O(live pods) python loop — at 10k-pod /
+    # hundreds-of-live-nodes batches the naive scan was a top-3 host cost
     live_labels = [dict(bp.labels) for sn in existing for bp in sn.pods]
+    live_pair_index: Dict[Tuple[str, str], set] = {}
+    for li, lbl in enumerate(live_labels):
+        for kv in lbl.items():
+            live_pair_index.setdefault(kv, set()).add(li)
+    _live_match_memo: Dict[int, bool] = {}
+
+    def live_matches(sel) -> bool:
+        """Whether any live bound pod's labels satisfy `sel`."""
+        got = _live_match_memo.get(id(sel))
+        if got is not None:
+            return got
+        cand = None
+        for kv in sel.label_selector:
+            hit = live_pair_index.get(kv)
+            if not hit:
+                cand = ()
+                break
+            cand = set(hit) if cand is None else (cand & hit)
+            if not cand:
+                break
+        if cand is None:  # no equality pairs to narrow on: scan everything
+            cand = range(len(live_labels))
+        got = any(
+            selector_matches(
+                live_labels[li], sel.label_selector, sel.match_expressions
+            )
+            for li in cand
+        )
+        _live_match_memo[id(sel)] = got
+        return got
     # symmetric anti-affinity from LIVE carriers: a bound pod's anti term
     # repels incoming matching pods from its node — only the oracle's
     # per-node ban sets express that, so any selected class goes oracle
@@ -748,9 +784,7 @@ def partition_groups(
                         reasons[i] = reasons[i] or why
                         reasons[j] = reasons[j] or why
             if live_labels and any(
-                selector_matches(lbl, t.label_selector, t.match_expressions)
-                for t in host_aff_terms
-                for lbl in live_labels
+                live_matches(t) for t in host_aff_terms
             ):
                 reasons[i] = reasons[i] or (
                     "hostname co-location with members on live nodes"
@@ -854,7 +888,9 @@ def partition_groups(
             for t in sig_rep[s].pod_affinity
         ):
             continue
-        if _coloc_component_mergeable(comp, sig_rep, reasons, live_labels):
+        if _coloc_component_mergeable(
+            comp, sig_rep, reasons, live_labels, live_match=live_matches
+        ):
             for s in comp:
                 if reasons[s] in _HOST_CURABLE:
                     reasons[s] = ""
@@ -1438,29 +1474,44 @@ def compile_problem(
         row = row_memo.get(mkey)
         if row is not None:
             return row
-        sched = rep.scheduling_requirements(
-            preferred=True, term=term, keep_prefs=keep
-        )
-        if zone_pin:
-            sched = Requirements(iter(sched))
-            sched.add(Requirement(L.LABEL_ZONE, Op.IN, [zone_pin]))
-        row = np.zeros(C, dtype=bool)
-        for pname, pr in catalog.pool_rows.items():
-            if pool_allow is not None and pname not in pool_allow:
-                continue  # only the domain's pools DEFINE the spread key
-            ent = _pool_feas(
-                catalog, rep, sig, pname, pools_by_name, term, keep
-            )
-            if ent is None:
-                continue
-            type_ok, zone_ok, ct_ok = ent
-            if zone_pin:
-                zone_ok = zone_ok & np.fromiter(
-                    (z == zone_pin for z in pr.zones), bool, len(pr.zones)
+        # the OPENABLE prefix of the row depends only on the signature
+        # shape and this catalog snapshot — never on the live nodes — so
+        # it memoizes for the CATALOG's lifetime ("catalog epoch": a new
+        # inventory snapshot builds a new Catalog with a fresh memo).  A
+        # warm re-compile of a recurring pending set assembles its rows
+        # from these cached prefixes and only re-checks the live columns.
+        ckey = ("row",) + mkey
+        open_row = catalog.feas_memo.get(ckey)
+        if open_row is None:
+            open_row = np.zeros(first_existing, dtype=bool)
+            for pname, pr in catalog.pool_rows.items():
+                if pool_allow is not None and pname not in pool_allow:
+                    continue  # only the domain's pools DEFINE the spread key
+                ent = _pool_feas(
+                    catalog, rep, sig, pname, pools_by_name, term, keep
                 )
-            row[pr.rows] = type_ok[pr.t_of] & zone_ok[pr.z_of] & ct_ok[pr.ct_of]
-        for e, sn in enumerate(live):
-            row[first_existing + e] = _fits_existing(rep, sched, sn)
+                if ent is None:
+                    continue
+                type_ok, zone_ok, ct_ok = ent
+                if zone_pin:
+                    zone_ok = zone_ok & np.fromiter(
+                        (z == zone_pin for z in pr.zones), bool, len(pr.zones)
+                    )
+                open_row[pr.rows] = (
+                    type_ok[pr.t_of] & zone_ok[pr.z_of] & ct_ok[pr.ct_of]
+                )
+            _memo_put(catalog, ckey, open_row)
+        row = np.zeros(C, dtype=bool)
+        row[:first_existing] = open_row
+        if live:
+            sched = rep.scheduling_requirements(
+                preferred=True, term=term, keep_prefs=keep
+            )
+            if zone_pin:
+                sched = Requirements(iter(sched))
+                sched.add(Requirement(L.LABEL_ZONE, Op.IN, [zone_pin]))
+            for e, sn in enumerate(live):
+                row[first_existing + e] = _fits_existing(rep, sched, sn)
         row_memo[mkey] = row
         return row
 

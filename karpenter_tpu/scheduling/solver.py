@@ -38,7 +38,7 @@ from karpenter_tpu.scheduling.scheduler import (
     VirtualNode,
 )
 from karpenter_tpu.state.cluster import StateNode
-from karpenter_tpu.utils.trace import TRACER, device_trace
+from karpenter_tpu.utils.trace import TRACER, device_trace, phase, phase_collect
 
 
 def default_pack_fn():
@@ -119,6 +119,28 @@ class TensorScheduler:
         # entries can accumulate.
         self._scan_memo: dict = {}
         self._input_key: tuple = ()
+        # incremental problem-compilation cache: a reconcile tick that
+        # re-solves a pending set it has seen before (same pod objects,
+        # same catalog snapshot, same live-node state) reuses the prior
+        # partition + CompiledProblem + live-join reservations instead of
+        # re-running the whole host-side compile.  The fingerprint keys on
+        # object identities PLUS mutation epochs (Pod/NodePool __setattr__
+        # bumps an epoch on every field reassignment), and every entry
+        # PINS the objects its ids reference, so id reuse cannot alias.
+        # Invalidation: catalog roll / pool change / daemonset change
+        # (identity+epoch in the key, and update() clears wholesale),
+        # live-node mutation (used/pods identity in the key), in-place pod
+        # mutation (the __setattr__ epoch).
+        self._compile_cache: dict = {}
+        self._last_fp = None
+        self.compile_cache_hits = 0
+        self.compile_cache_misses = 0
+        # per-solve observability: wall-time breakdown by phase (seconds,
+        # disjoint, summing to the solve's wall time) and which
+        # continuation handled the oracle half ("join" = overlapped
+        # live-member fast path, "oracle" = sequential continuation)
+        self.last_phases: Dict[str, float] = {}
+        self.last_continuation = ""
 
     def update(
         self,
@@ -147,6 +169,8 @@ class TensorScheduler:
             # superseded type graphs until the size backstop
             self._input_key = key
             self._scan_memo.clear()
+            # rolled inputs also obsolete every cached compilation
+            self._compile_cache.clear()
         self.pools = list(pools)
         self.instance_types = instance_types
         self.existing = list(existing)
@@ -160,30 +184,102 @@ class TensorScheduler:
         """Solve a batch: tensor path for everything the kernel expresses,
         oracle CONTINUATION for the remainder (hybrid).  One pod with an
         exotic constraint no longer sends the whole 10k-pod batch to the
-        O(pods x nodes) Python loop — only its coupled closure goes."""
-        pods = list(pods)
+        O(pods x nodes) Python loop — only its coupled closure goes.
+
+        Every solve records a wall-time phase breakdown in
+        ``last_phases`` (partition / compile / pad / dispatch /
+        device_block / oracle / decode / other — disjoint self-times that
+        sum to the solve's wall clock), the provisioning controller's
+        source for `karpenter_solver_phase_seconds` and the bench
+        harness's per-line ``phases`` dict."""
+        self.last_phases = phases = {}
+        with phase_collect(phases), phase("other"):
+            return self._solve(list(pods))
+
+    def _solve(self, pods: List[Pod]) -> SchedulingResult:
         self.last_compile_relaxed = 0  # per-solve; oracle paths leave it 0
-        with TRACER.span("solver.partition"):
-            sup_groups, unsupported, _reason = partition_groups(
-                pods, existing=self.existing, pools=self.pools
+        self.last_continuation = ""
+        cached = self._cache_lookup(pods)
+        if cached is None:
+            self.compile_cache_misses += 1
+            with phase("partition"), TRACER.span("solver.partition"):
+                sup_groups, unsupported, _reason = partition_groups(
+                    pods, existing=self.existing, pools=self.pools
+                )
+            if sup_groups:
+                # live-member co-location closures must JOIN specific live
+                # nodes; the tensor half would otherwise fill those nodes
+                # with plain pods first (existing capacity is free) and
+                # strand the groups — compile against SHADOW nodes with
+                # the groups' totals reserved.  The per-pod anchor
+                # assignments double as the overlapped join plan's input.
+                with phase("partition"):
+                    shadow, join_assign = self._reserve_live_capacity(
+                        unsupported
+                    )
+                prob = self._compile_tensor(
+                    [p for _, members in sup_groups for p in members],
+                    sup_groups,
+                    existing=shadow,
+                )
+            else:
+                prob, join_assign = None, ()
+            compact_ok = self._compact_guard(pods)
+            self._cache_store(
+                pods, sup_groups, unsupported, prob, join_assign, compact_ok
             )
-        if not sup_groups:
-            with TRACER.span("solver.oracle", pods=len(pods)):
+        else:
+            self.compile_cache_hits += 1
+            sup_groups, unsupported, prob, join_assign, compact_ok = cached
+        if prob is None or not prob.supported:
+            # nothing compiled (all-oracle batch or a compile bail):
+            # solve everything through the oracle
+            with phase("oracle"), TRACER.span("solver.oracle", pods=len(pods)):
                 return self._oracle(pods)
-        supported = [p for _, members in sup_groups for p in members]
-        # live-member co-location closures must JOIN specific live nodes;
-        # the tensor half would otherwise fill those nodes with plain
-        # pods first (existing capacity is free) and strand the groups —
-        # compile against SHADOW nodes with the groups' totals reserved
-        shadow = self._reserve_live_capacity(unsupported)
-        result = self._solve_tensor(supported, sup_groups, existing=shadow)
-        if result is None:  # tensor compile bailed; solve everything oracle
-            with TRACER.span("solver.oracle", pods=len(pods)):
-                return self._oracle(pods)
+        self.last_path = "tensor"
+        self.last_compile_relaxed = prob.compile_relaxed
+
+        # oracle/device overlap: the pack dispatch below only ENQUEUES
+        # device work (JAX async dispatch), so the host plans the
+        # oracle-only pods' live-node joins WHILE the device packs —
+        # `overlap` runs between dispatch and the blocking fetch.
+        join_plan = None
+
+        def overlap() -> None:
+            nonlocal join_plan
+            with phase("oracle"), TRACER.span(
+                "solver.join_plan", pods=len(unsupported)
+            ):
+                join_plan = self._plan_live_join(unsupported, join_assign)
+
+        result = self._pack_decode(
+            prob, overlap=overlap if unsupported else None
+        )
         if unsupported:
             self.last_path = "hybrid"
-            with TRACER.span("solver.oracle_continue", pods=len(unsupported)):
-                result = self._oracle_continue(unsupported, supported, result)
+            if join_plan is not None:
+                # every oracle-only pod joins its reserved anchor; the
+                # plan was validated against capacity the tensor half
+                # could not touch (the shadow reservation), so applying
+                # it cannot conflict with the decoded placements
+                self.last_continuation = "join"
+                for members, sn in join_plan:
+                    name = sn.name
+                    for p in members:
+                        result.existing_placements[p.key()] = name
+            else:
+                self.last_continuation = "oracle"
+                with phase("oracle"), TRACER.span(
+                    "solver.oracle_continue", pods=len(unsupported)
+                ):
+                    # built lazily: the sequential continuation is the
+                    # only consumer of the flattened supported list
+                    supported = [
+                        p for _, members in sup_groups for p in members
+                    ]
+                    result = self._oracle_continue(
+                        unsupported, supported, result
+                    )
         # preference/OR-term relaxation: the tensor path compiles preferred
         # node affinity as REQUIRED and only a pod's FIRST nodeSelectorTerm
         # (objects.py scheduling_requirements), so a pod whose preferences
@@ -192,10 +288,13 @@ class TensorScheduler:
         # the open nodes, then drops them / walks the later terms), seeded
         # with full topology records because relaxed pods may share spread
         # groups with their tensor-placed siblings
+        # guard on unschedulable FIRST: it is empty on virtually every
+        # solve, and the constraint scan below walks all 10k pods
         relax = [
             p
             for p in pods
-            if (
+            if p.key() in result.unschedulable
+            and (
                 p.preferred_affinity
                 or len(p.node_affinity_terms()) > 1
                 or any(
@@ -203,8 +302,7 @@ class TensorScheduler:
                     for c in p.topology_spread
                 )
             )
-            and p.key() in result.unschedulable
-        ]
+        ] if result.unschedulable else []
         if relax:
             relax_keys = {p.key() for p in relax}
             # a relax-eligible CO-LOCATION member brings its whole
@@ -255,18 +353,27 @@ class TensorScheduler:
                 del result.unschedulable[k]
             others = [p for p in pods if p.key() not in relax_keys]
             self.last_path = "hybrid"
-            with TRACER.span("solver.relax", pods=len(relax)):
+            with phase("oracle"), TRACER.span("solver.relax", pods=len(relax)):
                 result = self._oracle_continue(
                     relax, others, result, seed_topology=True
                 )
-        # a selector that matches UNLABELED pods (empty matchLabels, or
-        # only negative expressions) leaves no pod safely untracked —
-        # with one in the batch, skip compaction.  LIVE bound pods'
-        # symmetric anti-affinity counts too: a label-less batch pod
-        # matched by a live carrier's zone-keyed anti term is zone-pinned
-        # by the main solve, and the compaction scratch tracker (seeded
-        # only with new-node pods) would not see the ban.
-        if not any(
+        if compact_ok:
+            with TRACER.span("solver.compact"):
+                self._compact_small_nodes(result)
+        return result
+
+    def _compact_guard(self, pods: List[Pod]) -> bool:
+        """Whether decode compaction is safe for this batch: a selector
+        that matches UNLABELED pods (empty matchLabels, or only negative
+        expressions) leaves no pod safely untracked — with one in the
+        batch, skip compaction.  LIVE bound pods' symmetric anti-affinity
+        counts too: a label-less batch pod matched by a live carrier's
+        zone-keyed anti term is zone-pinned by the main solve, and the
+        compaction scratch tracker (seeded only with new-node pods) would
+        not see the ban.  Depends only on the batch and the live nodes,
+        both fingerprinted — so it rides the compile cache instead of
+        re-scanning 10k pods per solve."""
+        return not any(
             selector_matches({}, c.label_selector, c.match_expressions)
             for p in pods
             for c in (*p.topology_spread, *p.pod_affinity)
@@ -276,10 +383,7 @@ class TensorScheduler:
             for bp in sn.pods
             for t in bp.pod_affinity
             if t.anti
-        ):
-            with TRACER.span("solver.compact"):
-                self._compact_small_nodes(result)
-        return result
+        )
 
     def _compact_small_nodes(self, result: SchedulingResult) -> None:
         """Decode post-pass: re-home topology-free pods off nearly-empty
@@ -369,18 +473,31 @@ class TensorScheduler:
         totals charged against their anchor nodes, so the tensor compile
         sees the capacity the continuation will consume.  Only affects
         the compiled rows — the continuation runs against the REAL nodes
-        and fills the reserved space."""
+        and fills the reserved space.
+
+        Returns ``(shadow_existing, assignments)`` where assignments is a
+        tuple of (pod, anchor StateNode) pairs — the join-continuation
+        plan input (_plan_live_join).  Anchors are memoized PER CLASS:
+        pods of one class carry identical hostname-affinity terms, so the
+        anchor scan (the former per-pod O(pods x nodes x bound-pods) hot
+        loop) runs once per class."""
         if not unsupported or not self.existing:
-            return self.existing
-        reserve: Dict[str, Resources] = {}
+            return self.existing, ()
+        by_class: Dict[object, List[Pod]] = {}
         for p in unsupported:
+            by_class.setdefault(p.class_key(), []).append(p)
+        reserve: Dict[str, Resources] = {}
+        assignments: List[Tuple[List[Pod], StateNode]] = []
+        for members in by_class.values():
+            rep = members[0]
             terms = [
                 t
-                for t in p.pod_affinity
+                for t in rep.pod_affinity
                 if not t.anti and t.topology_key == L.LABEL_HOSTNAME
             ]
             if not terms:
                 continue
+            anchor = None
             for sn in self.existing:
                 # the join predicate: EVERY term must find a matching
                 # bound pod on the node (an any-term reserve could land
@@ -388,12 +505,19 @@ class TensorScheduler:
                 if all(
                     any(t.selects(bp) for bp in sn.pods) for t in terms
                 ):
-                    reserve[sn.name] = (
-                        reserve.get(sn.name, Resources()) + p.requests
-                    )
+                    anchor = sn
                     break
+            if anchor is None:
+                continue
+            # members of one class share the representative's requests
+            # (class identity = signature x requests), so the class's
+            # reserve is one scaled add, not a per-pod loop
+            reserve[anchor.name] = reserve.get(
+                anchor.name, Resources()
+            ) + rep.requests.scaled(len(members))
+            assignments.append((members, anchor))
         if not reserve:
-            return self.existing
+            return self.existing, ()
         import copy
 
         out = []
@@ -405,13 +529,24 @@ class TensorScheduler:
                 shadow = copy.copy(sn)
                 shadow.used = sn.used + r
                 out.append(shadow)
-        return out
+        return out, tuple(assignments)
 
     def _solve_tensor(
         self, pods: List[Pod], groups, existing=None
     ) -> Optional[SchedulingResult]:
-        import jax
+        """Compile + pack + decode, no continuation — kept for direct
+        callers/tests; `solve` drives the split halves itself so it can
+        cache the compile and overlap host work with the device pack."""
+        prob = self._compile_tensor(pods, groups, existing=existing)
+        if not prob.supported:
+            return None
+        self.last_path = "tensor"
+        self.last_compile_relaxed = prob.compile_relaxed
+        return self._pack_decode(prob)
 
+    def _compile_tensor(
+        self, pods: List[Pod], groups, existing=None
+    ) -> CompiledProblem:
         from karpenter_tpu.ops.tensorize import _axes_for_requests
 
         axes = _axes_for_requests([key[1] for key, _ in groups])
@@ -437,8 +572,8 @@ class TensorScheduler:
                 tuple(self.daemonsets),
             )
         catalog = self._catalog
-        with TRACER.span("solver.compile", pods=len(pods)):
-            prob = compile_problem(
+        with phase("compile"), TRACER.span("solver.compile", pods=len(pods)):
+            return compile_problem(
                 pods,
                 self.pools,
                 self.instance_types,
@@ -448,13 +583,13 @@ class TensorScheduler:
                 presplit=True,
                 groups=groups,
             )
-        if not prob.supported:
-            return None
-        self.last_path = "tensor"
-        # compile-time relaxation observability (bench relax line): pods
-        # whose class had its preferences peeled / OR-terms walked on the
-        # compiled rows rather than in the oracle continuation
-        self.last_compile_relaxed = prob.compile_relaxed
+
+    def _pack_decode(self, prob: CompiledProblem, overlap=None):
+        """Dispatch the device pack, run `overlap` host work while the
+        device executes (JAX dispatch is asynchronous — only the fetch
+        blocks), then fetch, retry on slot overflow, and decode."""
+        import jax
+
         if self.pack_fn is None:
             self.pack_fn = default_pack_fn()
         # the XLA timeline must stay open through fetch: pack_fn only
@@ -463,7 +598,7 @@ class TensorScheduler:
         # dispatch overhead and miss the kernel
         xla_trace = device_trace(TRACER)
         xla_trace.__enter__()
-        with TRACER.span("solver.pack"):
+        with phase("dispatch"), TRACER.span("solver.pack"):
             result = self.pack_fn(prob, objective=self.objective)
         from karpenter_tpu.ops import pallas_packer
         from karpenter_tpu.ops.packer import fetch_bundled
@@ -473,6 +608,8 @@ class TensorScheduler:
             if self.pack_fn is auto_pack
             else getattr(self.pack_fn, "kernel_name", "custom")
         )
+        if overlap is not None:
+            overlap()
 
         def fetch(res):
             # ONE transfer — literally one device array — for everything
@@ -487,7 +624,7 @@ class TensorScheduler:
             )
 
         try:
-            with TRACER.span("solver.fetch"):
+            with phase("device_block"), TRACER.span("solver.fetch"):
                 take, leftover, node_cfg, node_used = fetch(result)
             # grow the slot bucket if the solve ran out of node slots
             # while feasible configs remained
@@ -495,16 +632,173 @@ class TensorScheduler:
             max_k = len(prob.used0) + prob.total_pods()
             while self._overflowed(prob, leftover) and k < max_k:
                 k *= 2
-                with TRACER.span("solver.pack", retry_k=k):
+                with phase("dispatch"), TRACER.span("solver.pack", retry_k=k):
                     result = self.pack_fn(
                         prob, k_slots=k, objective=self.objective
                     )
-                with TRACER.span("solver.fetch", retry_k=k):
+                with phase("device_block"), TRACER.span(
+                    "solver.fetch", retry_k=k
+                ):
                     take, leftover, node_cfg, node_used = fetch(result)
         finally:
             xla_trace.__exit__(None, None, None)
-        with TRACER.span("solver.decode"):
+        with phase("decode"), TRACER.span("solver.decode"):
             return self._decode(prob, take, node_cfg, node_used)
+
+    # ------------------------------------------------- compile cache + join
+    _COMPILE_CACHE_CAP = 8
+
+    def _solve_fingerprint(self, pods: List[Pod]) -> Optional[tuple]:
+        """Fingerprint of everything the compile reads.
+
+        Batch/catalog inputs key by object identity + mutation epoch
+        (providers return NEW list objects on change; Pod/NodePool
+        __setattr__ epochs catch in-place field reassignment identity
+        alone cannot see).  Live nodes key by CONTENT — name, used /
+        allocatable / labels / taints values, schedulability flags,
+        bound-pod identities — because `Cluster.snapshot()` builds fresh
+        StateNode wrappers every reconcile tick: wrapper identity would
+        make the cache miss on every tick of a running controller, while
+        content identity lets an unchanged cluster re-serve the prior
+        compilation (the cached problem's decode refers to live nodes by
+        NAME, so content-equal wrappers are interchangeable).  Taints and
+        labels are part of the content precisely because controllers
+        cordon/taint/label nodes in place."""
+        try:
+            # direct __dict__ access: this loop runs over the whole 10k-pod
+            # batch per solve, and it must stay a fraction of the compile
+            # cost it short-circuits ("_mut" exists from field init — see
+            # Pod.__setattr__; KeyError falls through to the except)
+            pods_fp = tuple((id(p), p.__dict__["_mut"]) for p in pods)
+            pools_fp = tuple(
+                (id(p), p.__dict__.get("_mut", 0)) for p in self.pools
+            )
+            types_fp = tuple(
+                sorted((k, id(v)) for k, v in self.instance_types.items())
+            )
+            ds_fp = tuple(
+                (id(d), d.__dict__.get("_mut", 0)) for d in self.daemonsets
+            )
+            ex_fp = tuple(
+                (
+                    sn.name,
+                    tuple(sorted(sn.used.items())),
+                    tuple(sorted(sn.allocatable.items())),
+                    tuple(sorted(sn.labels.items())),
+                    tuple(map(repr, sn.taints)),
+                    sn.marked_for_deletion(),
+                    sn.node is not None and sn.node.cordoned,
+                    tuple(
+                        (id(bp), bp.__dict__.get("_mut", 0))
+                        for bp in sn.pods
+                    ),
+                )
+                for sn in self.existing
+            )
+        except Exception:  # exotic duck-typed inputs: skip caching
+            return None
+        return (pools_fp, types_fp, ds_fp, pods_fp, ex_fp)
+
+    def _cache_lookup(self, pods: List[Pod]):
+        fp = self._solve_fingerprint(pods)
+        self._last_fp = fp
+        if fp is None:
+            return None
+        ent = self._compile_cache.get(fp)
+        if ent is None:
+            return None
+        return ent[0]
+
+    def _cache_store(
+        self, pods, sup_groups, unsupported, prob, join_assign, compact_ok
+    ):
+        fp = self._last_fp
+        if fp is None:
+            return
+        # pins: every object an id in the fingerprint refers to (batch
+        # pods, pools, type lists, daemonsets, live nodes' BOUND pods —
+        # live nodes themselves key by content, not id) must stay
+        # allocated for the entry's lifetime, or a recycled id could alias
+        pins = (
+            list(pods),
+            [list(sn.pods) for sn in self.existing],
+            tuple(self.pools),
+            tuple(self.instance_types.values()),
+            tuple(self.daemonsets),
+        )
+        if len(self._compile_cache) >= self._COMPILE_CACHE_CAP:
+            self._compile_cache.pop(next(iter(self._compile_cache)))
+        self._compile_cache[fp] = (
+            (sup_groups, unsupported, prob, join_assign, compact_ok),
+            pins,
+        )
+
+    def _plan_live_join(self, unsupported: List[Pod], assignments):
+        """Validated placement plan for the oracle-only half when EVERY
+        pod is a live-member co-location joiner: each pod lands on the
+        anchor node `_reserve_live_capacity` charged its requests to.
+
+        Sound by construction: the tensor compile saw those anchors with
+        the groups' totals already added to `used`, so the device pack
+        can only consume capacity OUTSIDE the reservation, and the join
+        consumes capacity INSIDE it — the two halves cannot collide.
+        Returns None (fall back to the sequential oracle continuation)
+        whenever any pod is unanchored, carries constraint shapes beyond
+        plain hostname-affinity joining, is repelled by a live anti
+        carrier, fails the anchor's taint/label admission, or the anchor
+        lacks real capacity for its groups' totals — the oracle is the
+        semantics definition, the join is only its fast path."""
+        if not assignments or sum(
+            len(members) for members, _ in assignments
+        ) != len(unsupported):
+            return None
+        live_anti = [
+            t
+            for sn in self.existing
+            for bp in sn.pods
+            for t in bp.pod_affinity
+            if t.anti
+        ]
+        totals: Dict[str, Resources] = {}
+        node_of: Dict[str, StateNode] = {}
+        for members, sn in assignments:
+            rep = members[0]
+            if not self._join_class_eligible(rep, sn, live_anti):
+                return None
+            totals[sn.name] = totals.get(
+                sn.name, Resources()
+            ) + rep.requests.scaled(len(members))
+            node_of[sn.name] = sn
+        for name, tot in totals.items():
+            sn = node_of[name]
+            if not (sn.used + tot).fits(sn.allocatable):
+                return None
+        return assignments
+
+    def _join_class_eligible(
+        self, rep: Pod, sn: StateNode, live_anti
+    ) -> bool:
+        from karpenter_tpu.ops.tensorize import _fits_existing
+
+        if (
+            rep.topology_spread
+            or rep.preferred_affinity
+            or len(rep.node_affinity_terms()) > 1
+            or any(
+                t.anti or t.topology_key != L.LABEL_HOSTNAME
+                for t in rep.pod_affinity
+            )
+        ):
+            return False
+        if any(t.selects(rep) for t in live_anti):
+            return False
+        if sn.marked_for_deletion() or (
+            sn.node is not None and sn.node.cordoned
+        ):
+            return False
+        return _fits_existing(
+            rep, rep.scheduling_requirements(preferred=True), sn
+        )
 
     def _oracle(self, pods: List[Pod]) -> SchedulingResult:
         self.last_path = "oracle"

@@ -151,6 +151,61 @@ class Tracer:
 TRACER = Tracer()
 
 
+# ---------------------------------------------------------------------------
+# Per-solve phase accounting (the solver's latency-anatomy layer)
+# ---------------------------------------------------------------------------
+#
+# The span tracer above aggregates across a process lifetime; the solve
+# path additionally needs a PER-CALL breakdown (partition / compile / pad /
+# dispatch / device_block / oracle / decode) that sums to the call's wall
+# time, exportable as `karpenter_solver_phase_seconds` and on bench lines.
+# Phases record SELF time: a phase nested inside another subtracts itself
+# from its parent, so the buckets are disjoint and their sum equals the
+# wall time of the outermost phase — the property that lets a bench line's
+# `phases` dict be checked against its reported p50.
+#
+# The collector is thread-local and opt-in: with no sink installed,
+# `phase()` costs one attribute read (the same contract as Tracer.span).
+
+_PHASE_LOCAL = threading.local()
+
+
+@contextlib.contextmanager
+def phase_collect(sink: Dict[str, float]) -> Iterator[Dict[str, float]]:
+    """Install `sink` as this thread's phase accumulator for the block."""
+    prev_sink = getattr(_PHASE_LOCAL, "sink", None)
+    prev_stack = getattr(_PHASE_LOCAL, "stack", None)
+    _PHASE_LOCAL.sink = sink
+    _PHASE_LOCAL.stack = []
+    try:
+        yield sink
+    finally:
+        _PHASE_LOCAL.sink = prev_sink
+        _PHASE_LOCAL.stack = prev_stack
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Accumulate the block's SELF time (exclusive of nested phases) into
+    the installed sink under `name`.  No-op without a sink."""
+    sink = getattr(_PHASE_LOCAL, "sink", None)
+    if sink is None:
+        yield
+        return
+    stack = _PHASE_LOCAL.stack
+    child_time = [0.0]
+    stack.append(child_time)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        stack.pop()
+        if stack:
+            stack[-1][0] += dt
+        sink[name] = sink.get(name, 0.0) + dt - child_time[0]
+
+
 @contextlib.contextmanager
 def device_trace(
     tracer: Tracer, log_dir: Optional[str] = None

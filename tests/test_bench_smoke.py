@@ -1,0 +1,91 @@
+"""Bench-harness contract tests (tier-1).
+
+The smoke test drives bench.py's REAL emit path — every config builder,
+every per-line assert, the same JSON schema — at tiny scale, so schema
+regressions (a line missing its `phases`, a negative `device_ms`, the
+flagship not printing last) fail in CI instead of in the next round's
+BENCH artifact.
+"""
+
+import io
+import json
+import contextlib
+
+import pytest
+
+import bench
+
+
+REQUIRED_FIELDS = {"metric", "value", "unit", "vs_baseline", "path", "kernel", "nodes"}
+PHASE_NAMES = {
+    "partition", "compile", "pad", "dispatch", "device_block",
+    "oracle", "decode", "other", "harness",
+}
+
+
+@pytest.fixture(scope="module")
+def bench_lines():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main(tiny=True)
+    return [json.loads(line) for line in buf.getvalue().strip().splitlines()]
+
+
+class TestBenchSmoke:
+    def test_every_line_well_formed(self, bench_lines):
+        assert bench_lines
+        for line in bench_lines:
+            assert REQUIRED_FIELDS <= set(line), line
+            assert line["unit"] == "ms"
+            assert line["value"] > 0
+            assert line["vs_baseline"] > 0
+
+    def test_every_line_carries_phases(self, bench_lines):
+        for line in bench_lines:
+            phases = line.get("phases")
+            assert isinstance(phases, dict) and phases, line["metric"]
+            assert set(phases) <= PHASE_NAMES, (line["metric"], phases)
+            assert all(v >= 0.0 for v in phases.values()), line
+            # disjoint self-time spans + the harness residual sum to ~ the
+            # reported p50 (rounding of each span is the only slack)
+            total = sum(phases.values())
+            assert total == pytest.approx(
+                line["value"], abs=0.01 * len(phases) + 0.05
+            ), (line["metric"], total, line["value"])
+
+    def test_device_ms_nonnegative(self, bench_lines):
+        for line in bench_lines:
+            if "device_ms" in line:
+                assert line["device_ms"] >= 0.0, line
+            if "device_ms_floor" in line:
+                assert line["device_ms_floor"] >= 0.0, line
+
+    def test_flagship_prints_last(self, bench_lines):
+        assert bench_lines[-1]["metric"] == "schedule_10k_pods_500_types_p50"
+
+    def test_scale_restored_after_tiny_run(self, bench_lines):
+        assert bench.SCALE == 1.0 and bench.ITERS == 21
+
+
+class TestMarginalEstimate:
+    def test_clamps_negative_estimate_at_measurement_site(self):
+        # chained runs FASTER than chain x single (noise-inflated
+        # baseline): the raw difference is negative, the site clamps —
+        # this is the r05 `device_ms: -1.4` regression pinned
+        t1s = [0.110, 0.105, 0.108]
+        tks = [0.100, 0.102, 0.101]
+        est, floor = bench._marginal_estimate(t1s, tks, chain=6)
+        assert est == 0.0
+        assert floor >= 0.0
+
+    def test_positive_estimate_passes_through(self):
+        t1s = [0.100, 0.101, 0.102]
+        tks = [0.150, 0.152, 0.151]
+        est, floor = bench._marginal_estimate(t1s, tks, chain=6)
+        assert est == pytest.approx((0.150 - 0.100) / 5 * 1000.0)
+        assert floor >= 0.0
+
+    def test_emit_refuses_negative_device_ms(self, capsys):
+        with pytest.raises(ValueError, match="negative device_ms"):
+            bench._emit("m", 10.0, "tensor", "scan", 1, device_ms=-1.4)
+        assert capsys.readouterr().out == ""

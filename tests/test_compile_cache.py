@@ -1,0 +1,386 @@
+"""Host-path performance layer: the solver's incremental compile cache,
+the overlapped live-join continuation, the per-phase latency breakdown,
+and the device-constant LRU.
+
+The compile cache memoizes (partition groups, CompiledProblem, live-join
+reservations) per (catalog snapshot, pending-set, live-node-state)
+fingerprint.  The invalidation contract under test:
+
+- warm second solve of an IDENTICAL pending set hits the cache;
+- catalog epoch roll (the instance-type provider returns a new list
+  object) produces a fresh compile;
+- pool mutation (in-place field reassignment — the NodePool __setattr__
+  epoch) produces a fresh compile;
+- in-place pod mutation (field reassignment — the Pod __setattr__ epoch)
+  produces a fresh compile, with no stale feasibility rows;
+- live-node change (a pod binds, `used` moves) produces a fresh compile.
+"""
+
+import pytest
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import PodAffinityTerm
+from karpenter_tpu.scheduling import TensorScheduler
+from karpenter_tpu.state.cluster import StateNode
+from karpenter_tpu.testing import Environment
+
+
+@pytest.fixture(scope="module")
+def env():
+    return Environment()
+
+
+@pytest.fixture(scope="module")
+def setup(env):
+    pool = env.default_node_pool()
+    nc = env.default_node_class()
+    types = env.instance_types.list(pool, nc)
+    return pool, types
+
+
+def _pods(n=20, cpu=1):
+    return [Pod(requests=Resources(cpu=cpu, memory="1Gi")) for _ in range(n)]
+
+
+def _placed(result):
+    return sum(len(vn.pods) for vn in result.new_nodes) + len(
+        result.existing_placements
+    )
+
+
+class TestCompileCache:
+    def test_warm_identical_solve_hits(self, setup):
+        pool, types = setup
+        pods = _pods()
+        ts = TensorScheduler([pool], {pool.name: types})
+        r1 = ts.solve(pods)
+        assert (ts.compile_cache_hits, ts.compile_cache_misses) == (0, 1)
+        r2 = ts.solve(pods)
+        assert (ts.compile_cache_hits, ts.compile_cache_misses) == (1, 1)
+        # the cached compile decodes the same placements
+        assert _placed(r1) == _placed(r2) == len(pods)
+        assert len(r1.new_nodes) == len(r2.new_nodes)
+
+    def test_different_batch_misses(self, setup):
+        pool, types = setup
+        ts = TensorScheduler([pool], {pool.name: types})
+        ts.solve(_pods())
+        ts.solve(_pods())  # NEW pod objects: a different pending set
+        assert ts.compile_cache_hits == 0
+        assert ts.compile_cache_misses == 2
+
+    def test_catalog_epoch_roll_invalidates(self, setup, env):
+        pool, types = setup
+        pods = _pods()
+        ts = TensorScheduler([pool], {pool.name: types})
+        ts.solve(pods)
+        # the provider contract: inventory change = a NEW list object
+        ts.instance_types = {pool.name: list(types)}
+        ts.solve(pods)
+        assert ts.compile_cache_hits == 0
+        assert ts.compile_cache_misses == 2
+
+    def test_pool_mutation_invalidates(self, setup):
+        pool, types = setup
+        pods = _pods()
+        ts = TensorScheduler([pool], {pool.name: types})
+        ts.solve(pods)
+        pool.weight = pool.weight  # in-place reassignment bumps the epoch
+        ts.solve(pods)
+        assert ts.compile_cache_hits == 0
+        assert ts.compile_cache_misses == 2
+
+    def test_pod_mutation_invalidates_no_stale_rows(self, setup):
+        pool, types = setup
+        pods = _pods(n=5)
+        ts = TensorScheduler([pool], {pool.name: types})
+        r1 = ts.solve(pods)
+        assert not r1.unschedulable
+        # in-place mutation: an impossible node selector (a DEFINED
+        # topology key, impossible value) must produce a FRESH compile
+        # whose feasibility rows reject the pod — a stale cached row
+        # would keep placing it
+        pods[0].node_selector = {L.LABEL_ZONE: "zone-nowhere"}
+        r2 = ts.solve(pods)
+        assert ts.compile_cache_misses == 2
+        assert pods[0].key() in r2.unschedulable
+        assert _placed(r2) == len(pods) - 1
+
+    def test_update_clears_cache(self, setup):
+        pool, types = setup
+        pods = _pods()
+        ts = TensorScheduler([pool], {pool.name: types})
+        ts.solve(pods)
+        ts.update([pool], {pool.name: list(types)})
+        assert not ts._compile_cache
+
+    def test_existing_node_change_invalidates(self, setup):
+        pool, types = setup
+        pods = _pods(n=6)
+        sn = StateNode(
+            name="live-1",
+            provider_id="fake://live-1",
+            labels={L.LABEL_ZONE: "zone-a", L.LABEL_NODEPOOL: pool.name},
+            taints=[],
+            allocatable=Resources(cpu=16, memory="64Gi", pods=110),
+            pods=[],
+            used=Resources(),
+        )
+        ts = TensorScheduler([pool], {pool.name: types}, existing=[sn])
+        r1 = ts.solve(pods)
+        assert len(r1.existing_placements) == len(pods)
+        # a pod binds: `used` is replaced, the next solve must recompile
+        sn.used = sn.used + Resources(cpu=15)
+        r2 = ts.solve(pods)
+        assert ts.compile_cache_misses == 2
+        assert len(r2.existing_placements) < len(pods)
+
+    def test_live_taint_change_invalidates(self, setup):
+        """Cordoning-by-taint in place (what the termination/disruption
+        controllers do) must produce a fresh compile — taints are part of
+        the live-node content fingerprint."""
+        from karpenter_tpu.api import Taint
+
+        pool, types = setup
+        pods = _pods(n=4)
+        sn = StateNode(
+            name="live-t",
+            provider_id="fake://live-t",
+            labels={L.LABEL_ZONE: "zone-a", L.LABEL_NODEPOOL: pool.name},
+            taints=[],
+            allocatable=Resources(cpu=16, memory="64Gi", pods=110),
+            pods=[],
+            used=Resources(),
+        )
+        ts = TensorScheduler([pool], {pool.name: types}, existing=[sn])
+        r1 = ts.solve(pods)
+        assert len(r1.existing_placements) == len(pods)
+        sn.taints = [Taint(key="k", value="v", effect="NoSchedule")]
+        r2 = ts.solve(pods)
+        assert ts.compile_cache_misses == 2
+        assert not r2.existing_placements  # intolerable node: all new nodes
+
+    def test_snapshot_rebuild_same_content_hits(self, setup):
+        """The real controller rebuilds StateNode wrappers from
+        Cluster.snapshot() every tick; content-identical wrappers (same
+        name/used/labels/bound pods) must HIT — wrapper identity alone
+        must not defeat the cache across reconcile ticks."""
+        pool, types = setup
+        pods = _pods(n=6)
+        bound = Pod(labels={"a": "b"}, requests=Resources(cpu=1))
+
+        def wrapper():
+            return StateNode(
+                name="live-s",
+                provider_id="fake://live-s",
+                labels={L.LABEL_ZONE: "zone-a", L.LABEL_NODEPOOL: pool.name},
+                taints=[],
+                allocatable=Resources(cpu=16, memory="64Gi", pods=110),
+                pods=[bound],  # same BOUND pod objects, fresh wrapper
+                used=Resources(cpu=1),
+            )
+
+        ts = TensorScheduler([pool], {pool.name: types}, existing=[wrapper()])
+        r1 = ts.solve(pods)
+        ts.existing = [wrapper()]  # tick 2: fresh snapshot, same content
+        r2 = ts.solve(pods)
+        assert (ts.compile_cache_hits, ts.compile_cache_misses) == (1, 1)
+        assert r1.existing_placements == r2.existing_placements
+
+    def test_cache_bounded(self, setup):
+        pool, types = setup
+        ts = TensorScheduler([pool], {pool.name: types})
+        for _ in range(ts._COMPILE_CACHE_CAP + 4):
+            ts.solve(_pods(n=2))
+        assert len(ts._compile_cache) <= ts._COMPILE_CACHE_CAP
+
+
+def _live_join_fixture(pool, n_groups=3, group_size=4):
+    """Live nodes each holding one labeled bound pod, plus pending
+    co-location groups whose hostname affinity selects those bound pods —
+    the join-continuation shape."""
+    existing, pods = [], []
+    for g in range(n_groups):
+        bound = Pod(
+            labels={"pair": f"g{g}"}, requests=Resources(cpu=1, memory="2Gi")
+        )
+        existing.append(
+            StateNode(
+                name=f"live-{g}",
+                provider_id=f"fake://live-{g}",
+                labels={
+                    L.LABEL_ZONE: "zone-a",
+                    L.LABEL_NODEPOOL: pool.name,
+                },
+                taints=[],
+                allocatable=Resources(cpu=16, memory="64Gi", pods=110),
+                pods=[bound],
+                used=Resources(cpu=1, memory="2Gi"),
+            )
+        )
+        term = PodAffinityTerm(
+            topology_key=L.LABEL_HOSTNAME,
+            label_selector=(("pair", f"g{g}"),),
+        )
+        for _ in range(group_size):
+            pods.append(
+                Pod(
+                    labels={"pair": f"g{g}"},
+                    requests=Resources(cpu=1, memory="2Gi"),
+                    pod_affinity=[term],
+                )
+            )
+    return existing, pods
+
+
+class TestLiveJoinContinuation:
+    def test_join_places_groups_on_anchors(self, setup):
+        pool, types = setup
+        existing, pods = _live_join_fixture(pool)
+        filler = _pods(n=30)
+        ts = TensorScheduler([pool], {pool.name: types}, existing=existing)
+        r = ts.solve(filler + pods)
+        assert ts.last_path == "hybrid"
+        assert ts.last_continuation == "join"
+        assert not r.unschedulable
+        # every group member joined ITS anchor node
+        for p in pods:
+            g = p.labels["pair"][1:]
+            assert r.existing_placements[p.key()] == f"live-{g}"
+
+    def test_join_respects_anchor_capacity(self, setup):
+        """Groups that cannot fit their anchor fall back to the oracle
+        continuation (the semantics definition) instead of overcommitting."""
+        pool, types = setup
+        existing, pods = _live_join_fixture(pool, n_groups=1, group_size=4)
+        existing[0].allocatable = Resources(cpu=2, memory="8Gi", pods=110)
+        ts = TensorScheduler([pool], {pool.name: types}, existing=existing)
+        r = ts.solve(_pods(n=10) + pods)
+        assert ts.last_continuation == "oracle"
+        # the oracle decides: nothing lands on the overfull anchor beyond
+        # its capacity
+        placed_here = [
+            k for k, n in r.existing_placements.items() if n == "live-0"
+        ]
+        total = existing[0].used + Resources(cpu=1, memory="2Gi").scaled(
+            len(placed_here)
+        )
+        assert total.fits(existing[0].allocatable)
+
+    def test_join_ineligible_with_extra_constraints(self, setup):
+        """A joiner carrying a preference must take the oracle path (the
+        join fast path only understands plain hostname-affinity)."""
+        from karpenter_tpu.api import Requirement
+        from karpenter_tpu.api.requirements import Op
+
+        pool, types = setup
+        existing, pods = _live_join_fixture(pool, n_groups=2)
+        pods[0].preferred_affinity = [
+            Requirement(L.LABEL_ZONE, Op.IN, ["zone-a"])
+        ]
+        ts = TensorScheduler([pool], {pool.name: types}, existing=existing)
+        r = ts.solve(_pods(n=10) + pods)
+        assert ts.last_path == "hybrid"
+        assert ts.last_continuation == "oracle"
+        assert not r.unschedulable
+
+    def test_join_matches_oracle_placements(self, setup):
+        """The fast path and the forced-oracle continuation agree on the
+        join shape (the parity contract of the overlap)."""
+        pool, types = setup
+        existing, pods = _live_join_fixture(pool)
+        batch = _pods(n=10) + pods
+        ts = TensorScheduler([pool], {pool.name: types}, existing=existing)
+        r_join = ts.solve(batch)
+        assert ts.last_continuation == "join"
+        # force the sequential oracle by disabling the plan
+        ts2 = TensorScheduler([pool], {pool.name: types}, existing=existing)
+        ts2._plan_live_join = lambda *a, **k: None
+        r_oracle = ts2.solve(batch)
+        assert ts2.last_continuation == "oracle"
+        assert r_join.existing_placements == r_oracle.existing_placements
+        assert not r_join.unschedulable and not r_oracle.unschedulable
+
+
+class TestPhaseBreakdown:
+    def test_phases_recorded_and_disjoint(self, setup):
+        import time
+
+        pool, types = setup
+        pods = _pods(n=40)
+        ts = TensorScheduler([pool], {pool.name: types})
+        t0 = time.perf_counter()
+        ts.solve(pods)
+        wall = time.perf_counter() - t0
+        phases = ts.last_phases
+        for name in ("partition", "compile", "pad", "dispatch",
+                     "device_block", "decode", "other"):
+            assert name in phases, (name, phases)
+        assert all(v >= 0.0 for v in phases.values()), phases
+        # disjoint self-times: the sum equals the solve's wall clock
+        # (within scheduling noise of the two outer perf_counter reads)
+        assert sum(phases.values()) <= wall * 1.05 + 1e-3
+
+    def test_hybrid_records_oracle_phase(self, setup):
+        pool, types = setup
+        existing, pods = _live_join_fixture(pool)
+        ts = TensorScheduler([pool], {pool.name: types}, existing=existing)
+        ts.solve(pods)
+        assert "oracle" in ts.last_phases
+
+    def test_provisioner_exports_phase_metrics(self):
+        from karpenter_tpu.api import Resources as R
+
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool()
+        env.kube.put_pod(Pod(requests=R(cpu=1, memory="1Gi")))
+        env.settle(max_rounds=10)
+        samples = env.registry.histogram(
+            "karpenter_solver_phase_seconds", {"phase": "compile"}
+        )
+        assert samples, "no phase histogram observed after a provision tick"
+        assert all(s >= 0.0 for s in samples)
+
+
+class TestDeviceConstantLRU:
+    def test_evicts_least_recently_used_only(self):
+        import numpy as np
+
+        from karpenter_tpu.ops.packer import (
+            _DEVICE_CACHE_CAP,
+            cached_device_put,
+        )
+
+        cache = {}
+        srcs = []
+        for i in range(_DEVICE_CACHE_CAP):
+            a = np.array([i], np.float32)
+            srcs.append(a)
+            cached_device_put(cache, (a,), (), lambda a=a: a)
+        assert len(cache) == _DEVICE_CACHE_CAP
+        # touch the OLDEST entry, then insert one more: the second-oldest
+        # must be evicted, the touched survivor must stay resident
+        cached_device_put(cache, (srcs[0],), (), lambda: srcs[0])
+        extra = np.array([99], np.float32)
+        cached_device_put(cache, (extra,), (), lambda: extra)
+        assert len(cache) == _DEVICE_CACHE_CAP
+        keys = set(cache)
+        assert (id(srcs[0]),) in keys, "recently-used entry was evicted"
+        assert (id(srcs[1]),) not in keys, "LRU entry survived eviction"
+        assert (id(extra),) in keys
+
+    def test_hit_returns_cached_device_value(self):
+        import numpy as np
+
+        from karpenter_tpu.ops.packer import cached_device_put
+
+        cache = {}
+        a = np.array([1.0], np.float32)
+        built = []
+        fn = lambda: (built.append(1), a)[1]  # noqa: E731
+        v1 = cached_device_put(cache, (a,), (), fn)
+        v2 = cached_device_put(cache, (a,), (), fn)
+        assert v1 is v2
+        assert len(built) == 1

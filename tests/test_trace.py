@@ -108,3 +108,70 @@ class TestWiring:
     def test_profiling_off_by_default(self):
         env = Environment()
         assert env.operator.tracer.enabled is False
+
+
+class TestPhaseCollector:
+    def test_noop_without_sink(self):
+        from karpenter_tpu.utils.trace import phase
+
+        with phase("anything"):
+            pass  # must not raise, must not record anywhere
+
+    def test_self_time_is_disjoint(self):
+        import time
+
+        from karpenter_tpu.utils.trace import phase, phase_collect
+
+        sink = {}
+        with phase_collect(sink):
+            with phase("outer"):
+                time.sleep(0.01)
+                with phase("inner"):
+                    time.sleep(0.01)
+        assert set(sink) == {"outer", "inner"}
+        # inner's time is SUBTRACTED from outer (self-time accounting),
+        # so the buckets are disjoint and sum to the wall clock
+        assert sink["inner"] >= 0.009
+        assert sink["outer"] >= 0.009
+        assert sink["outer"] < 0.02 + 0.005
+
+    def test_accumulates_across_repeated_phases(self):
+        from karpenter_tpu.utils.trace import phase, phase_collect
+
+        sink = {}
+        with phase_collect(sink):
+            for _ in range(3):
+                with phase("step"):
+                    pass
+        assert len(sink) == 1 and sink["step"] >= 0.0
+
+    def test_sink_restored_after_block(self):
+        from karpenter_tpu.utils.trace import phase, phase_collect
+
+        outer_sink, inner_sink = {}, {}
+        with phase_collect(outer_sink):
+            with phase_collect(inner_sink):
+                with phase("a"):
+                    pass
+            with phase("b"):
+                pass
+        assert "a" in inner_sink and "a" not in outer_sink
+        assert "b" in outer_sink and "b" not in inner_sink
+
+    def test_thread_local_sinks(self):
+        from karpenter_tpu.utils.trace import phase, phase_collect
+
+        sink = {}
+        seen = {}
+
+        def worker():
+            # no sink installed on THIS thread: phase is a no-op
+            with phase("worker-phase"):
+                seen["ran"] = True
+
+        with phase_collect(sink):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["ran"]
+        assert "worker-phase" not in sink
