@@ -57,6 +57,13 @@ def _metrics_server(port: int) -> ThreadingHTTPServer:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "sim":
+        # deterministic cluster simulator: drive the real Operator through
+        # a declarative scenario, record/replay traces, emit an SLO report
+        # (sim/cli.py, docs/designs/simulation.md)
+        from karpenter_tpu.sim.cli import main as sim_main
+
+        return sim_main(argv[1:], allow_reexec=True)
     if argv and argv[0] == "store-server":
         # shared cluster-store server mode: own the one durable KubeStore
         # that --store-address controllers (and their Lease election)

@@ -175,6 +175,19 @@ class PodAffinityTerm:
 _pod_seq = itertools.count()
 
 
+def reset_name_sequences() -> None:
+    """Rewind the auto-name counters (pod-N / nodeclaim-N).
+
+    The cluster simulator's determinism contract is byte-identical traces
+    for equal seeds, and generated names leak into the trace (CreateTags
+    carries the claim name).  A fresh simulation therefore rewinds the
+    process-global counters — only safe against a FRESH KubeStore/FakeCloud,
+    where no live object can collide with a re-issued name."""
+    global _pod_seq, _claim_seq
+    _pod_seq = itertools.count()
+    _claim_seq = itertools.count()
+
+
 @dataclass
 class Pod:
     """The scheduling-relevant projection of a v1.Pod."""

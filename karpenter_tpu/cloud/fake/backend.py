@@ -258,6 +258,10 @@ class _CallRecorder:
         self._error_at: Dict[str, Dict[int, Exception]] = {}
         self._lock = threading.Lock()
         self.chaos: Optional[ChaosEngine] = None  # wired by FakeCloud
+        # observers called with (api, args) at every API entry, BEFORE any
+        # injected error fires — the cluster simulator's trace recorder
+        # (sim/trace.py) rides this to capture the full call stream
+        self.taps: List = []
 
     def record(self, api: str, *args) -> None:
         with self._lock:
@@ -271,6 +275,8 @@ class _CallRecorder:
                     del self._error_seq[api]
             if err is None:
                 err = self._error_at.get(api, {}).pop(n, None)
+        for tap in self.taps:
+            tap(api, args)
         if err is not None:
             raise err
         if self.chaos is not None:
@@ -379,6 +385,23 @@ class FakeCloud:
     def mark_insufficient(self, instance_type: str, zone: str, capacity_type: str):
         with self._lock:
             self.insufficient_pools.add((instance_type, zone, capacity_type))
+
+    def mark_zone_insufficient(self, zone: str) -> None:
+        """AZ capacity loss: every (type, capacity_type) pool in the zone
+        starts returning InsufficientInstanceCapacity — the sim's
+        az-blackout building block (cloud APIs keep answering; only the
+        zone's capacity is gone, like a real AZ event)."""
+        with self._lock:
+            for t in self.shapes:
+                for ct in (L.CAPACITY_TYPE_ON_DEMAND, L.CAPACITY_TYPE_SPOT):
+                    self.insufficient_pools.add((t, zone, ct))
+
+    def clear_zone_insufficient(self, zone: str) -> None:
+        """The AZ heals: drop every insufficient-pool mark in the zone."""
+        with self._lock:
+            self.insufficient_pools = {
+                p for p in self.insufficient_pools if p[1] != zone
+            }
 
     # -------------------------------------------------------------- catalog
     def describe_instance_types(self) -> List[MachineShape]:

@@ -401,31 +401,7 @@ class DisruptionController:
         return out
 
     def _remaining_budgets(self) -> Dict[str, int]:
-        """Per-pool disruption allowance this pass
-        (pool.disruption.budgets: "10%" of nodes or an absolute count;
-        active disruptions consume the budget)."""
-        counts: Dict[str, int] = {}
-        disrupting: Dict[str, int] = {}
-        for sn in self.cluster.snapshot():
-            pool = sn.pool_name
-            if not pool:
-                continue
-            counts[pool] = counts.get(pool, 0) + 1
-            if sn.marked_for_deletion():
-                disrupting[pool] = disrupting.get(pool, 0) + 1
-        out: Dict[str, int] = {}
-        for name, pool in self.kube.node_pools.items():
-            total = counts.get(name, 0)
-            allowed = total  # default: unbounded
-            for b in pool.disruption.budgets:
-                if b.endswith("%"):
-                    allowed = min(
-                        allowed, math.ceil(total * float(b[:-1]) / 100.0)
-                    )
-                else:
-                    allowed = min(allowed, int(b))
-            out[name] = allowed - disrupting.get(name, 0)
-        return out
+        return remaining_disruption_budgets(self.kube, self.cluster)
 
     # ------------------------------------------------------------ mechanisms
     def _expire(self, candidates: Sequence[Candidate]) -> bool:
@@ -709,3 +685,34 @@ class DisruptionController:
         )
         self.termination.mark_for_deletion(c.claim, reason=reason)
         return True
+
+
+def remaining_disruption_budgets(kube: KubeStore, cluster: Cluster) -> Dict[str, int]:
+    """Per-pool disruption allowance right now (pool.disruption.budgets:
+    "10%" of nodes or an absolute count; nodes already marked for deletion
+    consume the budget).
+
+    Module-level because two consumers need the SAME arithmetic: the
+    controller gates its voluntary disruptions on it each pass, and the
+    simulator's invariant checker (sim/invariants.py) verifies from the
+    outside that the controller never exceeded it."""
+    counts: Dict[str, int] = {}
+    disrupting: Dict[str, int] = {}
+    for sn in cluster.snapshot():
+        pool = sn.pool_name
+        if not pool:
+            continue
+        counts[pool] = counts.get(pool, 0) + 1
+        if sn.marked_for_deletion():
+            disrupting[pool] = disrupting.get(pool, 0) + 1
+    out: Dict[str, int] = {}
+    for name, pool in kube.node_pools.items():
+        total = counts.get(name, 0)
+        allowed = total  # default: unbounded
+        for b in pool.disruption.budgets:
+            if b.endswith("%"):
+                allowed = min(allowed, math.ceil(total * float(b[:-1]) / 100.0))
+            else:
+                allowed = min(allowed, int(b))
+        out[name] = allowed - disrupting.get(name, 0)
+    return out

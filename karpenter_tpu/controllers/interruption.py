@@ -77,6 +77,11 @@ class InterruptionController:
         self.termination = termination
         self.unavailable = unavailable
         self.registry = registry
+        # per-instance override: the simulator sets 1 so message handling
+        # (and the DeleteMessage/TerminateInstances calls it makes) happens
+        # in queue order — reproducible traces need a reproducible call
+        # stream, which a thread pool cannot give
+        self.workers = self.WORKERS
 
     # worker fan-out per batch (reference controller.go:108-118 runs the
     # 10-message batch through a 10-way errgroup)
@@ -113,7 +118,11 @@ class InterruptionController:
                 return  # NOT deleted -> redelivered next poll
             self.registry.inc("karpenter_interruption_deleted_messages")
 
-        with ThreadPoolExecutor(max_workers=self.WORKERS) as pool:
+        if self.workers <= 1:
+            for msg in messages:  # deterministic in-order drain (sim mode)
+                process(msg)
+            return
+        with ThreadPoolExecutor(max_workers=self.workers) as pool:
             # list() propagates nothing: process() swallows per-message
             # errors (handle AND delete), so the batch always drains
             list(pool.map(process, messages))

@@ -46,6 +46,16 @@ from karpenter_tpu.utils.clock import Clock
 log = logging.getLogger(__name__)
 
 
+def _call_outcome(fn, *args) -> Optional[Exception]:
+    """Run ``fn`` and return the exception it raised (None on success) —
+    lets the serial and concurrent launch paths share one outcome loop."""
+    try:
+        fn(*args)
+        return None
+    except Exception as exc:
+        return exc
+
+
 class PodBatcher:
     """The 1s-idle / 10s-max pending-pod window (settings.md:43-47)."""
 
@@ -107,12 +117,30 @@ class Provisioner:
         # long-lived scheduler: its compiled-catalog cache hits whenever the
         # instance-type provider serves the same cached inventory lists
         self.scheduler = TensorScheduler([], {})
+        # launch fan-out; 1 serializes launches in submission order — the
+        # simulator's determinism contract (sim/runner.py) requires the
+        # cloud-call stream to be reproducible, which thread scheduling is
+        # not.  None/absent keeps the production concurrent path.
+        self.launch_concurrency: Optional[int] = None
+        # pod key -> clock time first observed pending, feeding the
+        # karpenter_pods_time_to_schedule_seconds histogram (first-seen ->
+        # nominated); the sim's SLO report reads its samples
+        self._first_seen: Dict[str, float] = {}
 
     # -------------------------------------------------------------- reconcile
     def reconcile(self) -> List[NodeClaim]:
         """One controller tick: observe pending pods, provision when the
         batch window closes.  Returns the claims launched this tick."""
         pending = self._provisionable_pods()
+        now = self.clock.now()
+        for p in pending:
+            self._first_seen.setdefault(p.key(), now)
+        # prune first-seen entries for pods that vanished unscheduled
+        # (deleted mid-wait) so the map cannot grow unboundedly
+        if self._first_seen:
+            live = self.kube.pods
+            for key in [k for k in self._first_seen if k not in live]:
+                del self._first_seen[key]
         self.batcher.observe(pending)
         if not pending or not self.batcher.ready():
             return []
@@ -194,7 +222,18 @@ class Provisioner:
         # nominate pods placed on existing nodes (the kube-scheduler binds)
         for pod_key, node_name in result.existing_placements.items():
             self.cluster.nominate(pod_key, node_name)
+            self._observe_scheduled(pod_key)
         return self._launch(result)
+
+    def _observe_scheduled(self, pod_key: str) -> None:
+        """Pod first-seen-pending -> nominated latency (the scheduling SLO
+        the sim report aggregates into p50/p95/p99)."""
+        t0 = self._first_seen.pop(pod_key, None)
+        if t0 is not None:
+            self.registry.observe(
+                "karpenter_pods_time_to_schedule_seconds",
+                max(self.clock.now() - t0, 0.0),
+            )
 
     def _headroom_types(self, pool, types, usage: Resources) -> list:
         """The pool's instance types that still fit inside its remaining
@@ -242,43 +281,58 @@ class Provisioner:
         launched: List[NodeClaim] = []
         if not claims:
             return launched
-        with ThreadPoolExecutor(max_workers=min(32, len(claims))) as pool_exec:
-            futures = [
-                (claim, vn, pool_exec.submit(self.cloud_provider.create, claim))
+        workers = self.launch_concurrency or min(32, len(claims))
+        if workers <= 1:
+            # deterministic serial path (see launch_concurrency): every
+            # cloud call happens in claim order, so a seeded simulation
+            # replays byte-identically
+            outcomes = [
+                (claim, vn, _call_outcome(self.cloud_provider.create, claim))
                 for claim, vn in claims
             ]
-            for claim, vn, fut in futures:
-                try:
-                    fut.result()
-                except Exception as exc:
-                    if is_insufficient_capacity(exc):
-                        # ICE cache already masks the pools; pods retry next
-                        # batch (reference cloudprovider.go:101 semantics)
-                        self.registry.inc("karpenter_nodeclaims_launch_failed",
-                                          {"reason": "insufficient_capacity"})
-                        self.kube.record_event(
-                            "NodeClaim", "InsufficientCapacity", claim.name,
-                            str(exc),
-                        )
-                    else:
-                        # per-claim isolation: one flaky cloud error must not
-                        # kill the reconcile loop or strand the other claims'
-                        # nominations (the reference logs-and-continues per
-                        # machine); the pods re-enter the next batch
-                        log.exception("launch of %s failed", claim.name)
-                        self.registry.inc("karpenter_nodeclaims_launch_failed",
-                                          {"reason": "error"})
-                        self.kube.record_event(
-                            "NodeClaim", "LaunchFailed", claim.name, str(exc)
-                        )
-                    continue
-                self.kube.put_node_claim(claim)
-                self.registry.inc(
-                    "karpenter_nodeclaims_launched", {"nodepool": claim.pool_name}
-                )
-                for pod in vn.pods:
-                    self.cluster.nominate(pod.key(), claim.name)
-                launched.append(claim)
+        else:
+            with ThreadPoolExecutor(
+                max_workers=min(workers, len(claims))
+            ) as pool_exec:
+                futures = [
+                    (claim, vn, pool_exec.submit(self.cloud_provider.create, claim))
+                    for claim, vn in claims
+                ]
+                outcomes = [
+                    (claim, vn, _call_outcome(fut.result))
+                    for claim, vn, fut in futures
+                ]
+        for claim, vn, exc in outcomes:
+            if exc is not None:
+                if is_insufficient_capacity(exc):
+                    # ICE cache already masks the pools; pods retry next
+                    # batch (reference cloudprovider.go:101 semantics)
+                    self.registry.inc("karpenter_nodeclaims_launch_failed",
+                                      {"reason": "insufficient_capacity"})
+                    self.kube.record_event(
+                        "NodeClaim", "InsufficientCapacity", claim.name,
+                        str(exc),
+                    )
+                else:
+                    # per-claim isolation: one flaky cloud error must not
+                    # kill the reconcile loop or strand the other claims'
+                    # nominations (the reference logs-and-continues per
+                    # machine); the pods re-enter the next batch
+                    log.error("launch of %s failed", claim.name, exc_info=exc)
+                    self.registry.inc("karpenter_nodeclaims_launch_failed",
+                                      {"reason": "error"})
+                    self.kube.record_event(
+                        "NodeClaim", "LaunchFailed", claim.name, str(exc)
+                    )
+                continue
+            self.kube.put_node_claim(claim)
+            self.registry.inc(
+                "karpenter_nodeclaims_launched", {"nodepool": claim.pool_name}
+            )
+            for pod in vn.pods:
+                self.cluster.nominate(pod.key(), claim.name)
+                self._observe_scheduled(pod.key())
+            launched.append(claim)
         return launched
 
     # ------------------------------------------------------------- claim gen

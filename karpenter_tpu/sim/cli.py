@@ -1,0 +1,118 @@
+"""``python -m karpenter_tpu sim`` — run or replay a cluster scenario.
+
+    python -m karpenter_tpu sim --scenario diurnal --seed 7 --ticks 200
+    python -m karpenter_tpu sim --replay sim-diurnal-seed7.jsonl
+
+stdout is the deterministic SLO report (JSON): running the same
+scenario/seed/ticks twice prints the identical report and writes
+byte-identical traces; replaying a recorded trace reproduces the identical
+report.  Trace location/sha and the replay verdict go to stderr so they
+never perturb the comparable surface.  `--profile` attaches the wall-clock
+solver phase breakdown — explicitly non-deterministic, off by default.
+
+Determinism hygiene: the run pins JAX to CPU devices (a simulation wants
+reproducibility, not accelerator throughput) and re-execs itself once
+with PYTHONHASHSEED=0 so set iteration order cannot vary between
+invocations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None, allow_reexec: bool = False) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if allow_reexec and os.environ.get("PYTHONHASHSEED") is None:
+        env = dict(os.environ, PYTHONHASHSEED="0")
+        os.execve(
+            sys.executable,
+            [sys.executable, "-m", "karpenter_tpu", "sim", *argv],
+            env,
+        )
+    parser = argparse.ArgumentParser(prog="python -m karpenter_tpu sim")
+    parser.add_argument("--scenario", default="steady")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--ticks", type=int, default=200)
+    parser.add_argument(
+        "--trace",
+        default="",
+        help="trace JSONL path (default: sim-<scenario>-seed<seed>.jsonl)",
+    )
+    parser.add_argument(
+        "--replay",
+        default="",
+        metavar="TRACE",
+        help="re-execute a recorded trace instead of generating; exits 1 "
+        "if the recomputed report differs from the recorded one",
+    )
+    parser.add_argument(
+        "--profile",
+        action="store_true",
+        help="attach the wall-clock solver phase breakdown to the report "
+        "(NON-deterministic by nature)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list scenarios and exit"
+    )
+    args = parser.parse_args(argv)
+
+    # pin JAX before the operator/solver import chain initializes a backend
+    from karpenter_tpu.testing import pin_cpu_platform
+
+    pin_cpu_platform(8)
+
+    from karpenter_tpu.sim.report import wall_profile
+    from karpenter_tpu.sim.runner import SCENARIOS, replay, run_scenario
+    from karpenter_tpu.sim.trace import TraceWriter
+
+    if args.list:
+        for name, factory in sorted(SCENARIOS.items()):
+            print(f"{name}: {factory(200).description}")
+        return 0
+
+    if args.replay:
+        trace_path = args.trace or (args.replay + ".replayed")
+        writer = TraceWriter(trace_path)
+        runner, report, recorded = replay(args.replay, trace=writer)
+        matches = recorded is not None and report == recorded
+        print(
+            f"replayed {args.replay} -> {trace_path} "
+            f"(sha256 {writer.sha256()[:16]}); report "
+            f"{'matches' if matches else 'DIFFERS FROM'} the recorded one",
+            file=sys.stderr,
+        )
+    else:
+        if args.scenario not in SCENARIOS:
+            print(
+                f"unknown scenario {args.scenario!r}; have "
+                f"{', '.join(sorted(SCENARIOS))}",
+                file=sys.stderr,
+            )
+            return 64
+        trace_path = args.trace or f"sim-{args.scenario}-seed{args.seed}.jsonl"
+        writer = TraceWriter(trace_path)
+        runner, report = run_scenario(
+            args.scenario, args.seed, args.ticks, trace=writer
+        )
+        matches = True
+        print(
+            f"trace -> {trace_path} (sha256 {writer.sha256()[:16]})",
+            file=sys.stderr,
+        )
+
+    if args.profile:
+        report = dict(report, profile=wall_profile(runner.env.registry))
+    print(json.dumps(report, indent=2, sort_keys=True))
+
+    if report["invariants"]["violations"]:
+        print(
+            f"{len(report['invariants']['violations'])} invariant "
+            "violation(s)",
+            file=sys.stderr,
+        )
+        return 2
+    return 0 if matches else 1

@@ -1,0 +1,131 @@
+"""SLO report for a simulation run.
+
+`build_report` produces the DETERMINISTIC summary: everything in it is a
+function of (scenario, seed, ticks) on the simulated clock, so a replayed
+trace reproduces it byte-for-byte.  Wall-clock measurements — the solver
+phase breakdown from `last_phases`, scheduling wall durations — are host
+performance, not simulation outcome, and live in the separate
+`wall_profile` section the CLI only attaches under `--profile`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def percentile(samples: List[float], q: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation)."""
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    rank = max(0, min(len(ordered) - 1, int(round(q * (len(ordered) - 1)))))
+    return ordered[rank]
+
+
+def _counter_family(registry, name: str) -> Dict[str, float]:
+    """Sum a counter family per first-label value (e.g. per nodepool)."""
+    out: Dict[str, float] = {}
+    for labels, v in registry.counters.get(name, {}).items():
+        key = labels[0][1] if labels else ""
+        out[key] = out.get(key, 0.0) + v
+    return out
+
+
+def build_report(runner) -> dict:
+    env = runner.env
+    registry = env.registry
+    tts = registry.histogram("karpenter_pods_time_to_schedule_seconds")
+    tts_count = 0
+    hist = registry.histograms.get(
+        "karpenter_pods_time_to_schedule_seconds", {}
+    ).get(())
+    if hist is not None:
+        tts_count = hist.count
+    launched = sum(
+        _counter_family(registry, "karpenter_nodeclaims_launched").values()
+    )
+    terminated = sum(
+        _counter_family(registry, "karpenter_nodes_terminated").values()
+    )
+    paths = {
+        (labels[0][1] if labels else ""): int(v)
+        for labels, v in registry.counters.get(
+            "karpenter_provisioner_scheduling_simulation_count", {}
+        ).items()
+    }
+    running_final = sum(
+        1 for i in env.cloud.instances.values() if i.state == "running"
+    )
+    return {
+        "scenario": runner.scenario.name,
+        "seed": runner.seed,
+        "ticks": runner.ticks,
+        "sim_seconds": round(env.clock.now() - runner.t0, 6),
+        "pods": {
+            "created": runner.pods_created,
+            "deleted": runner.pods_deleted,
+            "final": len(env.kube.pods),
+        },
+        "time_to_schedule_s": {
+            # percentiles over the histogram's bounded sample window —
+            # "window" < "scheduled" means a long run outgrew it and the
+            # percentiles describe only the most recent pods
+            "p50": round(percentile(tts, 0.50), 6),
+            "p95": round(percentile(tts, 0.95), 6),
+            "p99": round(percentile(tts, 0.99), 6),
+            "max": round(max(tts), 6) if tts else 0.0,
+            "scheduled": tts_count,
+            "window": len(tts),
+        },
+        "pending": {
+            "peak": runner.peak_pending,
+            "final": len(env.kube.pending_pods()),
+        },
+        "nodes": {
+            "launched": int(launched),
+            "terminated": int(terminated),
+            "churn": int(launched + terminated),
+            "final": len(env.kube.nodes),
+            "instances_running_final": running_final,
+        },
+        "cost_usd": {
+            "total": round(sum(runner.cost_by_ct.values()), 6),
+            "by_capacity_type": {
+                ct: round(v, 6) for ct, v in sorted(runner.cost_by_ct.items())
+            },
+        },
+        "solver": {"paths": dict(sorted(paths.items()))},
+        "events": dict(sorted(runner.event_counts.items())),
+        "invariants": {
+            "checked_ticks": runner.checker.checked_ticks,
+            "violations": [str(v) for v in runner.checker.violations],
+        },
+    }
+
+
+def wall_profile(registry) -> dict:
+    """Host-side (NON-deterministic) performance: solver phase breakdown
+    from `last_phases` as observed by karpenter_solver_phase_seconds, plus
+    the end-to-end scheduling-duration histogram."""
+    phases = {}
+    for labels, h in registry.histograms.get(
+        "karpenter_solver_phase_seconds", {}
+    ).items():
+        phase = labels[0][1] if labels else ""
+        phases[phase] = {
+            "count": h.count,
+            "total_s": round(h.total, 6),
+            "p50_s": round(percentile(list(h.samples), 0.5), 6),
+        }
+    sched = registry.histogram(
+        "karpenter_provisioner_scheduling_duration_seconds"
+    )
+    return {
+        "wall_clock": True,
+        "solver_phases": dict(sorted(phases.items())),
+        "scheduling_duration_s": {
+            "p50": round(percentile(sched, 0.5), 6),
+            "p95": round(percentile(sched, 0.95), 6),
+            "solves": len(sched),
+        },
+    }

@@ -40,12 +40,12 @@ from __future__ import annotations
 import logging
 import socket
 import threading
-import time
 from typing import Dict, Optional, Tuple
 
 from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
 from karpenter_tpu.state.kube import KubeStore
 from karpenter_tpu.state.wire import STORE_KINDS, canonical, from_wire, to_wire
+from karpenter_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)
 
@@ -67,11 +67,22 @@ class RemoteKubeStore(KubeStore):
         connect_timeout: float = 5.0,
         request_timeout: float = 10.0,
         start_watch: bool = True,
+        clock: Optional[Clock] = None,
     ):
         super().__init__()
         self.host = host
         self.port = port
         self.identity = identity or f"client-{id(self):x}"
+        # injectable pacing clock: retry backoff and wait_synced polling
+        # sleep on it, so under a FakeClock (the simulator's determinism
+        # contract — no raw time.sleep outside utils/clock.py) the waits
+        # become simulated time.  Socket TIMEOUTS stay wall-clock: they
+        # bound real network reads, which no simulated clock can compress.
+        # Caveat of the same contract: pairing a FakeClock with a REAL
+        # remote server collapses the backoff to zero wall time, giving
+        # the server no recovery window — a FakeClock belongs with
+        # simulated peers; real deployments keep the default Clock.
+        self.clock = clock or Clock()
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
         self._sock: Optional[socket.socket] = None
@@ -135,7 +146,7 @@ class RemoteKubeStore(KubeStore):
                     self._close_sock()
                     last = exc
             if attempt < RETRIES - 1:  # no pointless sleep after the last try
-                time.sleep(BACKOFF_S * (2**attempt))
+                self.clock.sleep(BACKOFF_S * (2**attempt))
         else:
             raise StoreUnavailableError(
                 f"cluster store at {self.host}:{self.port}: {last}"
@@ -632,11 +643,11 @@ class RemoteKubeStore(KubeStore):
         helper: a standby asserts its mirror is warm before acting."""
         if min_rv is None:
             min_rv = self._rpc({"method": "stat"})["rv"]
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = self.clock.now() + timeout
+        while self.clock.now() < deadline:
             if self.synced_rv >= min_rv:
                 return True
-            time.sleep(0.005)
+            self.clock.sleep(0.005)
         return self.synced_rv >= min_rv
 
     def close(self) -> None:

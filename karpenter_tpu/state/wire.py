@@ -71,6 +71,25 @@ _DATACLASSES = {
     )
 }
 
+def register_dataclass(cls: type) -> type:
+    """Extend the wire codec with an additional dataclass.
+
+    The store protocol itself only ever ships the closed set above, but
+    the codec is reused by other subsystems — the cluster simulator's
+    trace (sim/trace.py) encodes fake-cloud objects (MachineShape,
+    FakeImage, ...) through the same tagged-JSON rules.  Registration is
+    idempotent; a NAME collision with a different class is an error, so
+    no registered kind can ever be silently re-bound."""
+    existing = _DATACLASSES.get(cls.__name__)
+    if existing is not None and existing is not cls:
+        raise ValueError(
+            f"wire dataclass name collision: {cls.__name__!r} already "
+            f"registered to {existing.__module__}.{existing.__qualname__}"
+        )
+    _DATACLASSES[cls.__name__] = cls
+    return cls
+
+
 # kind name -> (class, KubeStore dict attribute, key function)
 STORE_KINDS: Dict[str, Tuple[type, str, Any]] = {
     "Pod": (Pod, "pods", lambda o: o.key()),

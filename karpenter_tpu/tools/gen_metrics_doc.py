@@ -34,6 +34,7 @@ _GROUPS = [
     ("karpenter_cache_", "Cache"),
     ("karpenter_instancetype_", "Instance types"),
     ("karpenter_solver_", "Solver"),
+    ("karpenter_sim_", "Simulator"),
 ]
 
 # metric type / label set / movement semantics, rendered as a sub-line.
@@ -66,6 +67,40 @@ _DETAILS = {
         "controller",
         "1 after a clean reconcile; 0 while the controller is "
         "crash-contained in per-controller requeue backoff after raising",
+    ),
+    "karpenter_pods_time_to_schedule_seconds": (
+        "histogram",
+        "(none)",
+        "pod first-seen-pending -> nominated onto a node/claim, observed "
+        "by the provisioning controller on the injected clock; the "
+        "simulator's SLO report (sim/report.py) aggregates its samples "
+        "into p50/p95/p99 time-to-schedule",
+    ),
+    "karpenter_sim_events_injected_total": (
+        "counter",
+        "kind",
+        "scenario events the simulator applied (pod_create, pod_delete, "
+        "instance_kill, spot_interruption, chaos, az_down/az_up, "
+        "image_roll, pool_update)",
+    ),
+    "karpenter_sim_ticks_total": (
+        "counter",
+        "phase",
+        "simulated ticks executed per phase (run / drain / settle)",
+    ),
+    "karpenter_sim_pending_pods": (
+        "gauge",
+        "(none)",
+        "pending-pod depth at the end of the last simulated tick; the "
+        "report's pending.peak is the max this gauge reached",
+    ),
+    "karpenter_sim_invariant_violations_total": (
+        "counter",
+        "invariant",
+        "invariant checks that failed (no-double-launch, "
+        "registered-eq-launched, budgets, no-leaked-instances, "
+        "schedule-deadline, all-pods-scheduled, no-wedged-controller); "
+        "any movement fails the run",
     ),
     "karpenter_solver_phase_seconds": (
         "histogram",

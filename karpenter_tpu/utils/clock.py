@@ -36,3 +36,14 @@ class FakeClock(Clock):
     def step(self, seconds: float) -> None:
         with self._lock:
             self._now += seconds
+
+    def advance_to(self, t: float) -> float:
+        """Advance to an absolute time (no-op when already past it).
+
+        The scenario runner (sim/runner.py) pins tick boundaries at
+        ``t0 + k * tick_s`` with this, so injected chaos latency (which
+        advances the clock mid-tick via `sleep`) compresses the remainder
+        of the tick instead of skewing every later tick boundary."""
+        with self._lock:
+            self._now = max(self._now, t)
+            return self._now

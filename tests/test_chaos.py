@@ -5,8 +5,6 @@ and asserts the survival invariants — no duplicate launches, no leaked
 instances, every pod scheduled once the faults clear, no controller
 permanently wedged."""
 
-import random
-
 import pytest
 
 from karpenter_tpu.api import Pod, Resources, Settings
@@ -18,6 +16,8 @@ from karpenter_tpu.cloud.fake.backend import (
 )
 from karpenter_tpu.cloud.retry import OPEN
 from karpenter_tpu.operator import Operator
+from karpenter_tpu.sim.report import build_report
+from karpenter_tpu.sim.runner import ScenarioRunner, chaos_soak_scenario
 from karpenter_tpu.state.kube import KubeStore
 from karpenter_tpu.testing import Environment
 from karpenter_tpu.utils.clock import FakeClock
@@ -294,117 +294,48 @@ class TestDegradedProvisioning:
 
 
 # --------------------------------------------------------------------- soak
+#
+# The seeded soak now runs on the simulator (karpenter_tpu/sim/): the
+# scenario carries the same mixed fault schedule + workload churn the
+# hand-rolled loop here used to build, the runner owns the environment
+# stepping, and sim/invariants.py asserts a STRICT SUPERSET of the old
+# final checks — no duplicate launches / no leaked instances / all pods
+# scheduled after fault clearance / no wedged controller, plus per-tick
+# no-double-launch, registered==launched, disruption-budget and
+# schedule-deadline checks the old loop never made.
 
-SOAK_CONTROLLERS = (
-    "nodeclass", "provisioner", "lifecycle", "interruption", "disruption",
-    "termination", "link", "garbagecollection", "tagging", "metrics_state",
-    "consistency",
-)
 
-
-def _soak(seed: int, faulty_ticks: int, total_ticks: int) -> Environment:
+def _soak(seed: int, faulty_ticks: int, total_ticks: int) -> ScenarioRunner:
     """Run the full Operator under a seeded mixed fault schedule (error
     rates, throttle bursts, full and partial blackouts, injected latency,
     partial CreateFleet fulfillment) with workload churn, then clear the
     faults and give the system the recovery windows its caches need (ICE
-    TTL 180s, GC grace 30s)."""
-    env = Environment(
-        shapes=SHAPES,
-        settings=Settings(cluster_name="test", interruption_queue_name="q",
-                          **FAST),
-    )
-    env.default_node_class()
-    env.default_node_pool()
-    rng = random.Random(seed)
-    chaos = env.cloud.chaos
-    chaos.reseed(seed + 1)
-    t0 = env.clock.now()
-    chaos.set_error_rate("*", 0.05, "InternalError")
-    chaos.set_latency("CreateFleet", 0.002)
-    chaos.set_partial_fleet(0.15)
-    chaos.add_throttle_burst(t0 + 10, 8.0)
-    chaos.add_blackout(t0 + 30, 6.0)  # full API blackout
-    chaos.add_blackout(t0 + 50, 8.0, apis=["DescribeSubnets", "DescribeImages"])
-    live_pods = []
-    for tick in range(total_ticks):
-        if tick == faulty_ticks:
-            chaos.clear()  # the weather breaks
-        r = rng.random()
-        if r < 0.4:
-            p = Pod(requests=Resources(cpu=rng.choice([0.5, 1, 2]),
-                                       memory="1Gi"))
-            env.kube.put_pod(p)
-            live_pods.append(p)
-        elif r < 0.5 and live_pods:
-            env.kube.delete_pod(live_pods.pop().key())
-        elif r < 0.55:
-            running = [i for i in env.cloud.instances.values()
-                       if i.state == "running"]
-            if running:
-                try:  # out-of-band kill (the raw API is chaos-subjected too)
-                    env.cloud.terminate_instances([rng.choice(running).id])
-                except CloudAPIError:
-                    pass
-        elif r < 0.6:
-            claims = [c for c in env.kube.node_claims.values()
-                      if c.provider_id]
-            if claims:
-                env.cloud.send_message({
-                    "kind": "spot_interruption",
-                    "instance_id": rng.choice(claims).provider_id,
-                })
-        env.clock.step(rng.choice([0.5, 1.0, 2.0]))
-        env.kubelet.step()
-        env.operator.reconcile_once()  # ANY raise here fails the soak
-        env.kubelet.step()
-    # recovery: outlast the ICE masks and GC/liveness grace windows
-    for _ in range(8):
-        env.step(35.0)
-    env.settle(max_rounds=40)
-    return env
-
-
-def _assert_invariants(env: Environment) -> None:
-    op = env.operator
-    # every pending pod scheduled once the faults cleared
-    assert not env.kube.pending_pods()
-    # no duplicate launches: live claims map 1:1 onto instances ...
-    pids = [c.provider_id for c in env.kube.node_claims.values()
-            if c.provider_id and c.deleted_at is None]
-    assert len(pids) == len(set(pids))
-    # ... and no two live instances carry the same nodeclaim attribution
-    by_tag = {}
-    for inst in env.cloud.instances.values():
-        if inst.state == "terminated":
-            continue
-        tag = inst.tags.get("karpenter.sh/nodeclaim")
-        if tag:
-            assert by_tag.setdefault(tag, inst.id) == inst.id, (
-                f"claim {tag} backed by {by_tag[tag]} AND {inst.id}"
-            )
-    # no leaked instances: everything still running is claimed
-    running = {i.id for i in env.cloud.instances.values()
-               if i.state == "running"}
-    claimed = {c.provider_id for c in env.kube.node_claims.values()
-               if c.provider_id}
-    assert running <= claimed, f"leaked instances: {running - claimed}"
-    # no controller permanently wedged
-    assert not op._ctrl_backoff, op._ctrl_backoff
-    for name in SOAK_CONTROLLERS:
-        assert env.registry.gauge(
-            "karpenter_tpu_controller_healthy", {"controller": name}
-        ) == 1.0, f"controller {name} unhealthy after recovery"
+    TTL 180s, GC grace 30s); raise on any invariant violation."""
+    scenario = chaos_soak_scenario(faulty_ticks)
+    scenario.shapes = SHAPES
+    runner = ScenarioRunner(scenario, seed=seed, ticks=total_ticks)
+    runner.run()  # a raising reconcile_once fails the soak
+    runner.checker.raise_on_violations()
+    return runner
 
 
 @pytest.mark.chaos
+@pytest.mark.sim
 def test_chaos_soak_short():
     """Tier-1 seeded soak: ~80 ticks, faults clear at tick 60."""
-    _assert_invariants(_soak(seed=7, faulty_ticks=60, total_ticks=80))
+    runner = _soak(seed=7, faulty_ticks=60, total_ticks=80)
+    # the scenario actually exercised the cluster (guards against the
+    # scenario silently degenerating into a no-op run)
+    report = build_report(runner)
+    assert report["pods"]["created"] > 10
+    assert report["nodes"]["launched"] > 0
+    assert report["invariants"]["checked_ticks"] >= 80
 
 
 @pytest.mark.chaos
+@pytest.mark.sim
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_chaos_soak_long(seed):
     """The multi-hundred-tick soak (slow): 300 ticks, faults clear at 240."""
-    _assert_invariants(_soak(seed=seed, faulty_ticks=240, total_ticks=300))
+    _soak(seed=seed, faulty_ticks=240, total_ticks=300)

@@ -1,15 +1,21 @@
 """Lint/type gate (round-2 VERDICT hygiene item): no external linter is
-baked into the image, so this enforces the two checks that catch real rot:
+baked into the image, so this enforces the checks that catch real rot:
 
-1. every module under karpenter_tpu/ imports cleanly, and
+1. every module under karpenter_tpu/ imports cleanly,
 2. `typing.get_type_hints` resolves on every public function/method —
    which fails on annotations referencing names that were never imported
-   (the `Optional`-without-import bug class).
+   (the `Optional`-without-import bug class), and
+3. no direct `time.time()` / `time.sleep()` outside utils/clock.py — the
+   simulator's determinism contract: all time flows through the
+   injectable Clock, so a FakeClock compresses every wait and two equal
+   seeds replay byte-identically (docs/designs/simulation.md).
 """
 
 import importlib
 import inspect
+import pathlib
 import pkgutil
+import re
 import typing
 
 import karpenter_tpu
@@ -48,3 +54,38 @@ def test_annotations_resolve():
             except Exception:
                 pass  # forward refs to runtime-only types are fine
     assert not failures, "\n".join(failures)
+
+
+# the genuinely-wall-clock spots: the Clock abstraction itself is the one
+# place allowed to read the wall.  (time.monotonic/perf_counter remain
+# free — they measure host-side durations like batcher windows and solver
+# phases, which no simulated clock can compress.)
+_WALL_CLOCK_ALLOWLIST = {
+    "karpenter_tpu/utils/clock.py",
+}
+
+_WALL_CLOCK_RE = re.compile(r"\btime\.(?:time|sleep)\s*\(")
+
+
+def test_no_wall_clock_outside_clock_module():
+    """Determinism contract: `time.time()`/`time.sleep()` only inside
+    utils/clock.py (or the explicit allowlist).  Everything else takes an
+    injected Clock, so the cluster simulator can run the whole stack on a
+    FakeClock and replay a seed byte-identically."""
+    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(pkg_root.parent).as_posix()
+        if rel in _WALL_CLOCK_ALLOWLIST:
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            code = line.split("#", 1)[0]
+            if _WALL_CLOCK_RE.search(code):
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "wall-clock calls outside utils/clock.py (route through the "
+        "injected Clock, or allowlist a genuinely-wall-clock spot):\n"
+        + "\n".join(offenders)
+    )

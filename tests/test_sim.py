@@ -1,0 +1,339 @@
+"""Cluster-simulator suite (karpenter_tpu/sim/): determinism (same seed
+twice -> byte-identical trace), trace record/replay round-trips, the
+invariant checker's teeth, scenario coverage, the CLI entry, and the
+satellite pieces that ride the sim PR (time-to-schedule histogram,
+RemoteKubeStore on the injected clock)."""
+
+import json
+import os
+
+import pytest
+
+from karpenter_tpu.sim.invariants import (
+    InvariantChecker,
+    is_voluntary_disruption,
+)
+from karpenter_tpu.sim.report import build_report, percentile, wall_profile
+from karpenter_tpu.sim.runner import (
+    SCENARIOS,
+    Scenario,
+    ScenarioRunner,
+    replay,
+    run_scenario,
+)
+from karpenter_tpu.sim.trace import TraceWriter, read_tape, read_trace
+from karpenter_tpu.sim.workload import Script, SimEvent, Steady, poisson
+
+
+# --------------------------------------------------------------- determinism
+@pytest.mark.sim
+def test_same_seed_twice_is_byte_identical():
+    """The determinism contract: two runs of the same scenario/seed/ticks
+    produce byte-identical traces and equal reports."""
+    w1 = TraceWriter()
+    _, r1 = run_scenario("steady", seed=3, ticks=40, trace=w1)
+    w2 = TraceWriter()
+    _, r2 = run_scenario("steady", seed=3, ticks=40, trace=w2)
+    assert w1.text() == w2.text()
+    assert w1.sha256() == w2.sha256()
+    assert r1 == r2
+    assert r1["invariants"]["violations"] == []
+    # different seed actually changes the run (the RNG is wired through)
+    w3 = TraceWriter()
+    _, r3 = run_scenario("steady", seed=4, ticks=40, trace=w3)
+    assert w3.text() != w1.text()
+
+
+@pytest.mark.sim
+def test_replay_reproduces_trace_and_report(tmp_path):
+    """A recorded trace replays with no generators in the loop and
+    reproduces the identical report AND identical trace bytes."""
+    path = str(tmp_path / "storm.jsonl")
+    w = TraceWriter(path)
+    _, original = run_scenario("interruption-storm", seed=5, ticks=60, trace=w)
+    assert original["invariants"]["violations"] == []
+    w2 = TraceWriter()
+    _, replayed, recorded = replay(path, trace=w2)
+    assert recorded == original  # the trace carries the report
+    assert replayed == original
+    assert w2.text() == open(path).read()
+
+
+@pytest.mark.sim
+def test_trace_structure(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    w = TraceWriter(path)
+    run_scenario("steady", seed=1, ticks=10, trace=w)
+    lines = read_trace(path)
+    kinds = {line["t"] for line in lines}
+    assert kinds == {"meta", "tick", "ev", "api", "dig", "report"}
+    meta = lines[0]
+    assert meta == {
+        "t": "meta", "v": 1, "scenario": "steady", "seed": 1,
+        "ticks": 10, "tick_s": 1.0,
+    }
+    # every run tick has a digest; the api stream is non-empty
+    digs = [l for l in lines if l["t"] == "dig"]
+    assert len(digs) >= 10 and all("sha" in d for d in digs)
+    assert any(l["api"] == "CreateFleet" for l in lines if l["t"] == "api")
+    # the tape round-trips the event schedule
+    meta2, tape, slo = read_tape(path)
+    assert meta2["scenario"] == "steady" and slo is not None
+    assert sum(len(evs) for _, evs in tape.values()) == sum(
+        1 for l in lines if l["t"] == "ev"
+    )
+
+
+# ----------------------------------------------------------------- scenarios
+@pytest.mark.sim
+@pytest.mark.parametrize(
+    "name,ticks",
+    [
+        ("diurnal", 100),
+        ("api-storm+catalog-roll", 100),
+        ("batch-waves", 50),
+        ("flash-crowd", 50),
+    ],
+)
+def test_scenario_invariants(name, ticks):
+    """Every registered scenario runs clean: no invariant violations, and
+    the run actually exercised the cluster."""
+    runner, report = run_scenario(name, seed=7, ticks=ticks)
+    assert report["invariants"]["violations"] == []
+    assert report["pods"]["created"] > 0
+    assert report["nodes"]["launched"] > 0
+    assert report["pending"]["final"] == 0
+    assert report["cost_usd"]["total"] > 0
+    assert report["time_to_schedule_s"]["p95"] >= report["time_to_schedule_s"]["p50"]
+
+
+@pytest.mark.sim
+@pytest.mark.slow
+def test_diurnal_interruption_storm_500_ticks():
+    """The long one: 500 ticks of day/night load with a capacity-reclaim
+    storm at peak, partial fleet fulfillment riding along."""
+    runner, report = run_scenario(
+        "diurnal+interruption-storm", seed=11, ticks=500
+    )
+    assert report["invariants"]["violations"] == []
+    assert report["events"].get("spot_interruption", 0) > 10
+    assert report["pods"]["created"] > 100
+    assert report["pending"]["final"] == 0
+
+
+# ---------------------------------------------------------------- invariants
+@pytest.mark.sim
+def test_invariant_checker_catches_double_launch():
+    runner, _ = run_scenario("steady", seed=2, ticks=10)
+    env = runner.env
+    claims = [c for c in env.kube.node_claims.values() if c.provider_id]
+    assert claims, "scenario should have launched something"
+    # forge a duplicate: a second claim backed by the same instance
+    from karpenter_tpu.api import NodeClaim
+
+    env.kube.node_claims["forged"] = NodeClaim(
+        name="forged", pool_name="default", provider_id=claims[0].provider_id
+    )
+    checker = InvariantChecker(env)
+    checker.check_tick(0)
+    assert any(v.invariant == "no-double-launch" for v in checker.violations)
+
+
+@pytest.mark.sim
+def test_invariant_checker_catches_ghost_node():
+    runner, _ = run_scenario("steady", seed=2, ticks=10)
+    env = runner.env
+    from karpenter_tpu.state.kube import Node
+
+    env.kube.nodes["ghost"] = Node(name="ghost", provider_id="i-never-was")
+    checker = InvariantChecker(env)
+    checker.check_tick(0)
+    assert any(
+        v.invariant == "registered-eq-launched" for v in checker.violations
+    )
+
+
+@pytest.mark.sim
+def test_invariant_checker_catches_schedule_deadline():
+    runner, _ = run_scenario("steady", seed=2, ticks=5)
+    env = runner.env
+    from karpenter_tpu.api import Pod, Resources
+
+    pod = Pod(name="stuck", requests=Resources(cpu=1, memory="1Gi"))
+    env.kube.put_pod(pod)
+    checker = InvariantChecker(env, deadline_s=100.0)
+    checker.note_pod(pod.key())
+    env.clock.step(101.0)
+    checker.check_tick(0)
+    assert any(
+        v.invariant == "schedule-deadline" for v in checker.violations
+    )
+
+
+@pytest.mark.sim
+def test_voluntary_disruption_classification():
+    for reason in ("expired", "drifted/image", "emptiness",
+                   "consolidation/delete", "consolidation/multi"):
+        assert is_voluntary_disruption(reason)
+    for reason in ("spot_interruption", "rebalance_recommendation",
+                   "consolidation/rollback", "state_change"):
+        assert not is_voluntary_disruption(reason)
+
+
+@pytest.mark.sim
+def test_budget_invariant_holds_under_tight_budgets():
+    """A scenario that tightens budgets mid-run and rolls the catalog
+    (drift pressure) must never disrupt past the budget — the checker
+    wraps the controller's own budget arithmetic, so a violation here is
+    a real controller bug."""
+    _, report = run_scenario("api-storm+catalog-roll", seed=13, ticks=80)
+    assert not any(
+        "budgets" in v for v in report["invariants"]["violations"]
+    )
+
+
+# ------------------------------------------------------------------- report
+@pytest.mark.sim
+def test_percentile_nearest_rank():
+    assert percentile([], 0.5) == 0.0
+    assert percentile([3.0], 0.99) == 3.0
+    xs = [float(i) for i in range(1, 101)]
+    assert percentile(xs, 0.50) == 51.0  # nearest-rank on 0-indexed ranks
+    assert percentile(xs, 0.95) == 95.0
+    assert percentile(xs, 1.0) == 100.0
+
+
+@pytest.mark.sim
+def test_time_to_schedule_histogram_feeds_report():
+    runner, report = run_scenario("steady", seed=9, ticks=30)
+    samples = runner.env.registry.histogram(
+        "karpenter_pods_time_to_schedule_seconds"
+    )
+    assert samples, "pods scheduled -> histogram must have samples"
+    assert all(s >= 0 for s in samples)
+    assert report["time_to_schedule_s"]["scheduled"] == len(samples)
+    # profile section is separate and explicitly wall-clock
+    prof = wall_profile(runner.env.registry)
+    assert prof["wall_clock"] is True
+    assert prof["solver_phases"], "solves happened -> phases recorded"
+
+
+# ---------------------------------------------------------------------- CLI
+@pytest.mark.sim
+def test_cli_run_and_replay(tmp_path, capsys):
+    from karpenter_tpu.sim.cli import main
+
+    trace = str(tmp_path / "cli.jsonl")
+    rc = main(
+        ["--scenario", "steady", "--seed", "1", "--ticks", "25",
+         "--trace", trace]
+    )
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["scenario"] == "steady"
+    assert report["invariants"]["violations"] == []
+    assert os.path.exists(trace)
+
+    replay_trace = str(tmp_path / "cli.replay.jsonl")
+    rc = main(["--replay", trace, "--trace", replay_trace])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert json.loads(captured.out) == report
+    assert "matches" in captured.err
+    assert open(trace).read() == open(replay_trace).read()
+
+
+@pytest.mark.sim
+def test_cli_list_and_unknown_scenario(capsys):
+    from karpenter_tpu.sim.cli import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in ("steady", "diurnal", "interruption-storm",
+                 "api-storm+catalog-roll", "diurnal+interruption-storm",
+                 "chaos-soak"):
+        assert name in out
+    assert main(["--scenario", "nope", "--ticks", "1"]) == 64
+
+
+# ------------------------------------------------------------------ workload
+@pytest.mark.sim
+def test_poisson_sampler_seeded():
+    import random
+
+    rng = random.Random(0)
+    draws = [poisson(rng, 0.5) for _ in range(200)]
+    assert all(d >= 0 for d in draws)
+    assert 0.3 < sum(draws) / len(draws) < 0.8  # mean ~0.5
+    assert poisson(rng, 0.0) == 0
+
+
+@pytest.mark.sim
+def test_unknown_event_kind_is_an_error():
+    runner = ScenarioRunner(
+        Scenario("x", workloads=[Steady(rate=0.0)]), seed=0, ticks=1
+    )
+    with pytest.raises(ValueError, match="unknown sim event kind"):
+        runner.apply_event(SimEvent("frobnicate", {}))
+
+
+@pytest.mark.sim
+def test_custom_scenario_with_az_blackout():
+    """The DSL composes: an AZ goes dark mid-run and heals; capacity
+    relocates and every invariant still holds."""
+    scn = Scenario(
+        "az-test",
+        workloads=[
+            Steady(rate=0.6),
+            Script({
+                10: [("az_down", {"zone": "zone-a"})],
+                25: [("az_up", {"zone": "zone-a"})],
+            }),
+        ],
+    )
+    runner = ScenarioRunner(scn, seed=21, ticks=50)
+    runner.run()
+    runner.checker.raise_on_violations()
+    report = build_report(runner)
+    assert report["events"]["az_down"] == 1
+    assert report["pending"]["final"] == 0
+    # the blackout actually bit: zone-a lost its instances at tick 10
+    assert report["nodes"]["launched"] > 0
+
+
+# ------------------------------------------------ satellite: remote on Clock
+def test_remote_store_backoff_rides_injected_clock():
+    """RemoteKubeStore's retry backoff sleeps on the injectable Clock:
+    under a FakeClock the retries advance SIMULATED time (remote.py no
+    longer calls time.sleep — enforced by test_lint.py's wall-clock
+    rule)."""
+    from karpenter_tpu.state.remote import (
+        BACKOFF_S,
+        RETRIES,
+        RemoteKubeStore,
+        StoreUnavailableError,
+    )
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    t0 = clock.now()
+    store = RemoteKubeStore(
+        "127.0.0.1", 1, start_watch=False, clock=clock, connect_timeout=0.2
+    )
+    with pytest.raises(StoreUnavailableError):
+        store._rpc({"method": "stat"})
+    expected = sum(BACKOFF_S * (2 ** a) for a in range(RETRIES - 1))
+    assert clock.now() - t0 == pytest.approx(expected)
+    store.close()
+
+
+def test_remote_wait_synced_times_out_on_injected_clock():
+    from karpenter_tpu.state.remote import RemoteKubeStore
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    store = RemoteKubeStore("127.0.0.1", 1, start_watch=False, clock=clock)
+    t0 = clock.now()
+    assert store.wait_synced(min_rv=5, timeout=1.0) is False
+    assert clock.now() - t0 >= 1.0  # the poll waited on the fake clock
+    store.close()
