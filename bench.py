@@ -16,6 +16,11 @@ Configs (BASELINE.md "Benchmark configs to reproduce"):
 4. consolidation: repack 5k running pods through
    ``DisruptionController._simulate`` (the scheduling simulation the
    deprovisioner runs per candidate set).
+4b. consolidation sweep: the single-node what-if scan over ~60
+   candidates — the BATCHED path (one compiled base + one vmapped
+   verdict dispatch, ``TensorScheduler.evaluate_removals``) measured
+   against the sequential per-candidate path on the same snapshot; the
+   line carries ``sequential_ms`` and ``speedup_vs_sequential``.
 5. multi-pool weighted priority + spot price-aware selection.
 6. (extra) hybrid split cost: 9.5k tensor pods + 500 oracle-only pods
    (LIVE-MEMBER co-location: groups that must JOIN nodes their members
@@ -654,6 +659,79 @@ def run_consolidation_repack() -> None:
 
 
 # ---------------------------------------------------------------------------
+# config 4b: the single-node consolidation scan — batched vs sequential
+# ---------------------------------------------------------------------------
+
+
+def run_consolidation_sweep() -> None:
+    """The deprovisioner's single-node what-if scan over ~60 candidates:
+    the BATCHED path (one cached base compile + one vmapped verdict
+    dispatch, `TensorScheduler.evaluate_removals`) measured against the
+    sequential per-candidate simulation on the SAME snapshot — the line
+    carries both numbers so the speedup is measured, not asserted."""
+    from karpenter_tpu.api import Disruption, Pod, Resources
+    from karpenter_tpu.cloud.fake.backend import generate_catalog
+    from karpenter_tpu.controllers.disruption import _RemovalEvaluator
+    from karpenter_tpu.testing import Environment
+
+    # small shapes so ~60 nodes come up and every node is a candidate
+    shapes = generate_catalog(generations=(1, 2), cpus=(4, 8))
+    env = Environment(shapes=shapes)
+    env.default_node_class()
+    env.default_node_pool(
+        disruption=Disruption(consolidation_policy="WhenUnderutilized")
+    )
+    sizes = [
+        Resources(cpu=0.5, memory="1Gi"),
+        Resources(cpu=1, memory="2Gi"),
+    ]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(_n(560))]
+    for p in pods:
+        env.kube.put_pod(p)
+    env.settle(max_rounds=80)
+    assert not env.kube.pending_pods(), len(env.kube.pending_pods())
+
+    dc = env.operator.disruption
+    dc._budgets = dc._remaining_budgets()
+    candidates = sorted(
+        (c for c in dc._candidates() if dc._consolidatable(c)),
+        key=lambda c: c.disruption_cost(),
+    )
+    n_cands = len(candidates)
+    inv = dc._pool_inventory()
+    sched = dc._scheduler
+    singles = [[c] for c in candidates]
+
+    def batched_sweep():
+        # fresh memo each sample (the base compile stays cached on the
+        # scheduler — that IS the batched path's warm production shape)
+        ev = _RemovalEvaluator(dc, candidates, inv)
+        ev.prefetch(singles)
+        for s in singles:
+            ev.result(s)
+
+    def sequential_sweep():
+        for s in singles:
+            dc._simulate(list(s), inv)
+
+    p50, noise, phases = _measure(
+        batched_sweep, phases_fn=lambda: sched.last_phases
+    )
+    # the label reports what actually ran: a whole-pass fallback (or a
+    # too-small candidate set) leaves last_removal_batch at 0
+    batched_ran = sched.last_removal_batch > 0
+    seq_p50, _, _ = _measure(sequential_sweep)
+    _emit(
+        "consolidation_sweep_60_candidates_p50", p50,
+        "batched" if batched_ran else "sequential", "scan", n_cands,
+        noise_ms=noise, phases=phases,
+        batch=sched.last_removal_batch,
+        sequential_ms=round(seq_p50, 2),
+        speedup_vs_sequential=round(seq_p50 / p50, 2) if p50 else None,
+    )
+
+
+# ---------------------------------------------------------------------------
 
 
 def _device_ms(
@@ -812,6 +890,7 @@ def _run_all() -> None:
     )
 
     run_consolidation_repack()
+    run_consolidation_sweep()
 
     pools, inventory, pods = build_multipool_spot()
     _run_scheduler_config(

@@ -31,6 +31,7 @@ pods without controllers) follow designs/consolidation.md:46-53.
 
 from __future__ import annotations
 
+import copy
 import logging
 import math
 from dataclasses import dataclass
@@ -40,8 +41,12 @@ from karpenter_tpu.api import NodeClaim, NodePool, Pod
 from karpenter_tpu.api import labels as L
 from karpenter_tpu.cloud.provider import CloudProvider
 from karpenter_tpu.controllers.termination import TerminationController
-from karpenter_tpu.metrics.registry import REGISTRY, Registry
-from karpenter_tpu.scheduling.solver import TensorScheduler
+from karpenter_tpu.metrics.registry import (
+    REGISTRY,
+    Registry,
+    export_compile_cache_counters,
+)
+from karpenter_tpu.scheduling.solver import RemovalCandidate, TensorScheduler
 from karpenter_tpu.state.cluster import Cluster, StateNode
 from karpenter_tpu.state.kube import KubeStore
 from karpenter_tpu.utils.clock import Clock
@@ -99,7 +104,189 @@ class Candidate:
         return (len(self.reschedulable), prio, cost, -self.price)
 
 
+class _RemovalEvaluator:
+    """Memoizing evaluation front-end for one consolidation pass.
+
+    Turns the pass's candidate what-ifs into batched device dispatches
+    (`TensorScheduler.evaluate_removals` — one compile + one vmapped pack
+    per batch) while preserving the sequential path's semantics exactly:
+
+    - memoization by candidate-name set, shared between the drop-one
+      descent, its prefix-scan floor, and the single-node scan;
+    - the evaluation BUDGET counts batch elements: every fresh element —
+      batched or sequential — bumps ``sims`` by one, so
+      MULTI_NODE_SIM_BUDGET means the same thing on both paths;
+    - elements the batch cannot answer bit-identically (`needs_host`
+      verdicts, or a whole-pass fallback reason) evaluate LAZILY through
+      the sequential `_simulate`, keeping the old early-exit behavior;
+    - the full decode (the replacement VirtualNode) runs host-side only
+      for the chosen winner (`vnode_for`), never per element.
+    """
+
+    def __init__(
+        self,
+        dc: "DisruptionController",
+        candidates: Sequence[Candidate],
+        pool_inventory: Tuple,
+    ):
+        self.dc = dc
+        self.pool_inventory = pool_inventory
+        self.sims = 0  # fresh evaluations, in batch ELEMENTS
+        # key -> (fits, price, vnode, authoritative) — authoritative
+        # entries came from the sequential decode; batched verdicts carry
+        # False and are re-confirmed before any ACTION (vnode_for)
+        self._memo: Dict[
+            frozenset, Tuple[bool, float, Optional[object], bool]
+        ] = {}
+        # the pass's candidate universe in RANK ORDER — every subset the
+        # controller evaluates is an order-preserving selection from it,
+        # which is what lets the batch replay each subset's compile order
+        self._universe = tuple(
+            RemovalCandidate(c.state.name, tuple(c.reschedulable))
+            for c in candidates
+        )
+
+    def _key(self, subset: Sequence[Candidate]) -> frozenset:
+        return frozenset(c.claim.name for c in subset)
+
+    def known(self, subset: Sequence[Candidate]) -> bool:
+        return self._key(subset) in self._memo
+
+    def _sync_scheduler(self) -> None:
+        """Point the simulation scheduler at the FULL remaining cluster
+        (sequential fallbacks re-aim it at per-subset remainders; the
+        batched base must always compile against the full set).  The
+        snapshot comes from the SAME helper `_simulate` uses, so the two
+        paths cannot silently diverge on what counts as remaining."""
+        dc = self.dc
+        pools, inventory = self.pool_inventory
+        dc._scheduler.update(
+            pools,
+            inventory,
+            existing=dc._remaining_snapshot(frozenset()),
+            daemonsets=dc.kube.daemonset_pods(),
+        )
+
+    def prefetch(self, subsets: Sequence[Sequence[Candidate]]) -> None:
+        """Batch-evaluate every not-yet-memoized subset in ONE device
+        dispatch.  `needs_host` elements stay unmemoized and resolve
+        lazily (sequentially) on their first `result` call.
+
+        Deliberately eager over the WHOLE set: in the dominant
+        steady-state pass nothing is acceptable and every subset gets
+        consumed anyway, so one full dispatch is strictly cheaper than
+        any evaluate-top-first hybrid, which would add a sequential host
+        solve to every no-action tick to save one dispatch on the rarer
+        accept tick."""
+        fresh_keys = set()
+        fresh: List[Sequence[Candidate]] = []
+        for s in subsets:
+            k = self._key(s)
+            if k in self._memo or k in fresh_keys:
+                continue
+            fresh_keys.add(k)
+            fresh.append(s)
+        if not fresh or not self.dc.use_batched_consolidation:
+            return
+        sched = self.dc._scheduler
+        if len(fresh) < sched.MIN_REMOVAL_BATCH:
+            return
+        self._sync_scheduler()
+        elements = [
+            [
+                RemovalCandidate(c.state.name, tuple(c.reschedulable))
+                for c in s
+            ]
+            for s in fresh
+        ]
+        verdicts = sched.evaluate_removals(elements, self._universe)
+        reg = self.dc.registry
+        if sched.last_removal_batch:
+            reg.observe(
+                "karpenter_consolidation_eval_batch_size",
+                sched.last_removal_batch,
+            )
+            # a SEPARATE family from karpenter_solver_phase_seconds: that
+            # histogram is the provisioner's per-solve anatomy, and mixing
+            # 60-element verdict batches into the same distribution would
+            # skew its percentiles (the sim wall-profile reads it too)
+            for phase_name, seconds in sched.last_phases.items():
+                reg.observe(
+                    "karpenter_consolidation_phase_seconds",
+                    seconds,
+                    {"phase": phase_name},
+                )
+        answered = 0
+        for s, v in zip(fresh, verdicts):
+            if v.needs_host:
+                continue
+            self._memo[self._key(s)] = (
+                v.fits, v.replacement_price, None, False,
+            )
+            self.sims += 1
+            answered += 1
+        if answered:
+            reg.inc(
+                "karpenter_consolidation_evals_total",
+                {"path": "batched"},
+                by=answered,
+            )
+
+    def result(self, subset: Sequence[Candidate]) -> Tuple[bool, float]:
+        """(fits, replacement_price) for one subset — memoized; evaluates
+        sequentially when the batch did not answer it."""
+        key = self._key(subset)
+        got = self._memo.get(key)
+        if got is None:
+            fits, price, vnode = self.dc._simulate(
+                list(subset), self.pool_inventory
+            )
+            got = self._memo[key] = (fits, price, vnode, True)
+            self.sims += 1
+            self.dc.registry.inc(
+                "karpenter_consolidation_evals_total",
+                {"path": "sequential"},
+            )
+        return got[0], got[1]
+
+    def vnode_for(
+        self, subset: Sequence[Candidate]
+    ) -> Tuple[bool, float, Optional[object]]:
+        """Full host-side decode for the CHOSEN subset — the result every
+        ACTION (delete or replace) must be derived from.  Sequential memo
+        entries are already authoritative; a batched verdict makes the
+        winner (and only the winner) re-run the sequential simulation,
+        with any disagreement counted and the sequential answer kept."""
+        key = self._key(subset)
+        got = self._memo.get(key)
+        if got is not None and got[3]:
+            return got[0], got[1], got[2]
+        full = self.dc._simulate(list(subset), self.pool_inventory)
+        if got is not None and (
+            got[0] != full[0] or abs(got[1] - full[1]) > 1e-9
+        ):
+            # a parity break between the batched verdict and the
+            # sequential decode — must never happen (the parity suite
+            # enforces it); act on the sequential result and surface it
+            log.warning(
+                "batched consolidation verdict mismatch for %s: "
+                "batched=%s sequential=%s",
+                sorted(key), got[:2], full[:2],
+            )
+            self.dc.registry.inc(
+                "karpenter_consolidation_verdict_mismatch_total"
+            )
+        self._memo[key] = (full[0], full[1], full[2], True)
+        return full
+
+
 class DisruptionController:
+    # batched what-if evaluation for consolidation (one compile + one
+    # vmapped device dispatch per candidate batch); False forces every
+    # simulation down the sequential per-subset path.  Decisions are
+    # bit-identical either way (tests/test_consolidation_batch.py).
+    use_batched_consolidation = True
+
     def __init__(
         self,
         kube: KubeStore,
@@ -125,6 +312,14 @@ class DisruptionController:
         # replacement pre-spin state
         self._pending: Dict[str, _PendingReplacement] = {}
         self._nominate_later: Dict[str, _Nomination] = {}
+        # compile-cache counter values already exported to the registry
+        self._cc_exported = (0, 0)
+        # pod key -> (orig pod, its epoch, resolved reqs, simulation copy):
+        # a pod whose stored volume requirements differ from the fresh
+        # resolution gets ONE stable copy reused across simulations and
+        # passes, instead of a new object (= new id churning the solver's
+        # id-keyed caches) per _simulate call
+        self._volume_copies: Dict[str, Tuple] = {}
 
     # ------------------------------------------------------------- reconcile
     def reconcile(self) -> None:
@@ -134,48 +329,63 @@ class DisruptionController:
         with self.registry.time(
             "karpenter_deprovisioning_evaluation_duration_seconds"
         ):
-            self._nominate_evicted()
-            # when a replacement just became ready (or rolled back), let the
-            # candidate drain + pod rebinding settle before CONSOLIDATING
-            # again — otherwise the just-ready, not-yet-populated
-            # replacement looks like an empty candidate and consolidation
-            # would delete the very node it pre-spun.  Expiration, drift and
-            # emptiness are not at risk (the replacement and nomination
-            # targets are in `protected`) and still run this pass.
-            reaped = self._reap_replacements()
-            self._budgets = self._remaining_budgets()
-            reserved = {
-                name
-                for pr in self._pending.values()
-                for name in pr.candidate_names
+            try:
+                self._reconcile_pass()
+            finally:
+                self._cc_exported = export_compile_cache_counters(
+                    self.registry, self._scheduler, "disruption",
+                    self._cc_exported,
+                )
+
+    def _reconcile_pass(self) -> None:
+        if self._volume_copies:
+            # drop simulation copies of pods that left the cluster
+            self._volume_copies = {
+                k: v for k, v in self._volume_copies.items()
+                if k in self.kube.pods
             }
-            # protect in-flight replacements until their nominated pods
-            # bind: the pre-spun claim itself, plus any node still the
-            # target of a pending nomination
-            protected = {pr.claim_name for pr in self._pending.values()}
-            protected |= {n.target for n in self._nominate_later.values()}
-            candidates = [
-                c
-                for c in self._candidates()
-                if c.claim.name not in reserved
-                and c.claim.name not in protected
-            ]
-            if self._expire(candidates):
-                return
-            if self.feature_gate_drift and self._drift(candidates):
-                return
-            if self._emptiness(candidates):
-                return
-            if reaped:
-                return
-            # consolidation only: a slow-registering replacement in pool A
-            # must not freeze consolidation in pool B (_launch_replacement
-            # enforces one in-flight replacement per TARGET pool), and a
-            # node holding in-flight pod nominations is not consolidatable
-            # (its usage is about to grow) — but it still expires/drifts
-            self._consolidate(
-                [c for c in candidates if not c.state.nominated]
-            )
+        self._nominate_evicted()
+        # when a replacement just became ready (or rolled back), let the
+        # candidate drain + pod rebinding settle before CONSOLIDATING
+        # again — otherwise the just-ready, not-yet-populated
+        # replacement looks like an empty candidate and consolidation
+        # would delete the very node it pre-spun.  Expiration, drift and
+        # emptiness are not at risk (the replacement and nomination
+        # targets are in `protected`) and still run this pass.
+        reaped = self._reap_replacements()
+        self._budgets = self._remaining_budgets()
+        reserved = {
+            name
+            for pr in self._pending.values()
+            for name in pr.candidate_names
+        }
+        # protect in-flight replacements until their nominated pods
+        # bind: the pre-spun claim itself, plus any node still the
+        # target of a pending nomination
+        protected = {pr.claim_name for pr in self._pending.values()}
+        protected |= {n.target for n in self._nominate_later.values()}
+        candidates = [
+            c
+            for c in self._candidates()
+            if c.claim.name not in reserved
+            and c.claim.name not in protected
+        ]
+        if self._expire(candidates):
+            return
+        if self.feature_gate_drift and self._drift(candidates):
+            return
+        if self._emptiness(candidates):
+            return
+        if reaped:
+            return
+        # consolidation only: a slow-registering replacement in pool A
+        # must not freeze consolidation in pool B (_launch_replacement
+        # enforces one in-flight replacement per TARGET pool), and a
+        # node holding in-flight pod nominations is not consolidatable
+        # (its usage is about to grow) — but it still expires/drifts
+        self._consolidate(
+            [c for c in candidates if not c.state.nominated]
+        )
 
     # ------------------------------------------------- replacement pre-spin
     def _nominate_evicted(self) -> None:
@@ -478,11 +688,21 @@ class DisruptionController:
         pool_candidates.sort(key=lambda c: c.disruption_cost())
         if not pool_candidates:
             return False
-        # multi-node first (bigger wins), then single-node scan
-        if self._consolidate_multi(pool_candidates):
+        # one inventory fetch AND one evaluation context for the whole
+        # pass: every simulation — multi-node descent, prefix floor,
+        # single-node scan — shares the pools/types snapshot and the
+        # memoized verdicts
+        ev = _RemovalEvaluator(
+            self, pool_candidates, self._pool_inventory()
+        )
+        # multi-node first (bigger wins), then single-node scan — the
+        # whole scan is ONE batched dispatch, answered lazily in rank
+        # order so the first acceptable candidate still wins
+        if self._consolidate_multi(pool_candidates, ev):
             return True
+        ev.prefetch([[c] for c in pool_candidates])
         for c in pool_candidates:
-            if self._consolidate_single(c):
+            if self._consolidate_single(c, ev):
                 return True
         return False
 
@@ -503,21 +723,41 @@ class DisruptionController:
                 return False
         return True
 
-    def _consolidate_single(self, c: Candidate) -> bool:
-        fits, replacement_price, vnode = self._simulate([c])
+    def _consolidate_single(self, c: Candidate, ev: _RemovalEvaluator) -> bool:
+        fits, replacement_price = ev.result([c])
         if not fits:
             return False
-        if replacement_price == 0.0:
-            return self._disrupt(c, "consolidation/delete")
         # replacement must be strictly cheaper; spot nodes are delete-only
         # (deprovisioning.md:83-110)
-        if c.claim.capacity_type == L.CAPACITY_TYPE_SPOT:
+        if replacement_price > 0.0 and (
+            c.claim.capacity_type == L.CAPACITY_TYPE_SPOT
+            or replacement_price >= c.price
+        ):
             return False
-        if replacement_price < c.price:
-            return self._launch_replacement([c], vnode, "consolidation/replace")
-        return False
+        # the verdict accepted — but every ACTION derives from the
+        # winner's AUTHORITATIVE full decode (vnode_for re-runs the
+        # sequential simulation for batched verdicts and counts any
+        # disagreement), so a batched parity break can neither delete a
+        # node whose pods don't actually fit nor launch a replacement the
+        # sequential predicate would have rejected
+        fits2, price2, vnode = ev.vnode_for([c])
+        if not fits2:
+            return False
+        if price2 == 0.0:
+            return self._disrupt(c, "consolidation/delete")
+        if (
+            vnode is None
+            or c.claim.capacity_type == L.CAPACITY_TYPE_SPOT
+            or price2 >= c.price
+        ):
+            return False
+        return self._launch_replacement([c], vnode, "consolidation/replace")
 
-    def _consolidate_multi(self, ranked: Sequence[Candidate]) -> bool:
+    def _consolidate_multi(
+        self,
+        ranked: Sequence[Candidate],
+        ev: Optional[_RemovalEvaluator] = None,
+    ) -> bool:
         """Bounded SUBSET search over the top cost-ranked candidates: a
         whole candidate set whose pods fit on the remaining nodes plus at
         most one cheaper replacement (designs/consolidation.md
@@ -532,25 +772,18 @@ class DisruptionController:
         and repeat.  The descent is memoized and capped at
         MULTI_NODE_SIM_BUDGET simulations; the prefix-scan floor below
         may add up to MULTI_NODE_CANDIDATES-1 more on cache misses, so a
-        pass is bounded by the sum of the two, not the budget alone."""
+        pass is bounded by the sum of the two, not the budget alone.
+
+        Each descent level — the current set plus all its drop-one
+        children — evaluates as ONE batch (the budget counts batch
+        ELEMENTS, and memoized subsets never re-enter a batch), but the
+        results are consumed in the sequential order above, so the chosen
+        action is identical to the per-subset loop's."""
+        if ev is None:
+            ev = _RemovalEvaluator(self, list(ranked), self._pool_inventory())
         current = list(ranked[:MULTI_NODE_CANDIDATES])
         if len(current) < 2:
             return False
-        sims = 0
-        evaluated: Dict[frozenset, Tuple[bool, float, Optional[object]]] = {}
-        # one inventory fetch for the whole pass: every subset simulation
-        # sees the same pools/types, so don't rebuild them per _simulate
-        pool_inventory = self._pool_inventory()
-
-        def simulate(subset: List[Candidate]):
-            nonlocal sims
-            key = frozenset(c.claim.name for c in subset)
-            out = evaluated.get(key)
-            if out is None:
-                sims += 1
-                out = self._simulate(subset, pool_inventory)
-                evaluated[key] = out
-            return out
 
         def savings(subset: List[Candidate], rep_price: float) -> float:
             return sum(c.price for c in subset) - rep_price
@@ -564,45 +797,76 @@ class DisruptionController:
                 return False  # spot nodes are delete-only
             return rep_price < sum(c.price for c in subset)
 
-        while len(current) >= 2 and sims < MULTI_NODE_SIM_BUDGET:
-            fits, rep_price, vnode = simulate(current)
-            if acceptable(current, fits, rep_price):
-                return self._act_multi(current, rep_price, vnode)
-            best_child = None
-            best_gain = 0.0
-            best_result = (False, 0.0, None)
+        while len(current) >= 2 and ev.sims < MULTI_NODE_SIM_BUDGET:
+            # project the sequential path's budget walk — current first,
+            # then children in drop-index order until the budget would
+            # exhaust — so the batch holds exactly the subsets the
+            # per-subset loop would have simulated
+            consider: List[List[Candidate]] = []
+            proj = ev.sims + (0 if ev.known(current) else 1)
             for i in range(len(current)):
-                if sims >= MULTI_NODE_SIM_BUDGET:
+                if proj >= MULTI_NODE_SIM_BUDGET:
                     break
                 child = current[:i] + current[i + 1 :]
                 if len(child) < 2:
                     continue  # size-1 is the single-node scan's job
-                c_fits, c_price, c_vnode = simulate(child)
+                consider.append(child)
+                if not ev.known(child):
+                    proj += 1
+            ev.prefetch([current] + consider)
+            fits, rep_price = ev.result(current)
+            if acceptable(current, fits, rep_price):
+                return self._act_multi(current, rep_price, ev)
+            best_child = None
+            best_gain = 0.0
+            best_price = 0.0
+            for child in consider:
+                c_fits, c_price = ev.result(child)
                 if acceptable(child, c_fits, c_price):
                     gain = savings(child, c_price)
                     if best_child is None or gain > best_gain:
                         best_child = child
                         best_gain = gain
-                        best_result = (c_fits, c_price, c_vnode)
+                        best_price = c_price
             if best_child is not None:
-                _, rep_price, vnode = best_result
-                return self._act_multi(best_child, rep_price, vnode)
+                return self._act_multi(best_child, best_price, ev)
             current = current[:-1]  # trim the costliest-to-disrupt member
         # guaranteed floor: the old prefix scan (<= MULTI_NODE_CANDIDATES-1
         # sims, memoized against the descent above) so small prefixes are
         # still found when the drop-one budget runs out at large sizes
         pool = list(ranked[:MULTI_NODE_CANDIDATES])
-        for size in range(len(pool), 1, -1):
-            subset = pool[:size]
-            fits, rep_price, vnode = simulate(subset)
+        prefixes = [pool[:size] for size in range(len(pool), 1, -1)]
+        ev.prefetch(prefixes)
+        for subset in prefixes:
+            fits, rep_price = ev.result(subset)
             if acceptable(subset, fits, rep_price):
-                return self._act_multi(subset, rep_price, vnode)
+                return self._act_multi(subset, rep_price, ev)
         return False
 
     def _act_multi(
-        self, subset: List[Candidate], rep_price: float, vnode
+        self,
+        subset: List[Candidate],
+        rep_price: float,
+        ev: _RemovalEvaluator,
     ) -> bool:
-        if rep_price > 0 and vnode is not None:
+        # re-derive the whole action from the winner's AUTHORITATIVE full
+        # decode (see _consolidate_single): a counted verdict mismatch
+        # must neither delete nodes whose pods don't actually fit nor
+        # launch what the sequential predicate — strictly cheaper, spot
+        # delete-only — would have rejected
+        fits, price2, vnode = ev.vnode_for(subset)
+        if not fits:
+            return False
+        if price2 > 0:
+            if vnode is None:
+                return False
+            if any(
+                c.claim.capacity_type == L.CAPACITY_TYPE_SPOT
+                for c in subset
+            ):
+                return False
+            if price2 >= sum(c.price for c in subset):
+                return False
             return self._launch_replacement(
                 subset, vnode, "consolidation/multi"
             )
@@ -611,6 +875,25 @@ class DisruptionController:
             if self._disrupt(c, "consolidation/multi"):
                 acted = True
         return acted
+
+    def _remaining_snapshot(self, removed_names: frozenset) -> List[StateNode]:
+        """The cluster a removal simulation packs against: everything
+        live, minus the removed candidates, minus capacity that is
+        already spoken for — in-flight replacements and nomination
+        targets that haven't absorbed their pods yet (counting them as
+        free would let a second action double-book them).  The ONE
+        definition of "remaining" shared by the sequential `_simulate`
+        and the batched evaluator's base compile, so the two paths can
+        never diverge on what the cluster looks like."""
+        spoken_for = {pr.claim_name for pr in self._pending.values()}
+        spoken_for |= {n.target for n in self._nominate_later.values()}
+        return [
+            sn
+            for sn in self.cluster.snapshot()
+            if sn.name not in removed_names
+            and not sn.marked_for_deletion()
+            and sn.name not in spoken_for
+        ]
 
     def _pool_inventory(self):
         """(live pools, per-pool instance types) — fetched once per
@@ -632,30 +915,43 @@ class DisruptionController:
         replacement_price 0.0 means pure deletion suffices.  Reuses the
         tensor solver with the candidate nodes excluded from the snapshot
         (the same kernel the provisioner uses; SURVEY §7 step 7)."""
-        removed_names = {c.state.name for c in removed}
-        # in-flight replacements (and nomination targets that haven't
-        # absorbed their pods yet) are spoken-for capacity — counting them
-        # as free would let a second action double-book them
-        spoken_for = {pr.claim_name for pr in self._pending.values()}
-        spoken_for |= {n.target for n in self._nominate_later.values()}
-        remaining = [
-            sn
-            for sn in self.cluster.snapshot()
-            if sn.name not in removed_names
-            and not sn.marked_for_deletion()
-            and sn.name not in spoken_for
-        ]
+        remaining = self._remaining_snapshot(
+            frozenset(c.state.name for c in removed)
+        )
         pods = [p for c in removed for p in c.reschedulable]
         if not pods:
             return True, 0.0, None
         # a claim that bound since the pod last provisioned pins its zone;
-        # the repack must not move the pod away from its volume
+        # the repack must not move the pod away from its volume.  Resolve
+        # onto COPIES: these are shared LIVE pod objects, and writing the
+        # refreshed requirement in place would bump their mutation epoch —
+        # invalidating the PROVISIONER's compile cache from a pass that
+        # changed nothing it can see (tests/test_consolidation_batch.py
+        # asserts the cache stays warm across a consolidation pass)
         from karpenter_tpu.controllers.provisioning import (
-            resolve_volume_requirements,
+            volume_zone_requirements,
         )
 
+        sim_pods = []
         for p in pods:
-            resolve_volume_requirements(p, self.kube)
+            new = volume_zone_requirements(p, self.kube)
+            if new is None or new == p.volume_requirements:
+                sim_pods.append(p)
+                continue
+            ent = self._volume_copies.get(p.key())
+            if (
+                ent is not None
+                and ent[0] is p
+                and ent[1] == p.mutation_epoch()
+                and ent[2] == new
+            ):
+                sim_pods.append(ent[3])
+                continue
+            q = copy.copy(p)
+            q.volume_requirements = new
+            self._volume_copies[p.key()] = (p, p.mutation_epoch(), new, q)
+            sim_pods.append(q)
+        pods = sim_pods
         pools, inventory = pool_inventory or self._pool_inventory()
         scheduler = self._scheduler.update(
             pools,

@@ -36,7 +36,11 @@ from karpenter_tpu.api import (
 from karpenter_tpu.api import labels as L
 from karpenter_tpu.cloud.provider import CloudProvider
 from karpenter_tpu.errors import is_insufficient_capacity
-from karpenter_tpu.metrics.registry import REGISTRY, Registry
+from karpenter_tpu.metrics.registry import (
+    REGISTRY,
+    Registry,
+    export_compile_cache_counters,
+)
 from karpenter_tpu.scheduling.scheduler import SchedulingResult, VirtualNode
 from karpenter_tpu.scheduling.solver import TensorScheduler
 from karpenter_tpu.state.cluster import Cluster
@@ -126,6 +130,10 @@ class Provisioner:
         # karpenter_pods_time_to_schedule_seconds histogram (first-seen ->
         # nominated); the sim's SLO report reads its samples
         self._first_seen: Dict[str, float] = {}
+        # compile-cache counter values already exported to the registry
+        # (the scheduler counts monotonically; the registry counter gets
+        # the per-reconcile delta)
+        self._cc_exported = (0, 0)
 
     # -------------------------------------------------------------- reconcile
     def reconcile(self) -> List[NodeClaim]:
@@ -217,6 +225,9 @@ class Provisioner:
                 seconds,
                 {"phase": phase_name},
             )
+        self._cc_exported = export_compile_cache_counters(
+            self.registry, scheduler, "provisioner", self._cc_exported
+        )
         for pod_key, reason in result.unschedulable.items():
             self.kube.record_event("Pod", "FailedScheduling", pod_key, reason)
         # nominate pods placed on existing nodes (the kube-scheduler binds)
@@ -345,18 +356,20 @@ class Provisioner:
         return it.capacity if it is not None else vn.used
 
 
-def resolve_volume_requirements(pod: Pod, kube) -> None:
-    """Refresh a pod's volume-derived zone requirements before a solve.
+def volume_zone_requirements(pod: Pod, kube):
+    """The pod's CURRENT volume-derived zone requirements, recomputed from
+    the PVC/StorageClass state: bound claims pin the volume's zone, unbound
+    WaitForFirstConsumer claims admit the storage class's allowed
+    topologies (reference website v0.31 concepts/scheduling.md:387-411).
 
-    Bound claims pin the volume's zone; unbound WaitForFirstConsumer
-    claims admit the storage class's allowed topologies (reference website
-    v0.31 concepts/scheduling.md:387-411).  Idempotent — the field is
-    REPLACED each pass, so a claim that bound since the last solve
-    tightens the requirement instead of stacking."""
+    Returns None for pods without volume claims (nothing to resolve), else
+    the fresh requirement list — the caller decides whether/where to store
+    it (the provisioner writes it onto its own pending pods; consolidation
+    simulations resolve onto COPIES so shared live pods stay untouched)."""
     from karpenter_tpu.api.requirements import Op, Requirement
 
     if not pod.volume_claims:
-        return
+        return None
     zones = None
     for cname in pod.volume_claims:
         pvc = kube.pvcs.get(f"{pod.namespace}/{cname}")
@@ -371,12 +384,21 @@ def resolve_volume_requirements(pod: Pod, kube) -> None:
             z = set(sc.zones)
         zones = z if zones is None else zones & z
     if zones is None:
-        new = []
-    else:
-        # an empty intersection compiles to an unsatisfiable requirement,
-        # surfacing the conflict as an unschedulable pod with a reason
-        new = [Requirement(L.LABEL_ZONE, Op.IN, sorted(zones))]
-    if new != pod.volume_requirements:
+        return []
+    # an empty intersection compiles to an unsatisfiable requirement,
+    # surfacing the conflict as an unschedulable pod with a reason
+    return [Requirement(L.LABEL_ZONE, Op.IN, sorted(zones))]
+
+
+def resolve_volume_requirements(pod: Pod, kube) -> None:
+    """Refresh a pod's volume-derived zone requirements before a solve.
+
+    Idempotent — the field is REPLACED each pass, so a claim that bound
+    since the last solve tightens the requirement instead of stacking; a
+    no-op recomputation skips the write entirely so the pod's mutation
+    epoch (and with it every identity-keyed compile cache) stays put."""
+    new = volume_zone_requirements(pod, kube)
+    if new is not None and new != pod.volume_requirements:
         pod.volume_requirements = new
 
 

@@ -136,5 +136,33 @@ def _fmt(labels: Tuple) -> str:
     return "{" + inner + "}"
 
 
+def export_compile_cache_counters(
+    registry: "Registry", scheduler, consumer: str, exported: Tuple[int, int]
+) -> Tuple[int, int]:
+    """Mirror a TensorScheduler's monotonic compile-cache hit/miss counts
+    into `karpenter_solver_compile_cache_{hits,misses}_total{consumer=}`.
+
+    The scheduler counts across its whole lifetime; each caller keeps the
+    pair it last exported and this bumps the registry by the delta, so the
+    registry counter stays a well-formed monotonic _total series even with
+    two consumers (provisioner, disruption) exporting independently.
+    Returns the new exported pair."""
+    hits, misses = scheduler.compile_cache_hits, scheduler.compile_cache_misses
+    prev_h, prev_m = exported
+    if hits > prev_h:
+        registry.inc(
+            "karpenter_solver_compile_cache_hits_total",
+            {"consumer": consumer},
+            by=hits - prev_h,
+        )
+    if misses > prev_m:
+        registry.inc(
+            "karpenter_solver_compile_cache_misses_total",
+            {"consumer": consumer},
+            by=misses - prev_m,
+        )
+    return (hits, misses)
+
+
 # process-global default registry (controllers accept an override)
 REGISTRY = Registry()

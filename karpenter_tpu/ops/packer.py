@@ -455,6 +455,177 @@ def expand_take(
     return out.reshape(shape)
 
 
+# ---------------------------------------------------------------------------
+# Batched what-if removal verdicts (consolidation's N simulations in ONE
+# dispatch — see docs/designs/consolidation-batching.md)
+# ---------------------------------------------------------------------------
+
+# verdict row layout ([B, RV_WIDTH] float32; int fields bit-exact in f32
+# range — every count/index here is far below 2**24)
+RV_LEFTOVER = 0  # placement units that fit nowhere
+RV_NEW_COUNT = 1  # freshly opened node slots
+RV_C_MIN = 2  # config row of the cheapest widen-equivalent alternate
+RV_MIN_PRICE = 3  # its (float32) price; +inf when the mask was empty
+RV_C_STAR = 4  # config row the kernel committed for the single new node
+RV_MERGE = 5  # 1.0 when decode compaction might merge >=2 new nodes to 1
+RV_WIDTH = 6
+
+
+@partial(jax.jit, static_argnames=("k_slots", "objective"))
+def removal_verdict_kernel(
+    req: jax.Array,  # [G, R] float32 — base class requests
+    maxper: jax.Array,  # [G] int32
+    slot: jax.Array,  # [G] int32
+    feas: jax.Array,  # [G, C] bool
+    alloc: jax.Array,  # [C, R] float32
+    price: jax.Array,  # [C] float32
+    openable: jax.Array,  # [C] bool
+    used0: jax.Array,  # [K, R] float32 — FULL remaining-cluster prefill
+    cfg0: jax.Array,  # [K] int32
+    npods0: jax.Array,  # [K] int32
+    next_slot0: jax.Array,  # int32 — first free slot (== live-node count)
+    sig0: jax.Array,  # [S, K] int32
+    pool_id: jax.Array,  # [C] int32 — -1 on existing/padding rows
+    zone_id: jax.Array,  # [C] int32
+    ct_id: jax.Array,  # [C] int32
+    compactable: jax.Array,  # [G] bool — class movable by decode compaction
+    cnt_b: jax.Array,  # [B, G] int32 — per-element counts, PERMUTED positions
+    rm_b: jax.Array,  # [B, K] bool — per-element removed-slot mask
+    perm_b: jax.Array,  # [B, G] int32 — per-element class order
+    *,
+    k_slots: int,
+    objective: str = "nodes",
+) -> jax.Array:
+    """One batched dispatch answering N what-if consolidation questions.
+
+    The base problem (classes over the candidate-universe pods, existing
+    rows over the FULL remaining cluster) is compiled and padded ONCE;
+    each batch element b expresses one candidate subset as
+
+    - ``rm_b[b]``: a removal mask over the node-slot axis — masked slots
+      get ``cfg0 = -1``, which zeroes their placement capacity exactly as
+      if the node were absent (first-fit slot ORDER of the survivors is
+      unchanged, so the packing equals the subset's own compile),
+    - ``cnt_b[b]``: the subset's reschedulable pods as per-class counts
+      (classes outside the subset are 0-count no-ops), and
+    - ``perm_b[b]``: the class order the subset's OWN compile would have
+      produced (first occurrence over its pod list) — the scan is order-
+      sensitive, so each element replays its sequential class order.
+
+    Only per-element VERDICT rows come back (see RV_* layout): fits /
+    new-node count / replacement price (computed with the decoder's
+    widen-equivalent alternate scan so the price matches
+    ``VirtualNode.cheapest_price()``), plus a donor flag marking the one
+    decode divergence (small-node compaction) the caller must resolve
+    host-side.  The full decode runs host-side only for the winner.
+    """
+    idx = jnp.arange(k_slots, dtype=jnp.int32)
+
+    def one(cnt_p, rm, perm):
+        feas_p = feas[perm]
+        res = _pack_core(
+            req[perm], cnt_p, maxper[perm], slot[perm], feas_p,
+            alloc, price, openable,
+            used0, jnp.where(rm, -1, cfg0), npods0, next_slot0, sig0,
+            k_slots=k_slots, objective=objective,
+        )
+        leftover_units = res.leftover.sum()
+        newmask = (idx >= next_slot0) & (res.node_pods > 0)
+        new_count = newmask.sum()
+        # single-new-node replacement price, widen-equivalent: min config
+        # price over { committed } ∪ { openable configs feasible for every
+        # class on the node, holding its final usage, sharing the
+        # committed pool/zone/capacity-type } — exactly the alternate set
+        # _add_alternate_types widens to, whose min VirtualNode.
+        # cheapest_price() reports on the sequential path
+        k_star = jnp.argmax(newmask)
+        c_star = jnp.maximum(res.node_cfg[k_star], 0)
+        on_new = res.take[:, k_star] > 0
+        class_feas = jnp.where(on_new[:, None], feas_p, True).all(axis=0)
+        fits_used = (
+            res.node_used[k_star][None, :] <= alloc + 1e-6
+        ).all(axis=1)
+        same = (
+            (pool_id == pool_id[c_star])
+            & (zone_id == zone_id[c_star])
+            & (ct_id == ct_id[c_star])
+        )
+        m = openable & class_feas & fits_used & same
+        masked = jnp.where(m, price, jnp.inf)
+        c_min = jnp.argmin(masked).astype(jnp.int32)
+        min_price = masked[c_min]
+        # decode-compaction escape hatch: a >=2-new-node result flips to
+        # "fits with one replacement" only if _compact_small_nodes can
+        # merge the new nodes down to ONE.  Necessary conditions, checked
+        # here so conclusive not-fits verdicts skip the host fallback: all
+        # but at most one new node is a donor (<= 8 placement units, every
+        # class on it movable), and SOME openable config feasible for
+        # every new-node class holds the union of all new-node load (the
+        # try_add probe can re-type a node through the widen machinery, so
+        # the absorber is not limited to its committed config).  The test
+        # is deliberately a superset of what compaction can really do —
+        # a spurious positive costs one host fallback, never a wrong
+        # verdict.
+        bad_k = ((res.take > 0) & (~compactable[perm])[:, None]).any(axis=0)
+        donor_k = newmask & (res.node_pods <= 8) & ~bad_k
+        n_nondonor = (newmask & ~donor_k).sum()
+        new_load = jnp.where(newmask[:, None], res.node_used, 0.0).sum(
+            axis=0
+        )
+        on_any_new = ((res.take > 0) & newmask[None, :]).any(axis=1)
+        all_new_feas = jnp.where(on_any_new[:, None], feas_p, True).all(
+            axis=0
+        )
+        hold = (
+            (new_load[None, :] <= alloc + 1e-6).all(axis=1)
+            & openable
+            & all_new_feas
+        ).any()
+        merge = (new_count >= 2) & (n_nondonor <= 1) & hold
+        return jnp.stack(
+            [
+                leftover_units.astype(jnp.float32),
+                new_count.astype(jnp.float32),
+                c_min.astype(jnp.float32),
+                min_price,
+                c_star.astype(jnp.float32),
+                merge.astype(jnp.float32),
+            ]
+        )
+
+    return jax.vmap(one)(cnt_b, rm_b, perm_b)
+
+
+def run_removal_verdicts(
+    padded_args: tuple,
+    k_slots: int,
+    pool_id: np.ndarray,
+    zone_id: np.ndarray,
+    ct_id: np.ndarray,
+    compactable: np.ndarray,
+    cnt_b: np.ndarray,
+    rm_b: np.ndarray,
+    perm_b: np.ndarray,
+    objective: str = "nodes",
+) -> np.ndarray:
+    """Dispatch the batched verdict kernel over pre-padded base args
+    (`pad_problem` output) and fetch the [B, RV_WIDTH] verdict matrix —
+    ONE device read for the whole batch.  The batch axis is padded to a
+    power-of-two bucket by the caller so XLA compiles once per shape."""
+    (req, _cnt, maxper, slot, feas, alloc, price, openable,
+     used0, cfg0, npods0, e0, sig0) = padded_args
+    with phase("dispatch"):
+        out = removal_verdict_kernel(
+            req, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, e0, sig0,
+            pool_id, zone_id, ct_id, compactable,
+            cnt_b, rm_b, perm_b,
+            k_slots=k_slots, objective=objective,
+        )
+    with phase("device_block"):
+        return np.asarray(out)
+
+
 # device-resident constant caches, keyed by source-array identity with the
 # sources pinned in the entry so the id-based key stays sound (the same
 # pattern as TensorScheduler's catalog cache).  Eviction is LRU: python

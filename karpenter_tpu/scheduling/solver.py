@@ -15,7 +15,7 @@ agnostic to which path solved the batch.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -65,6 +65,62 @@ def default_pack_fn():
 
         return mesh_pack_fn()
     return auto_pack
+
+
+class RemovalCandidate(NamedTuple):
+    """One consolidation candidate as the solver sees it: the live node's
+    name plus the pods a removal would have to reschedule."""
+
+    node_name: str
+    pods: Tuple[Pod, ...]
+
+
+class RemovalVerdict(NamedTuple):
+    """The answer to one what-if removal: do the subset's pods fit on the
+    remaining cluster plus at most ONE new node?
+
+    ``replacement_price`` is 0.0 when pure deletion suffices; when
+    ``needs_host`` is set the batched path could not answer bit-identically
+    (see docs/designs/consolidation-batching.md fallback conditions) and
+    the caller must run the sequential simulation for this element."""
+
+    fits: bool
+    replacement_price: float
+    needs_host: bool = False
+    reason: str = ""
+
+
+class _RemovalBase:
+    """One compiled-and-padded base problem for a consolidation pass:
+    classes over the candidate-universe pods, existing rows over the FULL
+    remaining cluster.  Every candidate subset then evaluates as a removal
+    mask + count vector over this ONE compile (or records the fallback
+    `reason` that sends the whole pass to the sequential path)."""
+
+    __slots__ = (
+        "reason", "empty", "prob", "args", "k_slots", "n_live",
+        "slot_of", "class_of", "pool_id", "zone_id", "ct_id",
+        "compactable", "compact_ok", "price_py", "gp", "kp", "sort_key",
+    )
+
+    def __init__(self, reason: str = "", empty: bool = False):
+        self.reason = reason
+        self.empty = empty
+        self.prob = None
+        self.args: tuple = ()
+        self.k_slots = 0
+        self.n_live = 0
+        self.slot_of: Dict[str, int] = {}
+        self.class_of: Dict[int, int] = {}
+        self.pool_id = None
+        self.zone_id = None
+        self.ct_id = None
+        self.compactable = None
+        self.compact_ok = False
+        self.price_py: List[float] = []
+        self.gp = 0
+        self.kp = 0
+        self.sort_key: Dict[int, float] = {}
 
 
 class TensorScheduler:
@@ -135,6 +191,12 @@ class TensorScheduler:
         self._last_fp = None
         self.compile_cache_hits = 0
         self.compile_cache_misses = 0
+        # batched consolidation what-ifs: one compiled base problem per
+        # candidate universe (same fingerprint machinery as the compile
+        # cache — a consolidation pass over an unchanged cluster re-serves
+        # the prior compile across descent levels AND across reconciles)
+        self._removal_cache: dict = {}
+        self.last_removal_batch = 0  # elements in the last batched dispatch
         # per-solve observability: wall-time breakdown by phase (seconds,
         # disjoint, summing to the solve's wall time) and which
         # continuation handled the oracle half ("join" = overlapped
@@ -171,6 +233,7 @@ class TensorScheduler:
             self._scan_memo.clear()
             # rolled inputs also obsolete every cached compilation
             self._compile_cache.clear()
+            self._removal_cache.clear()
         self.pools = list(pools)
         self.instance_types = instance_types
         self.existing = list(existing)
@@ -732,6 +795,280 @@ class TensorScheduler:
             (sup_groups, unsupported, prob, join_assign, compact_ok),
             pins,
         )
+
+    # ------------------------------------------------- batched removals
+    _REMOVAL_CACHE_CAP = 4
+    # below this many fresh elements a batched dispatch cannot beat the
+    # sequential path's (cached-compile) solve, so don't pay the jit
+    MIN_REMOVAL_BATCH = 2
+
+    def evaluate_removals(
+        self,
+        subsets: Sequence[Sequence[RemovalCandidate]],
+        universe: Sequence[RemovalCandidate],
+    ) -> List[RemovalVerdict]:
+        """Answer N consolidation what-ifs with ONE compile + ONE batched
+        device dispatch.
+
+        ``universe`` is the pass's full candidate set in RANK ORDER (every
+        subset must be an order-preserving selection from it — the drop-one
+        descent and the single-node scan both are); the base problem
+        compiles once against the solver's current ``existing`` (the full
+        remaining cluster) and is cached across calls and reconciles by
+        the same fingerprint machinery as the solve-level compile cache.
+        Each subset is a removal mask over the live-node axis plus its
+        pods toggled pending (per-class counts in the subset's own class
+        order), vmapped through the packing scan kernel; only per-element
+        verdicts are decoded (fits / new-node count / replacement price).
+        Elements the batch cannot answer bit-identically to the sequential
+        simulation come back ``needs_host`` — the caller runs those (and
+        only those) through the sequential path, so DECISIONS never differ
+        between the two paths.  Records the usual per-phase breakdown in
+        ``last_phases``."""
+        self.last_phases = phases = {}
+        with phase_collect(phases), phase("other"):
+            return self._evaluate_removals(
+                [list(s) for s in subsets], tuple(universe)
+            )
+
+    def _evaluate_removals(
+        self, subsets: List[List[RemovalCandidate]], universe: tuple
+    ) -> List[RemovalVerdict]:
+        from karpenter_tpu.ops.packer import (
+            RV_C_MIN,
+            RV_C_STAR,
+            RV_LEFTOVER,
+            RV_MERGE,
+            RV_NEW_COUNT,
+            RV_MIN_PRICE,
+            _bucket,
+            run_removal_verdicts,
+        )
+
+        self.last_removal_batch = 0  # only a real dispatch sets it
+        base = self._removal_base(universe)
+        if base.reason:
+            return [
+                RemovalVerdict(False, 0.0, True, base.reason) for _ in subsets
+            ]
+        if base.empty:
+            # no reschedulable pods anywhere in the universe: every subset
+            # trivially fits by pure deletion
+            return [RemovalVerdict(True, 0.0) for _ in subsets]
+        B = len(subsets)
+        Bp = _bucket(max(B, 1), floor=self.MIN_REMOVAL_BATCH)
+        gp, kp = base.gp, base.kp
+        with phase("pad"):
+            cnt_b = np.zeros((Bp, gp), np.int32)
+            rm_b = np.zeros((Bp, kp), bool)
+            perm_b = np.tile(np.arange(gp, dtype=np.int32), (Bp, 1))
+            bad: Dict[int, str] = {}
+            for i, subset in enumerate(subsets):
+                order: List[int] = []
+                seen = set()
+                counts: Dict[int, int] = {}
+                for cand in subset:
+                    slot = base.slot_of.get(cand.node_name)
+                    if slot is not None:
+                        rm_b[i, slot] = True
+                    # a candidate absent from the live rows was cordoned
+                    # away by the compile on BOTH paths — nothing to mask
+                    for p in cand.pods:
+                        g = base.class_of.get(id(p))
+                        if g is None:
+                            bad[i] = "pod outside the compiled universe"
+                            break
+                        if g not in seen:
+                            seen.add(g)
+                            order.append(g)
+                        counts[g] = counts.get(g, 0) + 1
+                    if i in bad:
+                        break
+                if i in bad:
+                    continue
+                # the subset's own compile orders classes by the FFD sort
+                # key (descending size; the base guards exclude every
+                # `constrained` shape) with ties in first-occurrence order
+                # over its pod list — replay that order exactly, the scan
+                # is order-sensitive
+                first_idx = {g: j for j, g in enumerate(order)}
+                order.sort(key=lambda g: (base.sort_key[g], first_idx[g]))
+                perm = order + [g for g in range(gp) if g not in seen]
+                perm_b[i] = np.asarray(perm, np.int32)
+                cnt_b[i] = np.asarray(
+                    [counts.get(g, 0) for g in perm], np.int32
+                )
+        verd = run_removal_verdicts(
+            base.args, base.k_slots,
+            base.pool_id, base.zone_id, base.ct_id, base.compactable,
+            cnt_b, rm_b, perm_b, objective=self.objective,
+        )
+        self.last_removal_batch = B
+        out: List[RemovalVerdict] = []
+        with phase("decode"):
+            for i in range(B):
+                if i in bad:
+                    out.append(RemovalVerdict(False, 0.0, True, bad[i]))
+                    continue
+                row = verd[i]
+                if row[RV_LEFTOVER] > 0:
+                    # unschedulable — exact: the base guards exclude every
+                    # relax-eligible constraint shape, so the sequential
+                    # path's relax-and-retry could not have rescued it
+                    out.append(RemovalVerdict(False, 0.0))
+                    continue
+                new_count = int(row[RV_NEW_COUNT])
+                if new_count == 0:
+                    out.append(RemovalVerdict(True, 0.0))
+                    continue
+                if new_count == 1:
+                    # widen-equivalent price: committed config, improved by
+                    # the cheapest alternate — read back as PYTHON floats
+                    # so the price equals the sequential decode's
+                    price = base.price_py[int(row[RV_C_STAR])]
+                    if np.isfinite(row[RV_MIN_PRICE]):
+                        price = min(
+                            price, base.price_py[int(row[RV_C_MIN])]
+                        )
+                    out.append(RemovalVerdict(True, float(price)))
+                    continue
+                if row[RV_MERGE] > 0 and base.compact_ok:
+                    # >= 2 new nodes that decode compaction might merge to
+                    # one — the only decode step the verdict cannot replay
+                    out.append(
+                        RemovalVerdict(False, 0.0, True, "compaction")
+                    )
+                    continue
+                out.append(RemovalVerdict(False, 0.0))
+        return out
+
+    def _removal_base(self, universe: tuple) -> _RemovalBase:
+        pods = [p for cand in universe for p in cand.pods]
+        fp = self._solve_fingerprint(pods)
+        if fp is not None:
+            ent = self._removal_cache.get(fp)
+            if ent is not None:
+                return ent[0]
+        with phase("partition"):
+            base = self._build_removal_base(universe, pods)
+        if fp is not None:
+            # pin every object the fingerprint's ids refer to (same
+            # aliasing contract as the solve-level compile cache)
+            pins = (
+                list(pods),
+                [list(sn.pods) for sn in self.existing],
+                tuple(self.pools),
+                tuple(self.instance_types.values()),
+                tuple(self.daemonsets),
+            )
+            if len(self._removal_cache) >= self._REMOVAL_CACHE_CAP:
+                self._removal_cache.pop(next(iter(self._removal_cache)))
+            self._removal_cache[fp] = (base, pins)
+        return base
+
+    def _build_removal_base(
+        self, universe: tuple, pods: List[Pod]
+    ) -> _RemovalBase:
+        from karpenter_tpu.ops.packer import pad_problem
+        from karpenter_tpu.ops.tensorize import BIG
+
+        if not pods:
+            return _RemovalBase(empty=True)
+        # constraint shapes whose per-subset behavior the mask batch cannot
+        # replay bit-identically: pod-level topology coupling (order- and
+        # set-dependent compile decisions), preference/OR-term carriers
+        # (the sequential path may relax them), and volume claims (the
+        # sequential path re-resolves zone pins per simulation)
+        for p in pods:
+            if (
+                p.pod_affinity
+                or p.topology_spread
+                or p.preferred_affinity
+                or len(p.node_affinity_terms()) > 1
+            ):
+                return _RemovalBase(reason="constraint-shape")
+            if p.volume_claims:
+                return _RemovalBase(reason="volume-claims")
+        names = {cand.node_name for cand in universe}
+        for sn in self.existing:
+            if sn.name in names and any(bp.pod_affinity for bp in sn.pods):
+                # a live (anti-)affinity carrier ON a candidate node: the
+                # sequential compile drops it with the node, the base
+                # compile would keep it — feasibility could differ
+                return _RemovalBase(reason="live-carrier-on-candidate")
+        sup_groups, unsupported, _why = partition_groups(
+            pods, existing=self.existing, pools=self.pools
+        )
+        if unsupported:
+            return _RemovalBase(reason="oracle-pods")
+        prob = self._compile_tensor(
+            [p for _, members in sup_groups for p in members], sup_groups
+        )
+        if not prob.supported:
+            return _RemovalBase(reason="compile-unsupported")
+        if prob.compile_relaxed:
+            return _RemovalBase(reason="compile-relaxed")
+        for cm in prob.classes:
+            if (
+                cm.group_size
+                or cm.zone_pin
+                or cm.rep_override is not None
+                or cm.pool_allow is not None
+            ):
+                return _RemovalBase(reason="macro-class")
+        if len(prob.cnt) and (prob.maxper < BIG).any():
+            return _RemovalBase(reason="tracked-signature")
+        base = _RemovalBase()
+        base.prob = prob
+        base.n_live = len(prob.used0)
+        # worst case every pod of the largest subset needs its own node;
+        # the universe total bounds every subset, so one padded K serves
+        # the whole pass and slot overflow is impossible
+        base.args, base.k_slots = pad_problem(
+            prob, k_slots=base.n_live + max(prob.total_pods(), 1)
+        )
+        base.gp = base.args[0].shape[0]
+        cp = base.args[5].shape[0]
+        base.kp = base.k_slots
+        base.slot_of = {
+            prob.configs[prob.cfg0[i]].existing.name: i
+            for i in range(base.n_live)
+        }
+        base.class_of = {
+            id(p): g for g, cm in enumerate(prob.classes) for p in cm.pods
+        }
+        pool_idx: Dict[str, int] = {}
+        zone_idx: Dict[str, int] = {}
+        ct_idx: Dict[str, int] = {}
+        pool_id = np.full(cp, -1, np.int32)
+        zone_id = np.full(cp, -1, np.int32)
+        ct_id = np.full(cp, -1, np.int32)
+        for c, cfg in enumerate(prob.configs):
+            if cfg.existing is not None:
+                continue
+            pool_id[c] = pool_idx.setdefault(cfg.pool.name, len(pool_idx))
+            zone_id[c] = zone_idx.setdefault(cfg.zone, len(zone_idx))
+            ct_id[c] = ct_idx.setdefault(
+                cfg.capacity_type, len(ct_idx)
+            )
+        base.pool_id, base.zone_id, base.ct_id = pool_id, zone_id, ct_id
+        compactable = np.zeros(base.gp, bool)
+        for g, cm in enumerate(prob.classes):
+            # decode compaction moves only label-less pods (the guards
+            # above already excluded every pod-level selector carrier)
+            compactable[g] = not cm.pods[0].labels
+            # the compile's FFD sort key (tensorize compile_problem
+            # class_key, `constrained` always False under the guards
+            # above): a subset's own compile re-sorts its classes by this
+            # key, ties in first-occurrence order
+            r = cm.requests
+            base.sort_key[g] = -(r.cpu + r.memory / (4 * 2**30))
+        base.compactable = compactable
+        base.compact_ok = self._compact_guard(pods)
+        base.price_py = [
+            float(cfg.price) for cfg in prob.configs
+        ]
+        return base
 
     def _plan_live_join(self, unsupported: List[Pod], assignments):
         """Validated placement plan for the oracle-only half when EVERY

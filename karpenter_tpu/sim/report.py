@@ -31,6 +31,27 @@ def _counter_family(registry, name: str) -> Dict[str, float]:
     return out
 
 
+def _consolidation_section(registry) -> dict:
+    """Batched-vs-sequential consolidation evaluation counts plus the
+    batch-size distribution — how much of the what-if work ran as single
+    device dispatches instead of per-subset solver round-trips."""
+    evals = {
+        (labels[0][1] if labels else ""): int(v)
+        for labels, v in registry.counters.get(
+            "karpenter_consolidation_evals_total", {}
+        ).items()
+    }
+    sizes = registry.histogram("karpenter_consolidation_eval_batch_size")
+    hist = registry.histograms.get(
+        "karpenter_consolidation_eval_batch_size", {}
+    ).get(())
+    return {
+        "evals": dict(sorted(evals.items())),
+        "batches": hist.count if hist is not None else 0,
+        "batch_size_p50": percentile(sizes, 0.5),
+    }
+
+
 def build_report(runner) -> dict:
     env = runner.env
     registry = env.registry
@@ -94,7 +115,30 @@ def build_report(runner) -> dict:
                 ct: round(v, 6) for ct, v in sorted(runner.cost_by_ct.items())
             },
         },
-        "solver": {"paths": dict(sorted(paths.items()))},
+        "solver": {
+            "paths": dict(sorted(paths.items())),
+            # deterministic in a sim run: the id/epoch fingerprints hit
+            # and miss on the same reconciles for equal seeds
+            "compile_cache": {
+                "hits": int(
+                    sum(
+                        _counter_family(
+                            registry,
+                            "karpenter_solver_compile_cache_hits_total",
+                        ).values()
+                    )
+                ),
+                "misses": int(
+                    sum(
+                        _counter_family(
+                            registry,
+                            "karpenter_solver_compile_cache_misses_total",
+                        ).values()
+                    )
+                ),
+            },
+        },
+        "consolidation": _consolidation_section(registry),
         "events": dict(sorted(runner.event_counts.items())),
         "invariants": {
             "checked_ticks": runner.checker.checked_ticks,

@@ -34,6 +34,7 @@ _GROUPS = [
     ("karpenter_cache_", "Cache"),
     ("karpenter_instancetype_", "Instance types"),
     ("karpenter_solver_", "Solver"),
+    ("karpenter_consolidation_", "Consolidation"),
     ("karpenter_sim_", "Simulator"),
 ]
 
@@ -111,6 +112,53 @@ _DETAILS = {
         "by the provisioning controller after every scheduling solve; see "
         "the 'solve latency anatomy' section in the README for how to "
         "read them",
+    ),
+    "karpenter_solver_compile_cache_hits_total": (
+        "counter",
+        "consumer",
+        "solves served from the TensorScheduler's incremental compile "
+        "cache, per consuming controller (provisioner, disruption); "
+        "exported as the delta of the scheduler's lifetime counter each "
+        "reconcile",
+    ),
+    "karpenter_solver_compile_cache_misses_total": (
+        "counter",
+        "consumer",
+        "solves that had to run the full host-side compile; a warm "
+        "steady-state cluster should see hits dominate — misses every "
+        "tick mean something (pods, pools, live nodes) is being mutated "
+        "in place",
+    ),
+    "karpenter_consolidation_eval_batch_size": (
+        "histogram",
+        "",
+        "candidate-subset elements per batched what-if dispatch "
+        "(TensorScheduler.evaluate_removals): the single-node scan is one "
+        "batch, each drop-one descent level is one batch",
+    ),
+    "karpenter_consolidation_phase_seconds": (
+        "histogram",
+        "phase",
+        "per-dispatch wall time of one batched-evaluation phase "
+        "(partition / compile / pad / dispatch / device_block / decode / "
+        "other) — kept separate from karpenter_solver_phase_seconds so "
+        "verdict batches don't skew the provisioner's per-solve "
+        "percentiles",
+    ),
+    "karpenter_consolidation_evals_total": (
+        "counter",
+        "path",
+        "consolidation what-if simulations by evaluation path: 'batched' "
+        "elements were answered on-device from one shared compile, "
+        "'sequential' elements ran the per-subset solver round-trip "
+        "(fallback conditions: docs/designs/consolidation-batching.md)",
+    ),
+    "karpenter_consolidation_verdict_mismatch_total": (
+        "counter",
+        "",
+        "batched verdicts contradicted by the winner's sequential decode "
+        "— must stay 0 (the parity suite enforces it); any movement is a "
+        "bug in the batched path",
     ),
 }
 

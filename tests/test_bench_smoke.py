@@ -63,6 +63,20 @@ class TestBenchSmoke:
     def test_flagship_prints_last(self, bench_lines):
         assert bench_lines[-1]["metric"] == "schedule_10k_pods_500_types_p50"
 
+    def test_consolidation_sweep_line(self, bench_lines):
+        """The batched-vs-sequential sweep line carries both measurements
+        (the speedup is measured in-bench, not asserted) plus the batch
+        size of the final dispatch."""
+        line = next(
+            l
+            for l in bench_lines
+            if l["metric"] == "consolidation_sweep_60_candidates_p50"
+        )
+        assert line["path"] in ("batched", "sequential")
+        assert line["sequential_ms"] > 0
+        assert line["batch"] >= 0
+        assert line["speedup_vs_sequential"] > 0
+
     def test_scale_restored_after_tiny_run(self, bench_lines):
         assert bench.SCALE == 1.0 and bench.ITERS == 21
 

@@ -1,0 +1,295 @@
+"""Batched consolidation what-ifs: parity, fallback, and cache hygiene.
+
+The contract under test (docs/designs/consolidation-batching.md): the
+batched evaluation path (`TensorScheduler.evaluate_removals` — one compiled
+base problem + one vmapped verdict dispatch per batch) must be
+DECISION-IDENTICAL to the sequential per-subset simulation
+(`DisruptionController._simulate`).  Elements the batch cannot answer
+bit-identically come back `needs_host` and run sequentially, so the only
+acceptable difference between the two paths is speed.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import (
+    Disruption,
+    PersistentVolumeClaim,
+    Pod,
+    Resources,
+)
+from karpenter_tpu.cloud.fake.backend import generate_catalog
+from karpenter_tpu.controllers.disruption import _RemovalEvaluator
+from karpenter_tpu.scheduling.solver import RemovalCandidate
+from karpenter_tpu.testing import Environment
+
+SIZES = [
+    Resources(cpu=0.5, memory="1Gi"),
+    Resources(cpu=1, memory="2Gi"),
+    Resources(cpu=2, memory="4Gi"),
+]
+
+
+def _build_env(seed: int, npods: int, cpus=(4, 8)) -> Environment:
+    from karpenter_tpu.api.objects import reset_name_sequences
+
+    reset_name_sequences()
+    env = Environment(shapes=generate_catalog(generations=(1, 2), cpus=cpus))
+    env.default_node_class()
+    env.default_node_pool(
+        disruption=Disruption(consolidation_policy="WhenUnderutilized")
+    )
+    rng = random.Random(seed)
+    for _ in range(npods):
+        env.kube.put_pod(Pod(requests=rng.choice(SIZES)))
+    env.settle(max_rounds=60)
+    assert not env.kube.pending_pods()
+    return env
+
+
+def _ranked_candidates(dc):
+    dc._budgets = dc._remaining_budgets()
+    return sorted(
+        (c for c in dc._candidates() if dc._consolidatable(c)),
+        key=lambda c: c.disruption_cost(),
+    )
+
+
+def _assert_parity(env, subsets):
+    """Every batched verdict equals the sequential simulation's; elements
+    the batch declined (`needs_host`) are exempt by construction — the
+    controller runs exactly the sequential path for them."""
+    dc = env.operator.disruption
+    cands = _ranked_candidates(dc)
+    inv = dc._pool_inventory()
+    ev = _RemovalEvaluator(dc, cands, inv)
+    ev._sync_scheduler()
+    elements = [
+        [RemovalCandidate(c.state.name, tuple(c.reschedulable)) for c in s]
+        for s in subsets
+    ]
+    verdicts = dc._scheduler.evaluate_removals(elements, ev._universe)
+    answered = 0
+    for s, v in zip(subsets, verdicts):
+        fits, price, _vn = dc._simulate(list(s), inv)
+        names = tuple(c.claim.name for c in s)
+        if v.needs_host:
+            continue
+        answered += 1
+        assert v.fits == fits, (names, v, (fits, price))
+        assert v.replacement_price == pytest.approx(price, abs=1e-9), (
+            names, v, (fits, price),
+        )
+    return answered, len(subsets)
+
+
+def _parity_subsets(cands):
+    """The shapes the controller actually evaluates: the single-node scan,
+    the prefix floor, and drop-one children of the top set."""
+    subsets = [[c] for c in cands]
+    subsets += [cands[:k] for k in range(2, min(len(cands), 8) + 1)]
+    top = cands[:6]
+    if len(top) > 2:
+        subsets += [top[:i] + top[i + 1:] for i in range(len(top))]
+    return subsets
+
+
+@pytest.mark.parametrize("seed,npods", [(0, 120), (2, 90)])
+def test_parity_seeded_cluster(seed, npods):
+    env = _build_env(seed, npods, cpus=(4, 8) if seed == 0 else (8, 16, 32))
+    cands = _ranked_candidates(env.operator.disruption)
+    assert len(cands) >= 3
+    answered, total = _assert_parity(env, _parity_subsets(cands))
+    # the batch must actually answer the bulk of the pass, or the whole
+    # mechanism is a fallback in disguise
+    assert answered >= total * 0.6, (answered, total)
+
+
+@pytest.mark.sim
+@pytest.mark.parametrize("scenario_name", ["diurnal", "chaos-soak"])
+def test_parity_sim_snapshot(scenario_name):
+    """Mid-run snapshots of real simulator scenarios: the batched verdicts
+    match the sequential simulations on the cluster states the scenarios
+    actually produce (chaos faults, diurnal churn), not just on synthetic
+    fixtures.  The stock catalog packs everything onto 1-2 large nodes, so
+    the scenario runs on small shapes with extra steady load — same
+    workloads, same chaos schedule, a fleet wide enough to consolidate."""
+    from karpenter_tpu.sim.runner import SCENARIOS, ScenarioRunner
+    from karpenter_tpu.sim.workload import Steady
+
+    scn = SCENARIOS[scenario_name](60)
+    scn.shapes = generate_catalog(generations=(1, 2), cpus=(4, 8))
+    scn.workloads.append(Steady(rate=2.0))
+    runner = ScenarioRunner(scn, seed=3, ticks=60)
+    for t in range(50):
+        events = [
+            ev
+            for w in scn.workloads
+            for ev in w.events(t, runner.rng, runner.view)
+        ]
+        dt = (
+            runner.rng.choice(list(scn.tick_jitter))
+            if scn.tick_jitter
+            else scn.tick_s
+        )
+        runner._tick(t, dt, "run", events)
+    env = runner.env
+    cands = _ranked_candidates(env.operator.disruption)
+    if len(cands) < 2:
+        pytest.skip("scenario snapshot produced too few candidates")
+    _assert_parity(env, _parity_subsets(cands))
+
+
+def test_forced_fallback_identical_decisions():
+    """Flipping the batched path off must not change ANY consolidation
+    decision: two identically-seeded clusters — one batched, one forced
+    sequential — take the same actions tick for tick."""
+    digests = []
+    for batched in (True, False):
+        env = _build_env(5, 110)
+        dc = env.operator.disruption
+        dc.use_batched_consolidation = batched
+        rng = random.Random(99)
+        keys = sorted(env.kube.pods.keys())
+        for key in rng.sample(keys, len(keys) // 2):
+            env.kube.delete_pod(key)
+        states = []
+        for _ in range(25):
+            env.clock.step(65)
+            env.step(2.0)
+            states.append(
+                (
+                    tuple(sorted(
+                        name
+                        for name, cl in env.kube.node_claims.items()
+                        if cl.deleted_at is not None
+                    )),
+                    tuple(sorted(dc._pending)),
+                    tuple(sorted(
+                        (p.key(), p.node_name or "")
+                        for p in env.kube.pods.values()
+                    )),
+                )
+            )
+        digests.append(states)
+        # the batched run must actually have used the batched path
+        evals = env.registry.counters.get(
+            "karpenter_consolidation_evals_total", {}
+        )
+        by_path = {k[0][1]: v for k, v in evals.items() if k}
+        if batched:
+            assert by_path.get("batched", 0) > 0, by_path
+        assert (
+            env.registry.counter(
+                "karpenter_consolidation_verdict_mismatch_total"
+            )
+            == 0
+        )
+    assert digests[0] == digests[1]
+
+
+def test_consolidation_pass_leaves_provisioner_cache_warm():
+    """Satellite regression: a consolidation pass must not mutate shared
+    live pods (volume re-resolution now lands on copies), so the
+    provisioner's identity+epoch compile-cache fingerprint still hits on
+    the next solve."""
+    env = Environment(shapes=generate_catalog(generations=(1, 2), cpus=(4, 8)))
+    env.default_node_class()
+    env.default_node_pool(
+        disruption=Disruption(consolidation_policy="WhenUnderutilized")
+    )
+    # an unbound WaitForFirstConsumer-style claim: at provisioning time it
+    # resolves to nothing, and BINDS to a zone afterwards — the exact case
+    # where consolidation's re-resolution differs from the stored value
+    env.kube.pvcs["default/pvc-1"] = PersistentVolumeClaim(name="pvc-1")
+    pods = [Pod(requests=Resources(cpu=1, memory="2Gi")) for _ in range(30)]
+    pods[0].volume_claims = ["pvc-1"]
+    for p in pods:
+        env.kube.put_pod(p)
+    env.settle(max_rounds=40)
+    assert not env.kube.pending_pods()
+    bound = env.kube.pods[pods[0].key()]
+    zone = env.kube.nodes[bound.node_name].labels.get(
+        "topology.kubernetes.io/zone", "zone-a"
+    )
+    env.kube.pvcs["default/pvc-1"].bound_zone = zone
+
+    dc = env.operator.disruption
+    epochs = {p.key(): p.mutation_epoch() for p in env.kube.pods.values()}
+    stored = list(bound.volume_requirements)
+    dc._budgets = dc._remaining_budgets()
+    cands = _ranked_candidates(dc)
+    assert any(
+        any(q.key() == bound.key() for q in c.reschedulable) for c in cands
+    )
+    inv = dc._pool_inventory()
+    for c in cands:
+        dc._simulate([c], inv)
+    dc._simulate(cands, inv)
+    # shared pods untouched: same epochs, same stored requirements — the
+    # freshly-bound zone was honored on a COPY inside the simulation
+    assert {
+        p.key(): p.mutation_epoch() for p in env.kube.pods.values()
+    } == epochs
+    assert list(bound.volume_requirements) == stored
+
+    # and the provisioner's compile cache stays warm across a full
+    # disruption reconcile: solve, reconcile disruption, solve again
+    prov = env.operator.provisioner
+    blocker = Pod(requests=Resources(cpu=10_000))  # pends forever
+    env.kube.put_pod(blocker)
+    prov.provision([blocker])
+    hits0 = prov.scheduler.compile_cache_hits
+    misses0 = prov.scheduler.compile_cache_misses
+    dc.reconcile()
+    prov.provision([blocker])
+    assert prov.scheduler.compile_cache_hits == hits0 + 1
+    assert prov.scheduler.compile_cache_misses == misses0
+
+
+def test_removal_base_cache_warm_across_calls():
+    """The batched base problem compiles ONCE per (universe, cluster
+    state): repeated evaluations — descent levels, single scan, repeat
+    reconciles over an unchanged cluster — reuse the cached compile."""
+    env = _build_env(1, 80)
+    dc = env.operator.disruption
+    cands = _ranked_candidates(dc)
+    assert len(cands) >= 2
+    ev = _RemovalEvaluator(dc, cands, dc._pool_inventory())
+    ev._sync_scheduler()
+    sched = dc._scheduler
+    universe = ev._universe
+    base1 = sched._removal_base(universe)
+    assert not base1.reason
+    base2 = sched._removal_base(universe)
+    assert base1 is base2
+    # an in-place pod mutation invalidates the fingerprint
+    cands[0].reschedulable[0].labels = {"mutated": "yes"}
+    base3 = sched._removal_base(universe)
+    assert base3 is not base1
+
+
+def test_consolidation_metrics_recorded():
+    env = _build_env(4, 100)
+    dc = env.operator.disruption
+    for _ in range(3):
+        env.clock.step(65)
+        env.step(2.0)
+    reg = env.registry
+    evals = reg.counters.get("karpenter_consolidation_evals_total", {})
+    assert sum(evals.values()) > 0
+    by_path = {k[0][1]: v for k, v in evals.items() if k}
+    assert by_path.get("batched", 0) > 0, by_path
+    sizes = reg.histogram("karpenter_consolidation_eval_batch_size")
+    assert sizes and max(sizes) >= 2
+    assert reg.counter("karpenter_consolidation_verdict_mismatch_total") == 0
+    # compile-cache visibility: both consumers export the _total series
+    assert (
+        sum(
+            reg.counters.get(
+                "karpenter_solver_compile_cache_misses_total", {}
+            ).values()
+        )
+        > 0
+    )

@@ -9,8 +9,14 @@ baked into the image, so this enforces the checks that catch real rot:
    simulator's determinism contract: all time flows through the
    injectable Clock, so a FakeClock compresses every wait and two equal
    seeds replay byte-identically (docs/designs/simulation.md).
+4. no `scheduler.update(...)` inside loops in controllers/ — the
+   serial-simulation antipattern batched consolidation removed (each
+   per-candidate update() busts the solver's compile cache and forces a
+   full host compile per subset); the sanctioned call sites are
+   allowlisted by qualified name.
 """
 
+import ast
 import importlib
 import inspect
 import pathlib
@@ -89,3 +95,110 @@ def test_no_wall_clock_outside_clock_module():
         "injected Clock, or allowlist a genuinely-wall-clock spot):\n"
         + "\n".join(offenders)
     )
+
+
+# the sanctioned scheduler.update call sites in controllers/: the
+# provisioner's one-per-solve refresh, the deprovisioner's sequential
+# simulation (the explicit fallback the batched path funnels through),
+# and the batched evaluator's once-per-pass full-cluster sync.  Any NEW
+# call site — especially one inside a per-candidate loop — must either
+# go through TensorScheduler.evaluate_removals or be consciously added
+# here.
+_SCHEDULER_UPDATE_ALLOWLIST = {
+    ("karpenter_tpu/controllers/provisioning.py", "Provisioner.provision"),
+    ("karpenter_tpu/controllers/disruption.py",
+     "DisruptionController._simulate"),
+    ("karpenter_tpu/controllers/disruption.py",
+     "_RemovalEvaluator._sync_scheduler"),
+}
+
+
+def scheduler_update_offenders(source: str, rel: str, allowlist):
+    """AST scan for `<...scheduler...>.update(...)` calls: every call
+    site must be allowlisted by (file, qualified name), and the report
+    marks the ones lexically inside a for/while loop — the per-candidate
+    serial-simulation pattern this rule exists to block."""
+    tree = ast.parse(source)
+    offenders = []
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self):
+            self.scope = []  # class/function name stack
+            self.loops = 0
+
+        def _scoped(self, node, push):
+            self.scope.append(push)
+            self.generic_visit(node)
+            self.scope.pop()
+
+        def visit_ClassDef(self, node):
+            self._scoped(node, node.name)
+
+        def visit_FunctionDef(self, node):
+            self._scoped(node, node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def _loop(self, node):
+            self.loops += 1
+            self.generic_visit(node)
+            self.loops -= 1
+
+        visit_For = visit_While = visit_AsyncFor = _loop
+
+        def visit_Call(self, node):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "update":
+                target = ast.unparse(f.value)
+                if "scheduler" in target.lower():
+                    qual = ".".join(self.scope)
+                    if (rel, qual) not in allowlist:
+                        where = "INSIDE A LOOP" if self.loops else "call"
+                        offenders.append(
+                            f"{rel}:{node.lineno}: {qual or '<module>'}: "
+                            f"{target}.update(...) [{where}]"
+                        )
+            self.generic_visit(node)
+
+    Visitor().visit(tree)
+    return offenders
+
+
+def test_no_scheduler_update_in_candidate_loops():
+    """Serial-simulation guard: scheduler.update() in controllers/ only at
+    the sanctioned sites — a per-candidate update loop re-compiles the
+    whole problem per subset, which is exactly what the batched
+    consolidation path (TensorScheduler.evaluate_removals) exists to
+    avoid (docs/designs/consolidation-batching.md)."""
+    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
+    offenders = []
+    for path in sorted((pkg_root / "controllers").glob("*.py")):
+        rel = path.relative_to(pkg_root.parent).as_posix()
+        offenders += scheduler_update_offenders(
+            path.read_text(), rel, _SCHEDULER_UPDATE_ALLOWLIST
+        )
+    assert not offenders, (
+        "unsanctioned scheduler.update() in controllers/ (batch the "
+        "simulations through TensorScheduler.evaluate_removals, or "
+        "allowlist a genuinely one-shot site):\n" + "\n".join(offenders)
+    )
+
+
+def test_scheduler_update_lint_has_teeth():
+    """The checker actually fires: a synthetic per-candidate update loop
+    is flagged (and tagged as in-loop), an allowlisted site is not."""
+    bad = (
+        "class C:\n"
+        "    def scan(self, cands):\n"
+        "        for c in cands:\n"
+        "            s = self._scheduler.update(c)\n"
+    )
+    hits = scheduler_update_offenders(
+        bad, "karpenter_tpu/controllers/x.py", _SCHEDULER_UPDATE_ALLOWLIST
+    )
+    assert len(hits) == 1 and "INSIDE A LOOP" in hits[0], hits
+    ok = scheduler_update_offenders(
+        bad, "karpenter_tpu/controllers/x.py",
+        {("karpenter_tpu/controllers/x.py", "C.scan")},
+    )
+    assert not ok, ok
