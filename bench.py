@@ -60,6 +60,13 @@ SCALE = 1.0
 WARMUP = 3
 ITERS = 21
 
+# regression gate for --compare: any budgeted line whose p50 grew by more
+# than this fraction over the prior bench file fails the run
+COMPARE_THRESHOLD = 0.25
+
+# every line _emit printed this run, as dicts — the --compare surface
+_LINES: List[dict] = []
+
 
 def _n(count: int) -> int:
     """A workload count at the current SCALE (>= 1 so every shape keeps
@@ -105,6 +112,7 @@ def _emit(
         pm = {k: round(v * 1000.0, 3) for k, v in phases.items()}
         pm["harness"] = round(max(0.0, p50_ms - sum(pm.values())), 3)
         line["phases"] = pm
+    _LINES.append(line)
     print(json.dumps(line), flush=True)
 
 
@@ -831,21 +839,107 @@ def _forced_pack(kind: str):
     return pack
 
 
-def main(tiny: bool = False) -> None:
+def _load_bench_lines(path: str) -> List[dict]:
+    """Prior bench lines from either a raw JSONL file (one _emit line per
+    row) or a BENCH_rNN.json driver artifact ({"tail": "...jsonl..."})."""
+    import pathlib
+
+    text = pathlib.Path(path).read_text()
+    try:
+        whole = json.loads(text)
+    except json.JSONDecodeError:
+        whole = None
+    if isinstance(whole, dict) and "tail" in whole:
+        text = whole["tail"]
+    elif isinstance(whole, dict) and "metric" in whole:
+        return [whole]
+    lines: List[dict] = []
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            obj = json.loads(raw)
+        except json.JSONDecodeError:
+            continue  # driver artifacts mix log noise into the tail
+        if isinstance(obj, dict) and "metric" in obj and "value" in obj:
+            lines.append(obj)
+    if not lines:
+        raise ValueError(f"no bench lines found in {path}")
+    return lines
+
+
+def compare_lines(
+    new: List[dict], old: List[dict], threshold: float = COMPARE_THRESHOLD
+) -> Tuple[List[str], List[str]]:
+    """Per-metric p50 deltas between two bench runs.
+
+    Returns (report rows, regressed metric names): a metric regresses
+    when its new p50 exceeds the old by more than ``threshold`` (25% by
+    default — well past the per-line ``noise_ms`` IQR on every config).
+    Metrics present on only one side are reported, never failed — a new
+    bench line must not break comparisons against older artifacts."""
+    old_by = {l["metric"]: l for l in old}
+    new_by = {l["metric"]: l for l in new}
+    rows: List[str] = []
+    regressed: List[str] = []
+    for metric, line in new_by.items():
+        prior = old_by.get(metric)
+        if prior is None:
+            rows.append(f"{metric:55s} {line['value']:9.2f}ms       (new line)")
+            continue
+        delta = line["value"] - prior["value"]
+        pct = (delta / prior["value"] * 100.0) if prior["value"] else 0.0
+        flag = ""
+        if prior["value"] and line["value"] > prior["value"] * (1 + threshold):
+            flag = "  REGRESSION"
+            regressed.append(metric)
+        rows.append(
+            f"{metric:55s} {prior['value']:9.2f} -> {line['value']:9.2f}ms "
+            f"({pct:+6.1f}%){flag}"
+        )
+    for metric in old_by:
+        if metric not in new_by:
+            rows.append(f"{metric:55s} (absent from this run)")
+    return rows, regressed
+
+
+def main(tiny: bool = False, compare: Optional[str] = None) -> int:
     """Run every config and emit one JSON line each.
 
     ``tiny`` shrinks the workloads (SCALE=0.02 → ~200-pod batches) and
     the sample counts so the tier-1 smoke test (tests/test_bench_smoke.py)
     can drive the REAL emit path — same builders, same asserts, same line
-    schema — inside the test-suite time budget."""
+    schema — inside the test-suite time budget.
+
+    ``compare`` loads a prior bench artifact (BENCH_rNN.json or raw
+    JSONL), prints per-line p50 deltas to stderr (stdout stays the
+    machine-readable line stream), and returns non-zero when any common
+    line regressed by more than COMPARE_THRESHOLD."""
     global SCALE, WARMUP, ITERS
     if tiny:
         SCALE, WARMUP, ITERS = 0.02, 1, 3
+    _LINES.clear()
     try:
         _run_all()
     finally:
         if tiny:
             SCALE, WARMUP, ITERS = 1.0, 3, 21
+    if compare:
+        import sys
+
+        rows, regressed = compare_lines(_LINES, _load_bench_lines(compare))
+        print(f"vs {compare}:", file=sys.stderr)
+        for row in rows:
+            print(row, file=sys.stderr)
+        if regressed:
+            print(
+                f"{len(regressed)} line(s) regressed by >"
+                f"{COMPARE_THRESHOLD:.0%}: {', '.join(regressed)}",
+                file=sys.stderr,
+            )
+            return 1
+    return 0
 
 
 def _run_all() -> None:
@@ -974,4 +1068,19 @@ def _run_all() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(prog="python bench.py")
+    parser.add_argument(
+        "--tiny", action="store_true",
+        help="smoke-scale run (the tier-1 bench smoke test's mode)",
+    )
+    parser.add_argument(
+        "--compare", default="", metavar="BENCH_rNN.json",
+        help="prior bench artifact (driver JSON or raw JSONL); prints "
+        "per-line p50 deltas and exits 1 on a >25%% regression of any "
+        "budgeted line",
+    )
+    args = parser.parse_args()
+    sys.exit(main(tiny=args.tiny, compare=args.compare or None))

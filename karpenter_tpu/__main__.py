@@ -20,8 +20,6 @@ import json
 import logging
 import signal
 import sys
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from karpenter_tpu.api import Settings
 from karpenter_tpu.cloud.fake.backend import FakeCloud
@@ -32,31 +30,15 @@ from karpenter_tpu.state.kube import KubeStore
 log = logging.getLogger("karpenter_tpu")
 
 
-def _metrics_server(port: int) -> ThreadingHTTPServer:
-    class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802 (http.server API)
-            if self.path not in ("/metrics", "/healthz"):
-                self.send_response(404)
-                self.end_headers()
-                return
-            body = (
-                b"ok" if self.path == "/healthz" else REGISTRY.dump().encode()
-            )
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *args):  # quiet access log
-            pass
-
-    server = ThreadingHTTPServer(("", port), Handler)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
-    return server
-
-
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "obs":
+        # trace renderer: span dumps / recorded sim traces -> Chrome-trace
+        # (Perfetto-loadable) JSON + a terminal top-N self-time table
+        # (obs/render.py, docs/designs/observability.md)
+        from karpenter_tpu.obs.render import main as obs_main
+
+        return obs_main(argv[1:])
     if argv and argv[0] == "sim":
         # deterministic cluster simulator: drive the real Operator through
         # a declarative scenario, record/replay traces, emit an SLO report
@@ -84,7 +66,17 @@ def main(argv=None) -> int:
         "--metrics-port",
         type=int,
         default=8080,
-        help="HTTP port for /metrics and /healthz (0 disables)",
+        help="HTTP port for the telemetry surface (0 disables): /metrics "
+        "(Prometheus exposition), /healthz, /events (the cluster event "
+        "ledger), /trace (the span ring, renderable via "
+        "`python -m karpenter_tpu obs`)",
+    )
+    parser.add_argument(
+        "--events-log",
+        default="",
+        help="JSONL file the cluster event ledger appends to "
+        "(PodNominated, NodeDisrupted{reason}, RetryBackoff, ...); the "
+        "ring at /events is bounded, this sink is not",
     )
     parser.add_argument(
         "--solver-address",
@@ -172,9 +164,20 @@ def main(argv=None) -> int:
         operator.provisioner.scheduler.pack_fn = remote.pack_problem
         log.info("solver sidecar at %s", args.solver_address)
 
+    if args.events_log:
+        operator.ledger.set_sink(args.events_log)
+        log.info("event ledger sink at %s", args.events_log)
+
     server = None
     if args.metrics_port:
-        server = _metrics_server(args.metrics_port)
+        from karpenter_tpu.obs.http import start_telemetry
+
+        server = start_telemetry(
+            args.metrics_port,
+            REGISTRY,
+            tracer=operator.tracer,
+            ledger=operator.ledger,
+        )
         log.info("metrics on :%d/metrics", args.metrics_port)
 
     def _stop(_sig, _frame):

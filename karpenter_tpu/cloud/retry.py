@@ -214,6 +214,9 @@ class RetryingCloud:
                 if c.state != OPEN:
                     log.warning("circuit for %s opened after %d consecutive "
                                 "failures", api, c.failures)
+                    self._registry.event(
+                        "CircuitOpen", api=api, failures=c.failures
+                    )
                 c.opened_at = now
                 self._set_state(c, api, OPEN)
 
@@ -250,6 +253,14 @@ class RetryingCloud:
                     cap = min(self.backoff_max, self.backoff_base * (2 ** attempt))
                     with self._lock:
                         sleep = self._rng.uniform(0, cap)  # full jitter
+                    # ledgered with the tick's trace ID: a CreateFleet
+                    # retry shows up on the same timeline as the solve
+                    # and nomination it delayed (seeded jitter, so the
+                    # sim records this deterministically)
+                    self._registry.event(
+                        "RetryBackoff", api=api, classification=cls,
+                        attempt=attempt + 1, backoff_s=f"{sleep:.6f}",
+                    )
                     self._clock.sleep(sleep)
                     attempt += 1
                     continue

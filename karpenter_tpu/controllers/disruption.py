@@ -238,6 +238,15 @@ class _RemovalEvaluator:
         key = self._key(subset)
         got = self._memo.get(key)
         if got is None:
+            if self.dc.use_batched_consolidation:
+                # a what-if the batched dispatch could not answer
+                # bit-identically (needs_host element, whole-pass
+                # fallback reason, or a below-threshold batch) resolves
+                # through the sequential solver — ledgered so "why was
+                # this tick's consolidation slow?" is answerable
+                self.dc.registry.event(
+                    "VerdictFallback", subset_size=len(subset)
+                )
             fits, price, vnode = self.dc._simulate(
                 list(subset), self.pool_inventory
             )
@@ -441,6 +450,10 @@ class DisruptionController:
                 for cand_name in pr.candidate_names:
                     cand = self.kube.node_claims.get(cand_name)
                     if cand is not None:
+                        self.registry.event(
+                            "NodeDisrupted", node=cand_name,
+                            reason=pr.reason, replacement=claim.name,
+                        )
                         self.termination.mark_for_deletion(
                             cand, reason=pr.reason
                         )
@@ -465,6 +478,10 @@ class DisruptionController:
                 self.registry.inc(
                     "karpenter_deprovisioning_replacement_failed",
                     {"reason": "timeout"},
+                )
+                self.registry.event(
+                    "NodeDisrupted", node=claim.name,
+                    reason="consolidation/rollback",
                 )
                 self.termination.mark_for_deletion(
                     claim, reason="consolidation/rollback"
@@ -978,6 +995,10 @@ class DisruptionController:
         self.registry.inc(
             "karpenter_deprovisioning_actions",
             {"mechanism": reason.split("/")[0], "nodepool": c.pool.name},
+        )
+        self.registry.event(
+            "NodeDisrupted", node=c.claim.name, pool=c.pool.name,
+            reason=reason,
         )
         self.termination.mark_for_deletion(c.claim, reason=reason)
         return True

@@ -158,4 +158,8 @@ class InterruptionController:
             "karpenter_interruption_actions_performed",
             {"action": "CordonAndDrain", "message_type": parsed.kind},
         )
+        self.registry.event(
+            "NodeDisrupted", node=claim.name,
+            reason=f"interruption/{parsed.kind}",
+        )
         self.termination.mark_for_deletion(claim, reason=parsed.kind)

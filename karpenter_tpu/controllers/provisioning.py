@@ -233,6 +233,9 @@ class Provisioner:
         # nominate pods placed on existing nodes (the kube-scheduler binds)
         for pod_key, node_name in result.existing_placements.items():
             self.cluster.nominate(pod_key, node_name)
+            self.registry.event(
+                "PodNominated", pod=pod_key, node=node_name, placement="existing"
+            )
             self._observe_scheduled(pod_key)
         return self._launch(result)
 
@@ -340,8 +343,18 @@ class Provisioner:
             self.registry.inc(
                 "karpenter_nodeclaims_launched", {"nodepool": claim.pool_name}
             )
+            self.registry.event(
+                "NodeLaunched",
+                claim=claim.name,
+                pool=claim.pool_name,
+                pods=len(vn.pods),
+            )
             for pod in vn.pods:
                 self.cluster.nominate(pod.key(), claim.name)
+                self.registry.event(
+                    "PodNominated", pod=pod.key(), node=claim.name,
+                    placement="new",
+                )
                 self._observe_scheduled(pod.key())
             launched.append(claim)
         return launched

@@ -1,0 +1,161 @@
+"""Metric metadata: the ONE source of truth for metric type / labels /
+semantics, consumed by BOTH the generated reference page
+(tools/gen_metrics_doc.py -> docs/metrics.md) and the live /metrics
+endpoint's Prometheus exposition (metrics/registry.py `exposition` ->
+HELP/TYPE lines).  An entry here renders identically in the doc and on
+the wire, so the two can never disagree about what a series means.
+
+Entries: name -> (type, labels, description).  Families without an entry
+still expose and document — type inferred from the registry family they
+live in, description defaulting to the name — so the catalog grows as
+families gain documentation, never as a precondition.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
+    "karpenter_cloud_api_retries_total": (
+        "counter",
+        "api, classification",
+        "bumped each time RetryingCloud retries a cloud call classified "
+        "throttle or transient; terminal errors (ICE, NotFound) never move it",
+    ),
+    "karpenter_cloud_api_circuit_state": (
+        "gauge",
+        "api",
+        "0 closed / 1 half-open / 2 open; opens after "
+        "cloud_circuit_failure_threshold consecutive classified failures, "
+        "half-opens when cloud_circuit_reset_timeout elapses, closes on the "
+        "next success",
+    ),
+    "karpenter_provider_cache_stale_seconds": (
+        "gauge",
+        "provider",
+        "age of the last-good data a degraded provider (pricing / subnet / "
+        "securitygroup / image / version) is serving while its refresh API "
+        "fails; reset to 0 by the next successful refresh",
+    ),
+    "karpenter_tpu_controller_healthy": (
+        "gauge",
+        "controller",
+        "1 after a clean reconcile; 0 while the controller is "
+        "crash-contained in per-controller requeue backoff after raising",
+    ),
+    "karpenter_pods_time_to_schedule_seconds": (
+        "histogram",
+        "(none)",
+        "pod first-seen-pending -> nominated onto a node/claim, observed "
+        "by the provisioning controller on the injected clock; the "
+        "simulator's SLO report (sim/report.py) aggregates its samples "
+        "into p50/p95/p99 time-to-schedule",
+    ),
+    "karpenter_sim_events_injected_total": (
+        "counter",
+        "kind",
+        "scenario events the simulator applied (pod_create, pod_delete, "
+        "instance_kill, spot_interruption, chaos, az_down/az_up, "
+        "image_roll, pool_update)",
+    ),
+    "karpenter_sim_ticks_total": (
+        "counter",
+        "phase",
+        "simulated ticks executed per phase (run / drain / settle)",
+    ),
+    "karpenter_sim_pending_pods": (
+        "gauge",
+        "(none)",
+        "pending-pod depth at the end of the last simulated tick; the "
+        "report's pending.peak is the max this gauge reached",
+    ),
+    "karpenter_sim_invariant_violations_total": (
+        "counter",
+        "invariant",
+        "invariant checks that failed (no-double-launch, "
+        "registered-eq-launched, budgets, no-leaked-instances, "
+        "schedule-deadline, all-pods-scheduled, no-wedged-controller); "
+        "any movement fails the run",
+    ),
+    "karpenter_solver_phase_seconds": (
+        "histogram",
+        "phase",
+        "per-solve wall time of one solver phase (partition / compile / "
+        "pad / dispatch / device_block / oracle / decode / other) — "
+        "disjoint self-times that sum to the solve's wall clock, observed "
+        "by the provisioning controller after every scheduling solve; see "
+        "the 'solve latency anatomy' section in the README for how to "
+        "read them",
+    ),
+    "karpenter_solver_compile_cache_hits_total": (
+        "counter",
+        "consumer",
+        "solves served from the TensorScheduler's incremental compile "
+        "cache, per consuming controller (provisioner, disruption); "
+        "exported as the delta of the scheduler's lifetime counter each "
+        "reconcile",
+    ),
+    "karpenter_solver_compile_cache_misses_total": (
+        "counter",
+        "consumer",
+        "solves that had to run the full host-side compile; a warm "
+        "steady-state cluster should see hits dominate — misses every "
+        "tick mean something (pods, pools, live nodes) is being mutated "
+        "in place",
+    ),
+    "karpenter_consolidation_eval_batch_size": (
+        "histogram",
+        "",
+        "candidate-subset elements per batched what-if dispatch "
+        "(TensorScheduler.evaluate_removals): the single-node scan is one "
+        "batch, each drop-one descent level is one batch",
+    ),
+    "karpenter_consolidation_phase_seconds": (
+        "histogram",
+        "phase",
+        "per-dispatch wall time of one batched-evaluation phase "
+        "(partition / compile / pad / dispatch / device_block / decode / "
+        "other) — kept separate from karpenter_solver_phase_seconds so "
+        "verdict batches don't skew the provisioner's per-solve "
+        "percentiles",
+    ),
+    "karpenter_consolidation_evals_total": (
+        "counter",
+        "path",
+        "consolidation what-if simulations by evaluation path: 'batched' "
+        "elements were answered on-device from one shared compile, "
+        "'sequential' elements ran the per-subset solver round-trip "
+        "(fallback conditions: docs/designs/consolidation-batching.md)",
+    ),
+    "karpenter_consolidation_verdict_mismatch_total": (
+        "counter",
+        "",
+        "batched verdicts contradicted by the winner's sequential decode "
+        "— must stay 0 (the parity suite enforces it); any movement is a "
+        "bug in the batched path",
+    ),
+    # ---- observability plane (docs/designs/observability.md)
+    "karpenter_events_total": (
+        "counter",
+        "type",
+        "cluster event ledger entries by type (PodNominated, NodeLaunched, "
+        "NodeDisrupted, RetryBackoff, CircuitOpen, StaleServed, "
+        "VerdictFallback) — emitted at the controllers' decision sites, "
+        "deterministic under the simulator's FakeClock; the ring itself is "
+        "readable at /events and in the sim trace's `led` lines",
+    ),
+    "karpenter_telemetry_scrapes_total": (
+        "counter",
+        "endpoint",
+        "HTTP requests served by the telemetry server "
+        "(metrics / healthz / events / trace), per endpoint — the scrape "
+        "heartbeat a dead-man's-switch alert can sit on",
+    ),
+    "karpenter_store_requests_total": (
+        "counter",
+        "method",
+        "store-server RPCs dispatched, per method (put / delete / "
+        "bind_pod / evict_pod / lease_* / watch / ...); served from the "
+        "store process's own registry on ITS telemetry endpoint",
+    ),
+}

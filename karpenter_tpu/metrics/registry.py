@@ -11,6 +11,7 @@ dump — the reference's ~50 published metrics map onto these names, e.g.
 
 from __future__ import annotations
 
+import bisect
 import threading
 from collections import defaultdict, deque
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
@@ -19,19 +20,71 @@ from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 # unbounded so dump() stays exact while memory stays O(1) per series
 _HIST_WINDOW = 1024
 
+# fixed cumulative bucket bounds (seconds for latency series, plain
+# counts for size series), Prometheus-style with an implicit +Inf: wide
+# enough to span sub-ms solver phases and multi-minute time-to-schedule.
+# Buckets are the UNBOUNDED percentile source: the sample window above
+# only holds the last 1024 observations, so past that point window
+# percentiles describe the tail of the run, not the run — `quantile`
+# switches to bucket interpolation exactly there.
+BUCKET_BOUNDS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+)
+
+
+def _nearest_rank(ordered: List[float], q: float) -> float:
+    """The sim report's percentile formula (sim/report.py), shared so the
+    exact path of `_Hist.quantile` reproduces it bit-for-bit."""
+    if not ordered:
+        return 0.0
+    rank = max(0, min(len(ordered) - 1, int(round(q * (len(ordered) - 1)))))
+    return ordered[rank]
+
 
 class _Hist:
-    __slots__ = ("count", "total", "samples")
+    __slots__ = ("count", "total", "samples", "buckets", "vmax")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.samples: deque = deque(maxlen=_HIST_WINDOW)
+        # per-bound observation counts + one overflow slot (+Inf);
+        # rendered CUMULATIVE by the exposition
+        self.buckets: List[int] = [0] * (len(BUCKET_BOUNDS) + 1)
+        self.vmax = 0.0
 
     def observe(self, value: float) -> None:
         self.count += 1
         self.total += value
         self.samples.append(value)
+        self.buckets[bisect.bisect_left(BUCKET_BOUNDS, value)] += 1
+        if value > self.vmax:
+            self.vmax = value
+
+    def quantile(self, q: float) -> float:
+        """Percentile that stays honest past the sample window: exact
+        nearest-rank while the window still holds every observation,
+        bucket interpolation (deterministic, monotone) once it doesn't.
+        The exact path reuses the sim report's formula so small runs are
+        unchanged by the bucket machinery."""
+        if self.count == 0:
+            return 0.0
+        if self.count <= len(self.samples):
+            return _nearest_rank(sorted(self.samples), q)
+        target = q * self.count
+        cum = 0
+        for i, n in enumerate(self.buckets):
+            if n == 0:
+                continue
+            if cum + n >= target:
+                if i >= len(BUCKET_BOUNDS):
+                    return self.vmax  # +Inf bucket: the tracked max
+                lo = BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+                hi = BUCKET_BOUNDS[i]
+                return lo + (hi - lo) * max(0.0, target - cum) / n
+            cum += n
+        return self.vmax
 
 
 def _key(labels: Optional[Mapping[str, str]]) -> Tuple:
@@ -48,6 +101,11 @@ class Registry:
         self.histograms: Dict[str, Dict[Tuple, _Hist]] = defaultdict(
             lambda: defaultdict(_Hist)
         )
+        # optional cluster event ledger (obs/events.py): the operator
+        # attaches its per-process ledger here so every layer that
+        # already holds a registry can emit decision events without new
+        # constructor plumbing; None = events are dropped (bare tests)
+        self.ledger = None
 
     # ------------------------------------------------------------- recording
     def inc(self, name: str, labels: Optional[Mapping[str, str]] = None, by: float = 1.0):
@@ -61,6 +119,14 @@ class Registry:
     def observe(self, name: str, value: float, labels: Optional[Mapping[str, str]] = None):
         with self._lock:
             self.histograms[name][_key(labels)].observe(value)
+
+    def event(self, type_: str, **attrs) -> None:
+        """Emit a cluster event through the attached ledger (no-op when
+        none is attached).  The ledger stamps the injected clock + the
+        current trace ID and bumps ``karpenter_events_total{type}``."""
+        led = self.ledger
+        if led is not None:
+            led.emit(type_, **attrs)
 
     def reset_gauge(self, name: str):
         """Drop every series of a gauge family — used by collectors that
@@ -112,6 +178,16 @@ class Registry:
         h = self.histograms.get(name, {}).get(_key(labels))
         return list(h.samples) if h is not None else []
 
+    def quantile(
+        self, name: str, q: float, labels: Optional[Mapping[str, str]] = None
+    ) -> float:
+        """Window-exact / bucket-estimated percentile of a histogram
+        series — unlike ``percentile(registry.histogram(...))`` this does
+        NOT silently degrade to the last-1024-samples tail once a series
+        outgrows its window (tests/test_obs.py pins the regression)."""
+        h = self.histograms.get(name, {}).get(_key(labels))
+        return h.quantile(q) if h is not None else 0.0
+
     def dump(self) -> str:
         """Prometheus-text-style dump (for the /metrics analogue)."""
         lines: List[str] = []
@@ -134,6 +210,84 @@ def _fmt(labels: Tuple) -> str:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in labels)
     return "{" + inner + "}"
+
+
+# --------------------------------------------------------------- exposition
+def _num(v: float) -> str:
+    """Full-precision exposition value: %g truncates to 6 significant
+    digits, which corrupts large counters on the wire (1_234_567 ->
+    1.23457e+06); round-trip formatting keeps every digit while still
+    rendering integral floats as '1'."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _escape(value: str) -> str:
+    """Prometheus label-value escaping (exposition format spec)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _fmt_esc(labels: Tuple, extra: Tuple = ()) -> str:
+    pairs = tuple(labels) + tuple(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+def exposition(registry: "Registry") -> str:
+    """REAL Prometheus text exposition (format 0.0.4): HELP/TYPE headers
+    from the shared metric catalog (metrics/catalog.py — the same source
+    docs/metrics.md renders from) and cumulative ``_bucket{le=}`` series
+    for histograms, so an actual Prometheus server can scrape the
+    telemetry endpoint (obs/http.py) and ``histogram_quantile`` works.
+
+    Unlike ``dump()`` (the in-repo test/debug surface, shape-stable on
+    purpose), this is the wire format: one family header per name, then
+    every series of that family."""
+    from karpenter_tpu.metrics.catalog import METRIC_DETAILS
+
+    def header(name: str, kind: str) -> List[str]:
+        detail = METRIC_DETAILS.get(name)
+        help_text = detail[2] if detail is not None else name
+        return [
+            f"# HELP {name} {_escape(help_text)}",
+            f"# TYPE {name} {kind}",
+        ]
+
+    lines: List[str] = []
+    with registry._lock:
+        for name, series in sorted(registry.counters.items()):
+            lines += header(name, "counter")
+            for labels, v in sorted(series.items()):
+                lines.append(f"{name}{_fmt_esc(labels)} {_num(v)}")
+        for name, series in sorted(registry.gauges.items()):
+            lines += header(name, "gauge")
+            for labels, v in sorted(series.items()):
+                lines.append(f"{name}{_fmt_esc(labels)} {_num(v)}")
+        for name, series in sorted(registry.histograms.items()):
+            lines += header(name, "histogram")
+            for labels, h in sorted(series.items()):
+                cum = 0
+                for bound, n in zip(BUCKET_BOUNDS, h.buckets):
+                    cum += n
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_esc(labels, (('le', f'{bound:g}'),))} {cum}"
+                    )
+                lines.append(
+                    f"{name}_bucket{_fmt_esc(labels, (('le', '+Inf'),))} "
+                    f"{h.count}"
+                )
+                lines.append(f"{name}_sum{_fmt_esc(labels)} {_num(h.total)}")
+                lines.append(f"{name}_count{_fmt_esc(labels)} {h.count}")
+    return "\n".join(lines) + "\n"
 
 
 def export_compile_cache_counters(

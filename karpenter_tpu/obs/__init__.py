@@ -1,0 +1,21 @@
+"""Observability plane: correlated decision traces, the cluster event
+ledger, the live telemetry endpoint, and the trace renderer.
+
+- ``obs.context``: the per-tick trace ID and its propagation rules — the
+  operator mints one ID per reconcile tick, spans and ledger events stamp
+  it automatically, and RPC clients ship it across the wire so a server's
+  handling spans land on the same timeline (docs/designs/observability.md).
+- ``obs.events``: the typed, ring-buffered cluster event ledger
+  (PodNominated, NodeLaunched, NodeDisrupted{reason}, RetryBackoff,
+  CircuitOpen, StaleServed, VerdictFallback) — deterministic under a
+  FakeClock so the simulator records and replays it byte-identically.
+- ``obs.http``: the stdlib telemetry server exposing /metrics (real
+  Prometheus exposition), /healthz, /events, and /trace on the operator
+  and store-server processes.
+- ``obs.render``: ``python -m karpenter_tpu obs`` — span rings and sim
+  traces rendered as Chrome-trace (Perfetto-loadable) JSON plus a
+  terminal top-N self-time table.
+
+Deliberately import-light: submodules are imported where used, so
+``utils/trace.py`` can depend on ``obs.context`` without cycles.
+"""

@@ -1,0 +1,113 @@
+"""Live telemetry endpoint: /metrics, /healthz, /events, /trace.
+
+The reference serves ~50 Prometheus series plus pprof handlers on its
+metrics port (website v0.31 concepts/metrics.md, settings.md:18); this is
+that surface for the reproduction, mounted on BOTH the operator process
+(`python -m karpenter_tpu --metrics-port`) and the store server
+(`store-server --telemetry-port`):
+
+- ``/metrics``  real Prometheus exposition (HELP/TYPE headers from the
+                shared metric catalog, cumulative histogram buckets) —
+                scrapeable by an actual Prometheus server;
+- ``/healthz``  liveness (``ok``) — the chart's probe target;
+- ``/events``   the cluster event ledger's recent ring as JSON — the
+                "why did that node go away?" surface;
+- ``/trace``    the span tracer's aggregates + recent spans as JSON —
+                feedable to ``python -m karpenter_tpu obs`` for a
+                Perfetto-loadable timeline.
+
+Every request bumps ``karpenter_telemetry_scrapes_total{endpoint}`` so
+the scrape cadence is itself observable (a stalled scraper is an
+outage-in-waiting).  Stdlib-only by design: the container bakes no
+client libraries, and a ThreadingHTTPServer is plenty for one scraper
+plus a human.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from karpenter_tpu.metrics.registry import Registry, exposition
+
+
+def _trace_payload(tracer) -> dict:
+    return {
+        "stats": {
+            path: {"count": st.count, "total_s": st.total_s, "max_s": st.max_s}
+            for path, st in tracer.stats().items()
+        },
+        "recent": [
+            {
+                "path": s.path,
+                "start_s": s.start_s,
+                "duration_s": s.duration_s,
+                "trace_id": s.trace_id,
+                "meta": s.meta,
+            }
+            for s in tracer.recent(500)
+        ],
+    }
+
+
+def start_telemetry(
+    port: int,
+    registry: Registry,
+    tracer=None,
+    ledger=None,
+    host: str = "",
+) -> ThreadingHTTPServer:
+    """Serve the telemetry surface on (host, port) in a daemon thread;
+    port 0 binds a free port (tests).  Returns the server (its
+    ``server_address[1]`` is the bound port; ``shutdown()`` stops it)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path = self.path.split("?", 1)[0]
+            if path not in ("/metrics", "/healthz", "/events", "/trace"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            # counting BEFORE rendering: the scrape that reads the
+            # counter sees itself, so the series is never 0 on a
+            # scraped process
+            registry.inc(
+                "karpenter_telemetry_scrapes_total",
+                {"endpoint": path.strip("/")},
+            )
+            if path == "/metrics":
+                body = exposition(registry).encode()
+                ctype = "text/plain; version=0.0.4"
+            elif path == "/healthz":
+                body = b"ok"
+                ctype = "text/plain"
+            elif path == "/events":
+                events = (
+                    [ev.to_dict() for ev in ledger.recent(500)]
+                    if ledger is not None
+                    else []
+                )
+                body = json.dumps(events, sort_keys=True).encode()
+                ctype = "application/json"
+            else:  # /trace
+                payload = (
+                    _trace_payload(tracer) if tracer is not None else {}
+                )
+                body = json.dumps(payload, sort_keys=True).encode()
+                ctype = "application/json"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet access log
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(
+        target=server.serve_forever, daemon=True, name=f"telemetry-{port}"
+    ).start()
+    return server

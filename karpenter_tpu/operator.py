@@ -35,6 +35,8 @@ from karpenter_tpu.controllers.consistency import ConsistencyController
 from karpenter_tpu.controllers.metrics_state import MetricsStateController
 from karpenter_tpu.metrics.decorators import MetricsCloudProvider
 from karpenter_tpu.metrics.registry import REGISTRY, Registry
+from karpenter_tpu.obs.context import mint_trace_id, set_tick
+from karpenter_tpu.obs.events import EventLedger
 from karpenter_tpu.providers.image import ImageProvider, Resolver
 from karpenter_tpu.providers.instance import InstanceProvider
 from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
@@ -79,6 +81,18 @@ class Operator:
         self.clock = clock or cloud.clock
         self.registry = registry
         self.cluster = Cluster(kube, clock=self.clock)
+        # cluster event ledger (obs/events.py): typed decision records
+        # (PodNominated, NodeDisrupted{reason}, RetryBackoff, ...) on the
+        # injected clock.  Attached to the registry so every layer that
+        # already holds one — controllers, the retry layer, degraded
+        # providers — emits through `registry.event(...)` without new
+        # constructor plumbing; the simulator reads `operator.ledger`
+        # to record the timeline into its trace.
+        self.ledger = EventLedger(clock=self.clock, registry=registry)
+        registry.ledger = self.ledger
+        # per-tick trace context (obs/context.py): reconcile_once mints
+        # one trace ID per tick; spans and ledger events stamp it
+        self._tick_seq = 0
         # span tracing (the --enable-profiling analogue): the process
         # tracer so library layers (solver) record into the same sink
         from karpenter_tpu.utils.trace import TRACER
@@ -262,6 +276,18 @@ class Operator:
             )
             if not leading:
                 return
+
+        # mint this tick's trace ID: every controller span, solver phase,
+        # retry attempt, ledger event, and store RPC below correlates on
+        # it (obs/context.py).  Minted only for ticks that actually
+        # reconcile, so sim IDs count real ticks and replay identically.
+        self._tick_seq += 1
+        set_tick(
+            mint_trace_id(
+                self._tick_seq,
+                self.elector.identity if self.elector is not None else "",
+            )
+        )
 
         # re-arm the shared cloud-API retry budget for this tick
         self.retrying.begin_tick()

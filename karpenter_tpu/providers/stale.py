@@ -66,6 +66,12 @@ class StaleGuard:
             fetched_at, value = hit
             self._degraded.add(key)
             self._export()
+            self.registry.event(
+                "StaleServed",
+                provider=self.provider,
+                key=str(key),
+                age_s=f"{max(self.clock.now() - fetched_at, 0.0):.3f}",
+            )
             log.warning(
                 "%s provider refresh failed (%s); serving %.0fs-stale data",
                 self.provider, exc, max(self.clock.now() - fetched_at, 0.0),

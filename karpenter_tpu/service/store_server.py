@@ -39,9 +39,13 @@ import socketserver
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.obs.context import trace_context
+from karpenter_tpu.obs.events import EventLedger
 from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
 from karpenter_tpu.state.kube import KubeStore
 from karpenter_tpu.state.wire import STORE_KINDS, from_wire, to_wire
+from karpenter_tpu.utils.trace import Tracer
 
 log = logging.getLogger(__name__)
 
@@ -157,13 +161,31 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             header, _ = decode(payload)
             if header.get("method") == "watch":
+                # counted like every other RPC (docs/metrics.md lists
+                # watch in the per-method series); the span for the
+                # snapshot phase is recorded inside serve_watch, where
+                # the ctx is still in hand
+                self.server.registry.inc(  # type: ignore[attr-defined]
+                    "karpenter_store_requests_total", {"method": "watch"}
+                )
                 self.server.serve_watch(self.request, header)  # type: ignore[attr-defined]
                 return
+            # adopt the CLIENT's trace context for the handling span:
+            # the server's span log records this RPC under the caller's
+            # tick trace ID, stitching the two processes' timelines
+            # (state/remote.py ships the ctx; obs/render.py merges)
+            ctx = header.get("ctx") or {}
+            method = str(header.get("method", "?"))
             try:
-                response = self.server.dispatch(header)  # type: ignore[attr-defined]
+                with trace_context(ctx.get("trace_id", "")), \
+                        self.server.tracer.span(f"store.{method}"):  # type: ignore[attr-defined]
+                    response = self.server.dispatch(header)  # type: ignore[attr-defined]
             except Exception as exc:
                 log.exception("store request failed")
                 response = {"status": "error", "error": str(exc)}
+            self.server.registry.inc(  # type: ignore[attr-defined]
+                "karpenter_store_requests_total", {"method": method}
+            )
             try:
                 send_frame(self.request, encode(response, {}))
             except (ConnectionError, OSError):
@@ -185,6 +207,16 @@ class StoreServer(socketserver.ThreadingTCPServer):
         super().__init__((host, port), _Handler)
         self.store = store or VersionedStore()
         self._thread: Optional[threading.Thread] = None
+        # the server process's OWN observability surface: request
+        # counters + handling spans (recorded under each client's trace
+        # ID) + a ledger, all served by --telemetry-port in main().  The
+        # tracer stays on — spans are two perf_counter calls per RPC,
+        # and a store server without a span log cannot answer "which
+        # replica's tick was slow?"
+        self.registry = Registry()
+        self.tracer = Tracer(enabled=True)
+        self.ledger = EventLedger(registry=self.registry)
+        self.registry.ledger = self.ledger
 
     # ------------------------------------------------------------- dispatch
     def dispatch(self, header: dict) -> dict:
@@ -421,7 +453,14 @@ class StoreServer(socketserver.ThreadingTCPServer):
     # ---------------------------------------------------------------- watch
     def serve_watch(self, sock, header: dict) -> None:
         identity = header.get("identity", "")
-        snap, sub = self.store.subscribe(identity)
+        ctx = header.get("ctx") or {}
+        # span only the snapshot phase (subscribe + full-state frame) —
+        # the expensive, attributable part; the push loop below lives as
+        # long as the connection and would make a meaningless span
+        with trace_context(ctx.get("trace_id", "")), self.tracer.span(
+            "store.watch", identity=identity
+        ):
+            snap, sub = self.store.subscribe(identity)
         try:
             send_frame(sock, encode({"status": "ok", "snapshot": snap}, {}))
             while True:
@@ -465,14 +504,37 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8082)
+    parser.add_argument(
+        "--telemetry-port",
+        type=int,
+        default=8083,
+        help="HTTP port for /metrics, /healthz, /events and /trace on "
+        "THIS process (0 disables) — the store server's request "
+        "counters and its span log, which records every RPC under the "
+        "calling replica's trace ID",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     server = StoreServer(args.host, args.port)
+    telemetry = None
+    if args.telemetry_port:
+        from karpenter_tpu.obs.http import start_telemetry
+
+        telemetry = start_telemetry(
+            args.telemetry_port,
+            server.registry,
+            tracer=server.tracer,
+            ledger=server.ledger,
+        )
+        log.info("telemetry on :%d/metrics", args.telemetry_port)
     log.info("cluster store listening on %s:%d", *server.address)
     try:
         server.serve_forever()
     except KeyboardInterrupt:  # pragma: no cover - CLI path
         pass
+    finally:
+        if telemetry is not None:
+            telemetry.shutdown()
     return 0
 
 

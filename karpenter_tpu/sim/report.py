@@ -57,11 +57,13 @@ def build_report(runner) -> dict:
     registry = env.registry
     tts = registry.histogram("karpenter_pods_time_to_schedule_seconds")
     tts_count = 0
+    tts_max = 0.0
     hist = registry.histograms.get(
         "karpenter_pods_time_to_schedule_seconds", {}
     ).get(())
     if hist is not None:
         tts_count = hist.count
+        tts_max = hist.vmax
     launched = sum(
         _counter_family(registry, "karpenter_nodeclaims_launched").values()
     )
@@ -88,13 +90,26 @@ def build_report(runner) -> dict:
             "final": len(env.kube.pods),
         },
         "time_to_schedule_s": {
-            # percentiles over the histogram's bounded sample window —
-            # "window" < "scheduled" means a long run outgrew it and the
-            # percentiles describe only the most recent pods
-            "p50": round(percentile(tts, 0.50), 6),
-            "p95": round(percentile(tts, 0.95), 6),
-            "p99": round(percentile(tts, 0.99), 6),
-            "max": round(max(tts), 6) if tts else 0.0,
+            # window-exact while the run fits the sample window,
+            # bucket-estimated past it (Registry.quantile) — a long run
+            # no longer silently reports the tail's percentiles;
+            # "window" < "scheduled" marks where the estimate takes over
+            "p50": round(
+                registry.quantile(
+                    "karpenter_pods_time_to_schedule_seconds", 0.50
+                ), 6,
+            ),
+            "p95": round(
+                registry.quantile(
+                    "karpenter_pods_time_to_schedule_seconds", 0.95
+                ), 6,
+            ),
+            "p99": round(
+                registry.quantile(
+                    "karpenter_pods_time_to_schedule_seconds", 0.99
+                ), 6,
+            ),
+            "max": round(tts_max, 6),
             "scheduled": tts_count,
             "window": len(tts),
         },
@@ -140,6 +155,15 @@ def build_report(runner) -> dict:
         },
         "consolidation": _consolidation_section(registry),
         "events": dict(sorted(runner.event_counts.items())),
+        # the operator's OWN decision timeline (obs/events.py), distinct
+        # from `events` above (what the scenario injected): what the
+        # controllers did about it, and why nodes went away
+        "cluster_events": {
+            "counts": dict(sorted(runner.cluster_event_counts.items())),
+            "disruptions_by_reason": dict(
+                sorted(runner.disruptions_by_reason.items())
+            ),
+        },
         "invariants": {
             "checked_ticks": runner.checker.checked_ticks,
             "violations": [str(v) for v in runner.checker.violations],

@@ -171,6 +171,13 @@ class ScenarioRunner:
         self.pods_deleted = 0
         self.peak_pending = 0
         self.cost_by_ct: Dict[str, float] = {}
+        # cluster event ledger accounting (obs/events.py): the operator's
+        # decision records, drained once per tick into the trace and the
+        # report's `cluster_events` section — deterministic, so the led
+        # lines are part of the byte-comparable surface
+        self._led_seq = 0
+        self.cluster_event_counts: Dict[str, int] = {}
+        self.disruptions_by_reason: Dict[str, int] = {}
         self.t0 = self.env.clock.now()
         self._sched = self.t0
 
@@ -282,6 +289,18 @@ class ScenarioRunner:
         env.kubelet.step()
         env.operator.reconcile_once()  # any raise here fails the run
         env.kubelet.step()
+        for led in env.operator.ledger.drain(self._led_seq):
+            self._led_seq = led.seq
+            self.cluster_event_counts[led.type] = (
+                self.cluster_event_counts.get(led.type, 0) + 1
+            )
+            if led.type == "NodeDisrupted":
+                reason = led.attrs.get("reason", "")
+                self.disruptions_by_reason[reason] = (
+                    self.disruptions_by_reason.get(reason, 0) + 1
+                )
+            if self.trace is not None:
+                self.trace.ledger(tick, led)
         self.checker.check_tick(tick)
         env.registry.inc("karpenter_sim_ticks_total", {"phase": phase})
         pending = len(env.kube.pending_pods())

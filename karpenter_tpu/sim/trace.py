@@ -7,6 +7,10 @@ separators — so two runs are comparable byte-for-byte.  Line types:
     {"t": "tick", "tick", "dt", "phase"}   a tick boundary + its duration
     {"t": "ev",   "tick", "kind", "data"}  one injected scenario event
     {"t": "api",  "tick", "api", "args"}   one cloud API call (at entry)
+    {"t": "led",  "tick", ...}    one cluster-ledger event (obs/events.py):
+                                  seq/ts/type/trace_id/attrs — the
+                                  controllers' decisions on the tick's
+                                  trace timeline
     {"t": "dig",  "tick", ...}    per-tick state digest (counts + sha)
     {"t": "report", "slo": ...}   the final deterministic SLO report
 
@@ -116,6 +120,25 @@ class TraceWriter:
     def api(self, api: str, args: tuple) -> None:
         self._write(
             {"t": "api", "tick": self.tick, "api": api, "args": _wire_args(args)}
+        )
+
+    def ledger(self, tick: int, ev) -> None:
+        """One cluster-ledger event (obs/events.py ObsEvent).  Part of the
+        byte-comparable surface: everything in it is a function of the
+        injected clock and seeded decisions, so a replay re-emits the
+        identical lines (tests/test_obs.py pins this).  NOT part of the
+        replay tape — `read_tape` skips it (the controllers re-emit the
+        events when the tape re-executes)."""
+        self._write(
+            {
+                "t": "led",
+                "tick": tick,
+                "seq": ev.seq,
+                "ts": ev.ts,
+                "type": ev.type,
+                "trace_id": ev.trace_id,
+                "attrs": dict(ev.attrs),
+            }
         )
 
     def digest(self, tick: int, env) -> None:

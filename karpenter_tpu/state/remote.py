@@ -42,6 +42,7 @@ import socket
 import threading
 from typing import Dict, Optional, Tuple
 
+from karpenter_tpu.obs.context import current_trace_id
 from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
 from karpenter_tpu.state.kube import KubeStore
 from karpenter_tpu.state.wire import STORE_KINDS, canonical, from_wire, to_wire
@@ -126,6 +127,13 @@ class RemoteKubeStore(KubeStore):
         Mutations here are idempotent re-applied (puts/deletes/lease CAS);
         a retried record_event may at worst duplicate an event line."""
         header = dict(header, identity=self.identity)
+        # trace-context propagation (obs/context.py): the tick's trace ID
+        # rides the RPC header so the StoreServer records its handling
+        # span under the CLIENT's timeline — one trace spans both
+        # processes (docs/designs/observability.md)
+        tid = current_trace_id()
+        if tid:
+            header["ctx"] = {"trace_id": tid}
         last: Optional[Exception] = None
         for attempt in range(RETRIES):
             with self._rpc_lock:

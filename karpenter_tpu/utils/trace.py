@@ -30,6 +30,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
+from karpenter_tpu.obs.context import current_trace_id
+
 # bounded history: enough for several reconcile ticks of every controller
 RING_SIZE = 4096
 
@@ -53,6 +55,11 @@ class Span:
     start_s: float
     duration_s: float
     meta: Dict[str, str] = field(default_factory=dict)
+    # the reconcile tick (or RPC client context) this span acted for —
+    # stamped from obs/context.py at record time, so one trace ID joins
+    # controller spans, solver phases, and the store server's handling
+    # spans into a single timeline (docs/designs/observability.md)
+    trace_id: str = ""
 
 
 class Tracer:
@@ -94,7 +101,8 @@ class Tracer:
             with self._lock:
                 self._ring.append(
                     Span(path=path, start_s=t0, duration_s=dt,
-                         meta={k: str(v) for k, v in meta.items()})
+                         meta={k: str(v) for k, v in meta.items()},
+                         trace_id=current_trace_id())
                 )
                 stat = self._stats.get(path)
                 if stat is None:
@@ -134,7 +142,13 @@ class Tracer:
                 for k, v in self.stats().items()
             },
             "recent": [
-                {"path": s.path, "duration_s": s.duration_s, "meta": s.meta}
+                {
+                    "path": s.path,
+                    "start_s": s.start_s,
+                    "duration_s": s.duration_s,
+                    "trace_id": s.trace_id,
+                    "meta": s.meta,
+                }
                 for s in self.recent(500)
             ],
         }

@@ -81,6 +81,59 @@ class TestBenchSmoke:
         assert bench.SCALE == 1.0 and bench.ITERS == 21
 
 
+class TestCompare:
+    def test_compare_lines_flags_regression_over_threshold(self):
+        old = [{"metric": "a_p50", "value": 100.0},
+               {"metric": "b_p50", "value": 100.0},
+               {"metric": "gone_p50", "value": 5.0}]
+        new = [{"metric": "a_p50", "value": 126.0},   # +26%: regressed
+               {"metric": "b_p50", "value": 124.0},   # +24%: within noise
+               {"metric": "fresh_p50", "value": 9.0}]  # new line: reported
+        rows, regressed = bench.compare_lines(new, old)
+        assert regressed == ["a_p50"]
+        text = "\n".join(rows)
+        assert "REGRESSION" in text
+        assert "(new line)" in text and "(absent from this run)" in text
+
+    def test_load_bench_lines_driver_artifact_and_jsonl(self, tmp_path):
+        """Both prior-file shapes parse: the driver's BENCH_rNN.json
+        wrapper ({"tail": jsonl-with-noise}) and a raw JSONL dump."""
+        lines = [{"metric": "x_p50", "value": 10.0, "unit": "ms"}]
+        raw = "\n".join(json.dumps(l) for l in lines)
+        wrapper = tmp_path / "BENCH_r99.json"
+        wrapper.write_text(json.dumps(
+            {"n": 99, "cmd": "python bench.py", "rc": 0,
+             "tail": "some log noise\n" + raw + "\n"}
+        ))
+        assert bench._load_bench_lines(str(wrapper)) == lines
+        jsonl = tmp_path / "prior.jsonl"
+        jsonl.write_text(raw + "\n")
+        assert bench._load_bench_lines(str(jsonl)) == lines
+
+    def test_tiny_compare_run_exits_clean_without_regression(
+        self, bench_lines, tmp_path, capsys
+    ):
+        """Drive the REAL --compare path at tiny scale: a prior file with
+        generously slower values (so measurement noise cannot fake a
+        regression) compares clean, prints per-line deltas, returns 0."""
+        prior = tmp_path / "prior.jsonl"
+        prior.write_text(
+            "\n".join(
+                json.dumps({**l, "value": l["value"] * 100.0})
+                for l in bench_lines
+            )
+            + "\n"
+        )
+        rc = bench.main(tiny=True, compare=str(prior))
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "REGRESSION" not in captured.err
+        assert "->" in captured.err  # per-line p50 deltas printed
+        # stdout stayed the machine-readable line stream
+        for line in captured.out.strip().splitlines():
+            assert "metric" in json.loads(line)
+
+
 class TestMarginalEstimate:
     def test_clamps_negative_estimate_at_measurement_site(self):
         # chained runs FASTER than chain x single (noise-inflated

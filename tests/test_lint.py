@@ -14,6 +14,10 @@ baked into the image, so this enforces the checks that catch real rot:
    per-candidate update() busts the solver's compile cache and forces a
    full host compile per subset); the sanctioned call sites are
    allowlisted by qualified name.
+5. every metric-name literal passed to a registry verb (.inc/.set/
+   .observe/.time/...) appears in docs/metrics.md — the doc-rot guard
+   with teeth: a new series cannot ship without regenerating the
+   reference page (and with it the /metrics HELP/TYPE catalog).
 """
 
 import ast
@@ -182,6 +186,93 @@ def test_no_scheduler_update_in_candidate_loops():
         "simulations through TensorScheduler.evaluate_removals, or "
         "allowlist a genuinely one-shot site):\n" + "\n".join(offenders)
     )
+
+
+# the registry's recording verbs: a string literal metric name passed to
+# any of these is a published series and must be documented.  (Reading
+# verbs — counter/gauge/histogram/quantile — are deliberately included
+# too: reading an undocumented series is the same rot.)
+_REGISTRY_VERBS = frozenset(
+    {
+        "inc", "set", "observe", "time", "unset", "reset_gauge",
+        "counter", "gauge", "histogram", "quantile",
+    }
+)
+
+
+def documented_metric_names() -> set:
+    """Every metric family named in docs/metrics.md (the generated
+    reference page, tools/gen_metrics_doc.py)."""
+    doc = (
+        pathlib.Path(karpenter_tpu.__path__[0]).parent / "docs" / "metrics.md"
+    )
+    return set(re.findall(r"`(karpenter_[a-z0-9_]+)`", doc.read_text()))
+
+
+def metric_doc_offenders(source: str, rel: str, documented: set):
+    """AST scan: every `<anything>.<verb>("karpenter_...", ...)` call
+    whose first argument is a string literal must name a documented
+    metric family.  Dynamic names (f-strings, variables) are out of
+    scope — the doc generator cannot see them either."""
+    tree = ast.parse(source)
+    offenders = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REGISTRY_VERBS
+            and node.args
+        ):
+            continue
+        first = node.args[0]
+        if not (
+            isinstance(first, ast.Constant)
+            and isinstance(first.value, str)
+            and first.value.startswith("karpenter_")
+        ):
+            continue
+        if first.value not in documented:
+            offenders.append(
+                f"{rel}:{node.lineno}: {first.value!r} passed to "
+                f".{node.func.attr}() but absent from docs/metrics.md"
+            )
+    return offenders
+
+
+def test_registry_metric_literals_documented():
+    """Doc-rot guard: a metric literal reaching the registry without a
+    docs/metrics.md entry means someone added a series and skipped
+    `python -m karpenter_tpu.tools.gen_metrics_doc` — which also feeds
+    the /metrics endpoint's HELP/TYPE catalog."""
+    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
+    documented = documented_metric_names()
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(pkg_root.parent).as_posix()
+        offenders += metric_doc_offenders(
+            path.read_text(), rel, documented
+        )
+    assert not offenders, (
+        "metric literals not documented (run `python -m "
+        "karpenter_tpu.tools.gen_metrics_doc` to regenerate the page):\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_metric_doc_lint_has_teeth():
+    """The checker actually fires on an undocumented literal and stays
+    quiet on a documented one and on dynamic names."""
+    documented = {"karpenter_known_total"}
+    src = (
+        "def f(reg, name):\n"
+        "    reg.inc('karpenter_known_total')\n"
+        "    reg.observe('karpenter_rogue_seconds', 1.0)\n"
+        "    reg.inc(name)\n"  # dynamic: out of scope
+    )
+    hits = metric_doc_offenders(src, "karpenter_tpu/x.py", documented)
+    assert len(hits) == 1 and "karpenter_rogue_seconds" in hits[0], hits
 
 
 def test_scheduler_update_lint_has_teeth():

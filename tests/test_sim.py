@@ -66,7 +66,7 @@ def test_trace_structure(tmp_path):
     run_scenario("steady", seed=1, ticks=10, trace=w)
     lines = read_trace(path)
     kinds = {line["t"] for line in lines}
-    assert kinds == {"meta", "tick", "ev", "api", "dig", "report"}
+    assert kinds == {"meta", "tick", "ev", "api", "led", "dig", "report"}
     meta = lines[0]
     assert meta == {
         "t": "meta", "v": 1, "scenario": "steady", "seed": 1,
