@@ -869,42 +869,93 @@ def _load_bench_lines(path: str) -> List[dict]:
     return lines
 
 
-def compare_lines(
+def compare_verdict(
     new: List[dict], old: List[dict], threshold: float = COMPARE_THRESHOLD
-) -> Tuple[List[str], List[str]]:
-    """Per-metric p50 deltas between two bench runs.
+) -> dict:
+    """The machine-readable comparison between two bench runs — the
+    ``--compare-out`` JSON CI and ``doctor --bench`` ingest.
 
-    Returns (report rows, regressed metric names): a metric regresses
-    when its new p50 exceeds the old by more than ``threshold`` (25% by
-    default — well past the per-line ``noise_ms`` IQR on every config).
-    Metrics present on only one side are reported, never failed — a new
-    bench line must not break comparisons against older artifacts."""
+    Schema: {"threshold", "ok", "regressed": [metric...], "lines":
+    [{"metric", "prior_ms", "new_ms", "delta_pct", "regressed",
+    "status"}]} where status is one of compared / new / absent.  A
+    metric regresses when its new p50 exceeds the old by more than
+    ``threshold`` (25% by default — well past the per-line ``noise_ms``
+    IQR on every config); metrics present on only one side are reported,
+    never failed — a new bench line must not break comparisons against
+    older artifacts."""
     old_by = {l["metric"]: l for l in old}
     new_by = {l["metric"]: l for l in new}
-    rows: List[str] = []
+    lines: List[dict] = []
     regressed: List[str] = []
     for metric, line in new_by.items():
         prior = old_by.get(metric)
         if prior is None:
-            rows.append(f"{metric:55s} {line['value']:9.2f}ms       (new line)")
+            lines.append(
+                {"metric": metric, "prior_ms": None,
+                 "new_ms": line["value"], "delta_pct": None,
+                 "regressed": False, "status": "new"}
+            )
             continue
         delta = line["value"] - prior["value"]
         pct = (delta / prior["value"] * 100.0) if prior["value"] else 0.0
-        flag = ""
-        if prior["value"] and line["value"] > prior["value"] * (1 + threshold):
-            flag = "  REGRESSION"
+        is_reg = bool(
+            prior["value"] and line["value"] > prior["value"] * (1 + threshold)
+        )
+        if is_reg:
             regressed.append(metric)
-        rows.append(
-            f"{metric:55s} {prior['value']:9.2f} -> {line['value']:9.2f}ms "
-            f"({pct:+6.1f}%){flag}"
+        lines.append(
+            {"metric": metric, "prior_ms": prior["value"],
+             "new_ms": line["value"], "delta_pct": round(pct, 2),
+             "regressed": is_reg, "status": "compared"}
         )
     for metric in old_by:
         if metric not in new_by:
+            lines.append(
+                {"metric": metric, "prior_ms": old_by[metric]["value"],
+                 "new_ms": None, "delta_pct": None, "regressed": False,
+                 "status": "absent"}
+            )
+    return {
+        "threshold": threshold,
+        "ok": not regressed,
+        "regressed": regressed,
+        "lines": lines,
+    }
+
+
+def render_verdict(verdict: dict) -> List[str]:
+    """Human-readable report rows for a :func:`compare_verdict` dict."""
+    rows: List[str] = []
+    for line in verdict["lines"]:
+        metric = line["metric"]
+        if line["status"] == "new":
+            rows.append(f"{metric:55s} {line['new_ms']:9.2f}ms       (new line)")
+        elif line["status"] == "absent":
             rows.append(f"{metric:55s} (absent from this run)")
-    return rows, regressed
+        else:
+            flag = "  REGRESSION" if line["regressed"] else ""
+            rows.append(
+                f"{metric:55s} {line['prior_ms']:9.2f} -> "
+                f"{line['new_ms']:9.2f}ms ({line['delta_pct']:+6.1f}%){flag}"
+            )
+    return rows
 
 
-def main(tiny: bool = False, compare: Optional[str] = None) -> int:
+def compare_lines(
+    new: List[dict], old: List[dict], threshold: float = COMPARE_THRESHOLD
+) -> Tuple[List[str], List[str]]:
+    """(report rows, regressed metric names) between two bench runs —
+    a convenience wrapper over :func:`compare_verdict` +
+    :func:`render_verdict`."""
+    verdict = compare_verdict(new, old, threshold)
+    return render_verdict(verdict), verdict["regressed"]
+
+
+def main(
+    tiny: bool = False,
+    compare: Optional[str] = None,
+    compare_out: Optional[str] = None,
+) -> int:
     """Run every config and emit one JSON line each.
 
     ``tiny`` shrinks the workloads (SCALE=0.02 → ~200-pod batches) and
@@ -915,7 +966,11 @@ def main(tiny: bool = False, compare: Optional[str] = None) -> int:
     ``compare`` loads a prior bench artifact (BENCH_rNN.json or raw
     JSONL), prints per-line p50 deltas to stderr (stdout stays the
     machine-readable line stream), and returns non-zero when any common
-    line regressed by more than COMPARE_THRESHOLD."""
+    line regressed by more than COMPARE_THRESHOLD.  ``compare_out``
+    additionally writes the machine-readable verdict JSON
+    (:func:`compare_verdict` schema, plus the baseline path) so CI gates
+    and ``doctor --bench`` ingest the comparison instead of re-parsing
+    the stderr table."""
     global SCALE, WARMUP, ITERS
     if tiny:
         SCALE, WARMUP, ITERS = 0.02, 1, 3
@@ -928,10 +983,20 @@ def main(tiny: bool = False, compare: Optional[str] = None) -> int:
     if compare:
         import sys
 
-        rows, regressed = compare_lines(_LINES, _load_bench_lines(compare))
+        prior = _load_bench_lines(compare)
+        verdict = compare_verdict(_LINES, prior)
+        rows, regressed = render_verdict(verdict), verdict["regressed"]
         print(f"vs {compare}:", file=sys.stderr)
         for row in rows:
             print(row, file=sys.stderr)
+        if compare_out:
+            with open(compare_out, "w") as f:
+                json.dump(
+                    {"baseline": compare, **verdict}, f,
+                    indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            print(f"compare verdict -> {compare_out}", file=sys.stderr)
         if regressed:
             print(
                 f"{len(regressed)} line(s) regressed by >"
@@ -1082,5 +1147,19 @@ if __name__ == "__main__":
         "per-line p50 deltas and exits 1 on a >25%% regression of any "
         "budgeted line",
     )
+    parser.add_argument(
+        "--compare-out", default="", metavar="VERDICT.json",
+        help="write the machine-readable comparison verdict here "
+        "(requires --compare); CI and `python -m karpenter_tpu doctor "
+        "--bench` ingest this instead of the stderr table",
+    )
     args = parser.parse_args()
-    sys.exit(main(tiny=args.tiny, compare=args.compare or None))
+    if args.compare_out and not args.compare:
+        parser.error("--compare-out requires --compare")
+    sys.exit(
+        main(
+            tiny=args.tiny,
+            compare=args.compare or None,
+            compare_out=args.compare_out or None,
+        )
+    )

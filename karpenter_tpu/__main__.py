@@ -39,6 +39,14 @@ def main(argv=None) -> int:
         from karpenter_tpu.obs.render import main as obs_main
 
         return obs_main(argv[1:])
+    if argv and argv[0] == "doctor":
+        # diagnosis CLI: correlate a flight-recorder dump (or a live
+        # /debug/flight endpoint) into phases-vs-baseline, the event
+        # timeline around the breach, and rule-based suspected causes
+        # (obs/doctor.py, docs/designs/observability.md)
+        from karpenter_tpu.obs.doctor import main as doctor_main
+
+        return doctor_main(argv[1:])
     if argv and argv[0] == "sim":
         # deterministic cluster simulator: drive the real Operator through
         # a declarative scenario, record/replay traces, emit an SLO report
@@ -68,8 +76,10 @@ def main(argv=None) -> int:
         default=8080,
         help="HTTP port for the telemetry surface (0 disables): /metrics "
         "(Prometheus exposition), /healthz, /events (the cluster event "
-        "ledger), /trace (the span ring, renderable via "
-        "`python -m karpenter_tpu obs`)",
+        "ledger, ?since_seq=N&limit=M cursor), /trace (the span ring, "
+        "renderable via `python -m karpenter_tpu obs`), /debug/flight "
+        "(the flight recorder ring, diagnosable via `python -m "
+        "karpenter_tpu doctor`)",
     )
     parser.add_argument(
         "--events-log",
@@ -177,6 +187,7 @@ def main(argv=None) -> int:
             REGISTRY,
             tracer=operator.tracer,
             ledger=operator.ledger,
+            flight=operator.flight,
         )
         log.info("metrics on :%d/metrics", args.metrics_port)
 
@@ -184,8 +195,17 @@ def main(argv=None) -> int:
         log.info("shutting down")
         operator.stop()
 
+    def _flight_dump(_sig, _frame):
+        # only set a flag: the handler runs on the main thread, and
+        # dumping takes non-reentrant locks the interrupted frame may
+        # hold.  The dump lands at the end of the current/next tick,
+        # in flight_dir when configured, the working directory otherwise
+        operator.request_flight_dump("sigusr1")
+
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)
+    if hasattr(signal, "SIGUSR1"):
+        signal.signal(signal.SIGUSR1, _flight_dump)
     log.info(
         "karpenter-tpu controller running (cluster=%s, interval=%.1fs)",
         settings.cluster_name,

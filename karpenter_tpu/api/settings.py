@@ -41,6 +41,20 @@ class Settings:
     # requeued with exponential backoff while the rest of the tick proceeds
     controller_backoff_base: float = 1.0
     controller_backoff_max: float = 300.0
+    # SLO rule engine (obs/slo.py): per-rule overrides merged over the
+    # default rule set — {"rule-name": {"threshold": ..., "budget": ...,
+    # "fast_window_s": ..., "slow_window_s": ..., "enabled": ...}}; a
+    # non-default name creates a new rule and must carry "signal"
+    slo_rules: Dict[str, dict] = field(default_factory=dict)
+    # streaming anomaly detection over the phase-latency series
+    # (obs/detect.py); the simulator force-disables it (wall-clock values
+    # cannot enter a byte-compared trace)
+    enable_anomaly_detection: bool = True
+    # flight recorder (obs/flight.py): ring depth in ticks, and the
+    # directory breach/crash dumps land in ("" keeps the ring in-memory
+    # only — still served at /debug/flight and dumpable via SIGUSR1)
+    flight_ticks: int = 64
+    flight_dir: str = ""
 
     @classmethod
     def from_file(cls, path: str) -> "Settings":
@@ -77,7 +91,7 @@ class Settings:
                 kw[f.name] = float(raw)
             elif f.type in ("int", int):
                 kw[f.name] = int(raw)
-            elif f.name == "tags":
+            elif f.name in ("tags", "slo_rules"):
                 kw[f.name] = json.loads(raw)
             else:
                 kw[f.name] = raw
@@ -109,3 +123,11 @@ class Settings:
             raise ValueError(
                 "controller_backoff_max must be >= controller_backoff_base > 0"
             )
+        if not isinstance(self.slo_rules, dict) or any(
+            not isinstance(v, dict) for v in self.slo_rules.values()
+        ):
+            raise ValueError(
+                "slo_rules must map rule names to override dicts"
+            )
+        if self.flight_ticks < 1:
+            raise ValueError("flight_ticks must be >= 1")

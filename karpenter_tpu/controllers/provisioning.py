@@ -149,6 +149,13 @@ class Provisioner:
             live = self.kube.pods
             for key in [k for k in self._first_seen if k not in live]:
                 del self._first_seen[key]
+        # age of the OLDEST still-unnominated pending pod, on the injected
+        # clock — the SLO engine's pending-pod-age signal (obs/slo.py);
+        # deterministic, so a sim scenario can page on it
+        self.registry.set(
+            "karpenter_pods_pending_age_seconds",
+            max((now - t0 for t0 in self._first_seen.values()), default=0.0),
+        )
         self.batcher.observe(pending)
         if not pending or not self.batcher.ready():
             return []

@@ -140,7 +140,8 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "type",
         "cluster event ledger entries by type (PodNominated, NodeLaunched, "
         "NodeDisrupted, RetryBackoff, CircuitOpen, StaleServed, "
-        "VerdictFallback) — emitted at the controllers' decision sites, "
+        "VerdictFallback, CatalogRolled, SLOBreach, SLORecovered, "
+        "AnomalyDetected) — emitted at the controllers' decision sites, "
         "deterministic under the simulator's FakeClock; the ring itself is "
         "readable at /events and in the sim trace's `led` lines",
     ),
@@ -148,8 +149,8 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "counter",
         "endpoint",
         "HTTP requests served by the telemetry server "
-        "(metrics / healthz / events / trace), per endpoint — the scrape "
-        "heartbeat a dead-man's-switch alert can sit on",
+        "(metrics / healthz / events / trace / debug/flight), per endpoint "
+        "— the scrape heartbeat a dead-man's-switch alert can sit on",
     ),
     "karpenter_store_requests_total": (
         "counter",
@@ -157,5 +158,57 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "store-server RPCs dispatched, per method (put / delete / "
         "bind_pod / evict_pod / lease_* / watch / ...); served from the "
         "store process's own registry on ITS telemetry endpoint",
+    ),
+    # ---- diagnosis layer (docs/designs/observability.md, PR 7)
+    "karpenter_reconcile_tick_duration_seconds": (
+        "histogram",
+        "(none)",
+        "wall-clock duration of one full reconcile_once tick (every "
+        "controller plus the diagnosis tail's own evaluation); the SLO "
+        "engine's tick_duration_p99 signal reads its bucket-honest p99",
+    ),
+    "karpenter_pods_pending_age_seconds": (
+        "gauge",
+        "(none)",
+        "age of the oldest pending pod not yet nominated onto a "
+        "node/claim, on the injected clock, refreshed by the provisioner "
+        "each reconcile (0 when nothing is waiting); the SLO engine's "
+        "pending_pod_age_max signal — the reference's pending-pod-age "
+        "alerting contract",
+    ),
+    "karpenter_slo_status": (
+        "gauge",
+        "rule",
+        "1 while the rule is breached (fast AND slow burn windows over "
+        "budget), 0 once the fast window recovers; transitions also emit "
+        "SLOBreach/SLORecovered ledger events",
+    ),
+    "karpenter_slo_burn_rate": (
+        "gauge",
+        "rule, window",
+        "time-weighted violating fraction over the rule's fast/slow "
+        "window divided by its budget; >= 1 on both windows pages "
+        "(zero-budget rules saturate at 1000 on any violation)",
+    ),
+    "karpenter_slo_breaches_total": (
+        "counter",
+        "rule",
+        "SLOBreach transitions per rule over the process lifetime; the "
+        "sim report's `slo` section carries the per-scenario counts",
+    ),
+    "karpenter_anomaly_detected_total": (
+        "counter",
+        "series, phase",
+        "phase-latency samples that blew past their rolling "
+        "median/MAD baseline (obs/detect.py); each detection also emits "
+        "an AnomalyDetected ledger event carrying baseline vs observed "
+        "and the magnitude",
+    ),
+    "karpenter_flight_dumps_total": (
+        "counter",
+        "trigger",
+        "flight-recorder dumps written, per trigger (slo_breach / "
+        "controller_crash / sigusr1 / http / manual); the dump itself is "
+        "a JSONL ring of the last flight_ticks ticks' full context",
     ),
 }

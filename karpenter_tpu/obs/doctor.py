@@ -1,0 +1,366 @@
+"""``python -m karpenter_tpu doctor`` — from telemetry to diagnosis.
+
+Input: a flight-recorder dump (obs/flight.py JSONL) or a live process
+(``http://host:port`` — fetches ``/debug/flight``).  Output: a terminal
+diagnosis that answers "why was that tick slow / why did that SLO burn"
+without a human staring at dashboards:
+
+1. **phases vs rolling baseline** — per-phase self-time per tick comes
+   from the dump's histogram deltas; the last ticks are compared against
+   the median of the earlier ones, and regressing phases are named;
+2. **event timeline bracketing the breach** — the ledger slice around
+   the first ``SLOBreach`` (or the tail of the dump when nothing
+   breached), one line per decision event;
+3. **rule-based suspected causes** — deterministic correlations over the
+   dump: "CircuitOpen on CreateFleet preceded the provisioning stall",
+   "compile-cache misses spiked after the catalog roll", restated
+   ``AnomalyDetected`` attributions, and (with ``--bench``) regressed
+   lines from a ``bench.py --compare-out`` verdict.
+
+``diagnose()`` is the pure core (tests assert on its dict, not on
+terminal text); ``main()`` is the CLI shell around it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import statistics
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.obs.flight import load_flight, read_flight
+
+# how many trailing ticks count as "recent" when no breach anchors the
+# split (a breach splits the dump at its tick instead)
+RECENT_TICKS = 8
+
+# a phase regresses when its recent median exceeds twice its baseline
+# median AND moves by an absolute floor (sub-ms wiggle is not a story)
+REGRESSION_FACTOR = 2.0
+REGRESSION_FLOOR_S = 0.005
+
+_SERIES_RE = re.compile(r"^(?P<name>[a-z0-9_]+)(?:\{(?P<labels>.*)\})?$")
+
+# histogram family -> short prefix used in phase keys ("solver/compile")
+_FAMILY_SHORT = {
+    "karpenter_solver_phase_seconds": "solver",
+    "karpenter_consolidation_phase_seconds": "consolidation",
+    "karpenter_reconcile_tick_duration_seconds": "tick",
+    "karpenter_provisioner_scheduling_duration_seconds": "scheduling",
+}
+
+
+def _parse_series(key: str) -> Tuple[str, Dict[str, str]]:
+    m = _SERIES_RE.match(key)
+    if m is None:
+        return key, {}
+    labels = {}
+    for pair in (m.group("labels") or "").split(","):
+        if "=" in pair:
+            k, _, v = pair.partition("=")
+            labels[k] = v
+    return m.group("name"), labels
+
+
+def _median(values: List[float]) -> float:
+    return statistics.median(values) if values else 0.0
+
+
+# ----------------------------------------------------------------- analysis
+def phase_series(ticks: List[dict]) -> Dict[str, List[float]]:
+    """phase key ("solver/compile") -> per-tick self-time seconds, one
+    entry per tick (0.0 on ticks where the phase did not run)."""
+    out: Dict[str, List[float]] = {}
+    for i, tick in enumerate(ticks):
+        for key, delta in tick.get("hists", {}).items():
+            name, labels = _parse_series(key)
+            short = _FAMILY_SHORT.get(name)
+            if short is None:
+                continue
+            phase = labels.get("phase", "")
+            pkey = f"{short}/{phase}" if phase else short
+            series = out.setdefault(pkey, [0.0] * len(ticks))
+            series[i] += float(delta.get("sum_s", 0.0))
+    return out
+
+
+def ledger_events(ticks: List[dict]) -> List[Tuple[int, dict]]:
+    """(tick index, event) pairs in emission order."""
+    out = []
+    for i, tick in enumerate(ticks):
+        for ev in tick.get("events", []):
+            out.append((i, ev))
+    return out
+
+
+def counter_deltas(ticks: List[dict], family: str) -> List[float]:
+    """Per-tick delta of one counter family summed over its series."""
+    out = []
+    for tick in ticks:
+        total = 0.0
+        for key, delta in tick.get("counters", {}).items():
+            name, _ = _parse_series(key)
+            if name == family:
+                total += float(delta)
+        out.append(total)
+    return out
+
+
+def _split_index(ticks: List[dict], events) -> int:
+    """Where baseline ends and "recent" begins: the first SLOBreach's
+    tick when one exists, else the last RECENT_TICKS."""
+    for i, ev in events:
+        if ev.get("type") == "SLOBreach":
+            return max(1, i)
+    return max(1, len(ticks) - RECENT_TICKS)
+
+
+def phase_analysis(ticks: List[dict], split: int) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for pkey, series in sorted(phase_series(ticks).items()):
+        base = _median(series[:split])
+        recent = _median(series[split:])
+        regressing = (
+            recent > base * REGRESSION_FACTOR
+            and recent - base > REGRESSION_FLOOR_S
+        )
+        out[pkey] = {
+            "baseline_ms": round(base * 1000.0, 3),
+            "recent_ms": round(recent * 1000.0, 3),
+            "ratio": round(recent / base, 2) if base > 0 else None,
+            "regressing": regressing,
+        }
+    return out
+
+
+# ------------------------------------------------------- suspected causes
+def suspected_causes(
+    ticks: List[dict],
+    events: List[Tuple[int, dict]],
+    phases: Dict[str, dict],
+    bench_verdict: Optional[dict] = None,
+) -> List[str]:
+    causes: List[str] = []
+    regressing = [k for k, p in phases.items() if p["regressing"]]
+    breaches = [(i, ev) for i, ev in events if ev.get("type") == "SLOBreach"]
+
+    # catalog roll -> compile-cache miss storm -> compile-phase blowup
+    rolls = [(i, ev) for i, ev in events if ev.get("type") == "CatalogRolled"]
+    if rolls:
+        i, roll = rolls[0]
+        misses = counter_deltas(
+            ticks, "karpenter_solver_compile_cache_misses_total"
+        )
+        # the roll tick's own misses belong to the roll: the invalidation
+        # happens mid-tick, before that tick's solves recompile
+        before, after = sum(misses[:i]), sum(misses[i:])
+        if after > before:
+            msg = (
+                f"compile-cache misses spiked after the catalog roll "
+                f"(CatalogRolled seq {roll.get('seq')}, tick "
+                f"{roll.get('trace_id') or i}): {int(after)} misses after "
+                f"vs {int(before)} before"
+            )
+            compile_keys = [k for k in regressing if k.endswith("/compile")]
+            if compile_keys:
+                k = compile_keys[0]
+                p = phases[k]
+                msg += (
+                    f"; phase '{k}' regressed to {p['recent_ms']}ms "
+                    f"(baseline {p['baseline_ms']}ms)"
+                )
+            causes.append(msg)
+
+    # circuit open -> provisioning stall
+    opens = [(i, ev) for i, ev in events if ev.get("type") == "CircuitOpen"]
+    if opens:
+        pending = [t.get("summary", {}).get("pending", 0) for t in ticks]
+        i, op_ev = opens[0]
+        stalled = (
+            max(pending[i:], default=0) > max(pending[:i], default=0)
+            or any(
+                bev.get("attrs", {}).get("rule") == "pending-pod-age"
+                for _, bev in breaches
+            )
+        )
+        if stalled:
+            causes.append(
+                f"CircuitOpen on {op_ev.get('attrs', {}).get('api', '?')} "
+                f"(seq {op_ev.get('seq')}) preceded a provisioning stall: "
+                f"pending peaked at {max(pending[i:], default=0)} afterwards"
+            )
+
+    # anomaly attributions are causes by construction
+    for _, ev in events:
+        if ev.get("type") == "AnomalyDetected":
+            a = ev.get("attrs", {})
+            causes.append(
+                f"anomaly in {a.get('series', '?')} phase "
+                f"'{a.get('phase', '')}': observed {a.get('observed_s')}s vs "
+                f"baseline {a.get('baseline_s')}s ({a.get('magnitude')}x)"
+            )
+
+    # any regressing phase not already blamed gets named on its own
+    blamed = " ".join(causes)
+    for k in regressing:
+        if f"'{k}'" not in blamed:
+            p = phases[k]
+            causes.append(
+                f"phase '{k}' regressed: {p['recent_ms']}ms recent vs "
+                f"{p['baseline_ms']}ms baseline"
+            )
+
+    if bench_verdict and bench_verdict.get("regressed"):
+        causes.append(
+            "bench --compare flagged regressions: "
+            + ", ".join(bench_verdict["regressed"])
+        )
+    return causes
+
+
+# ---------------------------------------------------------------- diagnose
+def diagnose(
+    flight: dict, bench_verdict: Optional[dict] = None
+) -> dict:
+    ticks = flight["ticks"]
+    events = ledger_events(ticks)
+    split = _split_index(ticks, events)
+    phases = phase_analysis(ticks, split)
+    breaches = [ev for _, ev in events if ev.get("type") == "SLOBreach"]
+    recoveries = [ev for _, ev in events if ev.get("type") == "SLORecovered"]
+    # the timeline brackets the first breach: everything from a few ticks
+    # before it through the end of the dump (or the whole tail of a
+    # breach-free dump)
+    lo = max(0, split - 4)
+    timeline = [
+        {"tick": i, **ev} for i, ev in events if i >= lo
+    ]
+    return {
+        "meta": flight["meta"],
+        "ticks": len(ticks),
+        "split_tick": split,
+        "breaches": breaches,
+        "recoveries": recoveries,
+        "phases": phases,
+        "regressing_phases": [
+            k for k, p in phases.items() if p["regressing"]
+        ],
+        "timeline": timeline,
+        "suspected_causes": suspected_causes(
+            ticks, events, phases, bench_verdict
+        ),
+    }
+
+
+def render_diagnosis(diag: dict) -> str:
+    out: List[str] = []
+    meta = diag["meta"]
+    out.append(
+        f"flight dump: {diag['ticks']} tick(s), trigger="
+        f"{meta.get('trigger', '?')}, dumped_ts={meta.get('dumped_ts')}"
+    )
+    out.append(
+        f"SLO breaches: {len(diag['breaches'])}, recoveries: "
+        f"{len(diag['recoveries'])}"
+    )
+    out.append("")
+    out.append("phases vs rolling baseline (recent = ticks past the "
+               f"split at tick {diag['split_tick']}):")
+    out.append(
+        f"  {'phase':32s} {'baseline_ms':>12s} {'recent_ms':>10s} "
+        f"{'ratio':>6s}"
+    )
+    for pkey, p in diag["phases"].items():
+        flag = "  << REGRESSING" if p["regressing"] else ""
+        ratio = f"{p['ratio']:.2f}" if p["ratio"] is not None else "-"
+        out.append(
+            f"  {pkey:32s} {p['baseline_ms']:12.3f} {p['recent_ms']:10.3f} "
+            f"{ratio:>6s}{flag}"
+        )
+    out.append("")
+    out.append("event timeline bracketing the breach:")
+    if not diag["timeline"]:
+        out.append("  (no ledger events in the dump)")
+    for ev in diag["timeline"][-40:]:
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sorted(ev.get("attrs", {}).items())
+        )
+        out.append(
+            f"  tick {ev['tick']:>4d}  seq {ev.get('seq', '?'):>5}  "
+            f"{ev.get('type', '?'):18s} {attrs}"
+        )
+    out.append("")
+    out.append("suspected causes:")
+    if diag["suspected_causes"]:
+        for cause in diag["suspected_causes"]:
+            out.append(f"  - {cause}")
+    else:
+        out.append("  - none: no regressing phase, breach, or correlated "
+                   "event in this dump")
+    return "\n".join(out)
+
+
+# --------------------------------------------------------------------- CLI
+def _fetch_flight(base_url: str) -> dict:
+    import urllib.request
+
+    url = base_url.rstrip("/") + "/debug/flight"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        text = resp.read().decode()
+    if not text.strip():
+        raise ValueError(
+            f"{url} returned an empty body (no flight recorder attached?)"
+        )
+    return read_flight(text)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu doctor",
+        description="correlate a flight dump (or a live /debug/flight "
+        "endpoint) into a terminal diagnosis: phases vs baseline, the "
+        "event timeline around the breach, and suspected causes",
+    )
+    parser.add_argument(
+        "input",
+        help="a flight dump JSONL (flight-<trace>.jsonl) or a live "
+        "process base URL (http://host:port)",
+    )
+    parser.add_argument(
+        "--bench",
+        default="",
+        metavar="VERDICT.json",
+        help="a `bench.py --compare-out` verdict to fold into the "
+        "suspected-causes section",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the diagnosis as JSON instead of the terminal report",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        if args.input.startswith(("http://", "https://")):
+            flight = _fetch_flight(args.input)
+        else:
+            flight = load_flight(args.input)
+        verdict = None
+        if args.bench:
+            with open(args.bench) as f:
+                verdict = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"doctor: {exc}", file=sys.stderr)
+        return 64
+
+    diag = diagnose(flight, bench_verdict=verdict)
+    if args.json:
+        print(json.dumps(diag, indent=2, sort_keys=True))
+    else:
+        print(render_diagnosis(diag))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -21,6 +21,15 @@ Event types (emitted at the existing decision sites):
 - ``StaleServed``     a degraded provider served last-good data
 - ``VerdictFallback`` a consolidation what-if the batched path could not
                       answer resolved through the sequential solver
+- ``CatalogRolled``   a provider's catalog cache was invalidated (image
+                      roll); compile storms downstream start here
+- ``SLOBreach``       the SLO engine (obs/slo.py): a rule's fast AND
+                      slow burn-rate windows exceeded budget
+- ``SLORecovered``    the SLO engine: a breached rule's fast window
+                      dropped back under budget
+- ``AnomalyDetected`` streaming anomaly detection (obs/detect.py): a
+                      phase-latency sample blew past its rolling robust
+                      baseline, attrs carry the attribution
 
 Every event stamps the current trace ID (obs/context.py), so the ledger
 joins the span timeline on the same key.  Emission also bumps
@@ -37,7 +46,7 @@ import logging
 import threading
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.obs.context import current_trace_id
 from karpenter_tpu.utils.clock import Clock
@@ -51,6 +60,10 @@ RETRY_BACKOFF = "RetryBackoff"
 CIRCUIT_OPEN = "CircuitOpen"
 STALE_SERVED = "StaleServed"
 VERDICT_FALLBACK = "VerdictFallback"
+CATALOG_ROLLED = "CatalogRolled"
+SLO_BREACH = "SLOBreach"
+SLO_RECOVERED = "SLORecovered"
+ANOMALY_DETECTED = "AnomalyDetected"
 
 EVENT_TYPES = (
     POD_NOMINATED,
@@ -60,6 +73,10 @@ EVENT_TYPES = (
     CIRCUIT_OPEN,
     STALE_SERVED,
     VERDICT_FALLBACK,
+    CATALOG_ROLLED,
+    SLO_BREACH,
+    SLO_RECOVERED,
+    ANOMALY_DETECTED,
 )
 
 # bounded history: several hundred ticks of decisions on a busy cluster
@@ -140,6 +157,26 @@ class EventLedger:
         with self._lock:
             return list(self._ring)[-limit:]
 
+    def read(
+        self, since_seq: int, limit: Optional[int] = None
+    ) -> Tuple[List[ObsEvent], int]:
+        """(events with seq > since_seq still in the ring, dropped count):
+        ``dropped`` counts events that matched the cursor but were already
+        evicted — the loss a poller must see to know its cursor fell
+        behind the ring (the `/events?since_seq=` contract, obs/http.py).
+        ``limit`` caps the returned slice from the OLD end, so a catching-
+        up poller pages forward without skipping."""
+        with self._lock:
+            dropped = (
+                self._ring[0].seq - since_seq - 1
+                if self._ring and self._ring[0].seq > since_seq + 1
+                else max(0, self._seq - since_seq) if not self._ring else 0
+            )
+            events = [ev for ev in self._ring if ev.seq > since_seq]
+        if limit is not None:
+            events = events[: max(0, limit)]
+        return events, dropped
+
     def drain(self, since_seq: int) -> List[ObsEvent]:
         """Events with seq > since_seq still in the ring (the simulator
         polls this once per tick to record the ledger into its trace).
@@ -147,13 +184,7 @@ class EventLedger:
         already evicted the oldest events — that loss is LOUD, never
         silent: a sim trace/report undercounting vs
         ``karpenter_events_total`` must be explainable."""
-        with self._lock:
-            lost = (
-                self._ring[0].seq - since_seq - 1
-                if self._ring and self._ring[0].seq > since_seq + 1
-                else 0
-            )
-            events = [ev for ev in self._ring if ev.seq > since_seq]
+        events, lost = self.read(since_seq)
         if lost > 0:
             log.warning(
                 "event ledger overflowed between drains: %d event(s) "

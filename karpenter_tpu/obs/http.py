@@ -10,11 +10,21 @@ that surface for the reproduction, mounted on BOTH the operator process
                 shared metric catalog, cumulative histogram buckets) —
                 scrapeable by an actual Prometheus server;
 - ``/healthz``  liveness (``ok``) — the chart's probe target;
-- ``/events``   the cluster event ledger's recent ring as JSON — the
-                "why did that node go away?" surface;
+- ``/events``   the cluster event ledger as JSON, with cursor support:
+                ``?since_seq=N&limit=M`` pages forward from a poller's
+                last seen sequence number, and the payload's ``dropped``
+                count says how many events aged out of the ring before
+                the cursor caught up — a poller can fall behind, but
+                never silently.  ``ring_counts`` are per-type counts over
+                the bounded ring; ``total_counts`` mirror the cumulative
+                ``karpenter_events_total`` census (the two diverge once
+                the ring overflows — by design);
 - ``/trace``    the span tracer's aggregates + recent spans as JSON —
                 feedable to ``python -m karpenter_tpu obs`` for a
-                Perfetto-loadable timeline.
+                Perfetto-loadable timeline;
+- ``/debug/flight``  the flight recorder's ring (obs/flight.py) as
+                JSONL — the same artifact a breach dumps to disk, for
+                ``python -m karpenter_tpu doctor http://host:port``.
 
 Every request bumps ``karpenter_telemetry_scrapes_total{endpoint}`` so
 the scrape cadence is itself observable (a stalled scraper is an
@@ -27,6 +37,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -52,11 +63,60 @@ def _trace_payload(tracer) -> dict:
     }
 
 
+def _int_param(params: dict, name: str, default: int) -> int:
+    try:
+        return int(params.get(name, [default])[0])
+    except (TypeError, ValueError):
+        return default
+
+
+def events_payload(ledger, registry: Registry, params: dict) -> dict:
+    """The /events JSON body.  ``since_seq``/``limit`` page the ring
+    forward (oldest first); WITHOUT a cursor the newest ``limit`` events
+    are served — a bare curl must show what just happened, not the
+    oldest survivors of a full ring.  ``last_seq`` is the cursor for the
+    next poll.  ``dropped``
+    counts events the cursor missed because they aged out of the
+    4096-entry ring — without it a slow poller silently undercounts.
+    ``ring_counts`` (the old ambiguous ``counts``) covers only what the
+    ring still holds; ``total_counts`` is the cumulative
+    ``karpenter_events_total`` census from the registry."""
+    since_seq = _int_param(params, "since_seq", 0)
+    limit = _int_param(params, "limit", 500)
+    if ledger is None:
+        events, dropped = [], 0
+    elif "since_seq" in params:
+        # cursor mode: page forward from the poller's last seen seq,
+        # oldest first, with the dropped count for ring overflow
+        events, dropped = ledger.read(since_seq, limit)
+    else:
+        # no cursor: the human-curl case — serve the NEWEST events, the
+        # "why did that node go away?" surface
+        events, dropped = ledger.recent(limit), 0
+    with registry._lock:
+        # copy under the lock: the operator thread inserts a NEW label
+        # key the instant a first-of-its-type event fires — exactly the
+        # moment a poller is most likely to be reading this
+        census = dict(registry.counters.get("karpenter_events_total", {}))
+    total_counts = {
+        labels[0][1] if labels else "": int(v)
+        for labels, v in census.items()
+    }
+    return {
+        "events": [ev.to_dict() for ev in events],
+        "last_seq": events[-1].seq if events else since_seq,
+        "dropped": dropped,
+        "ring_counts": ledger.counts() if ledger is not None else {},
+        "total_counts": dict(sorted(total_counts.items())),
+    }
+
+
 def start_telemetry(
     port: int,
     registry: Registry,
     tracer=None,
     ledger=None,
+    flight=None,
     host: str = "",
 ) -> ThreadingHTTPServer:
     """Serve the telemetry surface on (host, port) in a daemon thread;
@@ -65,8 +125,11 @@ def start_telemetry(
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (http.server API)
-            path = self.path.split("?", 1)[0]
-            if path not in ("/metrics", "/healthz", "/events", "/trace"):
+            path, _, query = self.path.partition("?")
+            known = (
+                "/metrics", "/healthz", "/events", "/trace", "/debug/flight",
+            )
+            if path not in known:
                 self.send_response(404)
                 self.end_headers()
                 return
@@ -84,13 +147,27 @@ def start_telemetry(
                 body = b"ok"
                 ctype = "text/plain"
             elif path == "/events":
-                events = (
-                    [ev.to_dict() for ev in ledger.recent(500)]
-                    if ledger is not None
+                payload = events_payload(
+                    ledger, registry, urllib.parse.parse_qs(query)
+                )
+                body = json.dumps(payload, sort_keys=True).encode()
+                ctype = "application/json"
+            elif path == "/debug/flight":
+                lines = (
+                    flight.dump_lines(trigger="http")
+                    if flight is not None
                     else []
                 )
-                body = json.dumps(events, sort_keys=True).encode()
-                ctype = "application/json"
+                if flight is not None:
+                    # dump_lines itself never counts (FlightRecorder.dump
+                    # counts after a successful disk write); the served
+                    # dump counts here so the documented {trigger="http"}
+                    # series exists
+                    registry.inc(
+                        "karpenter_flight_dumps_total", {"trigger": "http"}
+                    )
+                body = ("\n".join(lines) + "\n").encode() if lines else b""
+                ctype = "application/x-ndjson"
             else:  # /trace
                 payload = (
                     _trace_payload(tracer) if tracer is not None else {}

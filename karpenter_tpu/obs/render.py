@@ -1,14 +1,20 @@
 """Trace renderer: span rings and sim traces -> Chrome-trace JSON.
 
-``python -m karpenter_tpu obs INPUT`` converts either
+``python -m karpenter_tpu obs INPUT`` converts any of
 
 - a span dump (``Tracer.dump`` JSON, also served live at ``/trace``) —
   every recorded span becomes a duration event, one timeline row per
-  trace ID, so "where did the tick go" reads as a flame slice; or
+  trace ID, so "where did the tick go" reads as a flame slice;
 - a recorded sim trace (the JSONL the scenario runner writes) — ticks
   become duration events on a ``sim`` row, injected scenario events and
   cluster-ledger events become instant markers, and the per-tick digest
-  becomes counter tracks (pending pods, nodes, running instances)
+  becomes counter tracks (pending pods, nodes, running instances); or
+- a flight-recorder dump (obs/flight.py JSONL, dumped on SLOBreach /
+  crash / SIGUSR1 or fetched from ``/debug/flight``) — ticks become
+  duration events (wall durations on the injected-clock timeline),
+  ledger events become instant markers, per-tick spans nest under their
+  tick, and the cluster summary becomes counter tracks — so a breach
+  artifact opens directly in Perfetto
 
 into Chrome-trace (Perfetto / chrome://tracing loadable) JSON, plus a
 terminal top-N SELF-time table — the ``pprof -top`` analogue, computed
@@ -200,11 +206,108 @@ def sim_event_counts(lines: List[dict]) -> Dict[str, int]:
     return out
 
 
+# ------------------------------------------------------------ flight dumps
+def chrome_from_flight(flight: dict) -> dict:
+    """Flight-recorder dump (obs/flight.py) -> chrome-trace dict.  Ticks
+    are duration events placed at their injected-clock timestamps with
+    their WALL durations (a 1s-cadence loop whose ticks take ~10ms reads
+    as sparse slices — correct: the gaps are idle time); ledger events
+    are instants on their own row, per-tick spans nest on a third row,
+    and the pending/nodes/running summary becomes counter tracks."""
+    ticks = flight["ticks"]
+    base = ticks[0]["ts"] if ticks else 0.0
+
+    def ts(t: float) -> float:
+        return round((t - base) * _US, 3)
+
+    events: List[dict] = []
+    for tick in ticks:
+        start = tick["ts"] - tick.get("dur_s", 0.0)
+        events.append(
+            {
+                "name": f"tick {tick['seq']}",
+                "ph": "X",
+                "ts": ts(start),
+                "dur": round(tick.get("dur_s", 0.0) * _US, 3),
+                "pid": 1,
+                "tid": 1,
+                "args": {
+                    "trace_id": tick.get("trace_id", ""),
+                    **tick.get("summary", {}),
+                },
+            }
+        )
+        for ev in tick.get("events", []):
+            events.append(
+                {
+                    "name": ev.get("type", "?"),
+                    "ph": "i",
+                    "s": "t",
+                    "ts": ts(ev.get("ts", tick["ts"])),
+                    "pid": 1,
+                    "tid": 2,
+                    "args": {
+                        "trace_id": ev.get("trace_id", ""),
+                        **ev.get("attrs", {}),
+                    },
+                }
+            )
+        # spans carry perf_counter starts, not clock time: re-anchor them
+        # inside their tick proportionally to their own earliest start
+        spans = tick.get("spans", [])
+        if spans:
+            s0 = min(s.get("start_s", 0.0) for s in spans)
+            for s in spans:
+                events.append(
+                    {
+                        "name": s["path"],
+                        "ph": "X",
+                        "ts": ts(start + (s.get("start_s", 0.0) - s0)),
+                        "dur": round(s.get("duration_s", 0.0) * _US, 3),
+                        "pid": 1,
+                        "tid": 3,
+                        "args": dict(s.get("meta", {})),
+                    }
+                )
+        for counter in ("pending", "nodes", "running"):
+            if counter in tick.get("summary", {}):
+                events.append(
+                    {
+                        "name": counter,
+                        "ph": "C",
+                        "ts": ts(tick["ts"]),
+                        "pid": 1,
+                        "tid": 0,
+                        "args": {counter: tick["summary"][counter]},
+                    }
+                )
+    events += [
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+         "args": {"name": "ticks"}},
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": 2,
+         "args": {"name": "cluster ledger"}},
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": 3,
+         "args": {"name": "spans"}},
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": f"flight ({flight['meta'].get('trigger', '?')})"}},
+    ]
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def flight_event_counts(flight: dict) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for tick in flight["ticks"]:
+        for ev in tick.get("events", []):
+            out[ev["type"]] = out.get(ev["type"], 0) + 1
+    return out
+
+
 # --------------------------------------------------------------------- CLI
 def _load(path: str) -> Tuple[str, object]:
     """Autodetect the input kind: ('sim', jsonl lines) for a scenario
-    trace (first line has ``"t": "meta"``), ('spans', payload) for a
-    Tracer dump / a /trace scrape."""
+    trace (first line has ``"t": "meta"``), ('flight', flight dict) for
+    a flight-recorder dump (first line has ``"t": "flight"``),
+    ('spans', payload) for a Tracer dump / a /trace scrape."""
     with open(path) as f:
         text = f.read()
     first = text.lstrip().split("\n", 1)[0]
@@ -214,14 +317,19 @@ def _load(path: str) -> Tuple[str, object]:
         head = None
     if isinstance(head, dict) and head.get("t") == "meta":
         return "sim", [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+    if isinstance(head, dict) and head.get("t") == "flight":
+        from karpenter_tpu.obs.flight import read_flight
+
+        return "flight", read_flight(text)
     payload = json.loads(text)
     if isinstance(payload, dict) and (
         "stats" in payload or "recent" in payload
     ):
         return "spans", payload
     raise ValueError(
-        f"{path}: neither a sim trace (JSONL with a meta line) nor a span "
-        "dump (JSON with stats/recent)"
+        f"{path}: not a sim trace (JSONL with a meta line), a flight dump "
+        "(JSONL with a flight header), or a span dump (JSON with "
+        "stats/recent)"
     )
 
 
@@ -233,8 +341,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "input",
-        help="a sim trace JSONL (sim-<scenario>-seed<N>.jsonl) or a span "
-        "dump JSON (Tracer.dump / a /trace scrape)",
+        help="a sim trace JSONL (sim-<scenario>-seed<N>.jsonl), a flight-"
+        "recorder dump (flight-<trace>.jsonl / a /debug/flight fetch), or "
+        "a span dump JSON (Tracer.dump / a /trace scrape)",
     )
     parser.add_argument(
         "--out",
@@ -256,6 +365,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"  {type_:20s} {n:6d}")
         else:
             print("no cluster-ledger lines in this trace")
+    elif kind == "flight":
+        chrome = chrome_from_flight(data)
+        counts = flight_event_counts(data)
+        if counts:
+            print("cluster events recorded in the flight dump:")
+            for type_, n in sorted(counts.items()):
+                print(f"  {type_:20s} {n:6d}")
+        else:
+            print("no cluster events in this flight dump")
+        print(
+            "diagnose it: python -m karpenter_tpu doctor "
+            f"{args.input}", file=sys.stderr,
+        )
     else:
         chrome = chrome_from_spans(data)
         stats = data.get("stats", {})

@@ -13,7 +13,9 @@ goroutines; `run` loops it for real deployments.
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -35,8 +37,11 @@ from karpenter_tpu.controllers.consistency import ConsistencyController
 from karpenter_tpu.controllers.metrics_state import MetricsStateController
 from karpenter_tpu.metrics.decorators import MetricsCloudProvider
 from karpenter_tpu.metrics.registry import REGISTRY, Registry
-from karpenter_tpu.obs.context import mint_trace_id, set_tick
+from karpenter_tpu.obs.context import current_trace_id, mint_trace_id, set_tick
+from karpenter_tpu.obs.detect import AnomalyDetector
 from karpenter_tpu.obs.events import EventLedger
+from karpenter_tpu.obs.flight import FlightRecorder
+from karpenter_tpu.obs.slo import SLOEngine, default_rules
 from karpenter_tpu.providers.image import ImageProvider, Resolver
 from karpenter_tpu.providers.instance import InstanceProvider
 from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
@@ -104,6 +109,31 @@ class Operator:
         self.tracer.profile_dir = (
             self.settings.profile_dir if self.settings.enable_profiling else ""
         )
+        # diagnosis layer (docs/designs/observability.md §diagnosis):
+        # - the SLO engine evaluates its rule set at the end of every tick
+        #   against the registry the controllers just wrote,
+        # - the anomaly detector baselines the phase-latency series (the
+        #   simulator disables it: wall-clock judgments cannot enter a
+        #   byte-compared trace),
+        # - the flight recorder keeps the last N ticks' full context on a
+        #   ring, dumped on SLOBreach / controller crash / SIGUSR1
+        self.slo = SLOEngine(
+            registry, self.clock, rules=default_rules(self.settings)
+        )
+        self.detector = AnomalyDetector(
+            registry, self.clock,
+            enabled=self.settings.enable_anomaly_detection,
+        )
+        self.flight = FlightRecorder(
+            self.clock, registry, ledger=self.ledger, tracer=self.tracer,
+            capacity=self.settings.flight_ticks,
+        )
+        # out-of-band dump requests (SIGUSR1) land here and are honored
+        # at the next tick's diagnosis tail: a signal handler must never
+        # dump directly — it runs on the main thread and would deadlock
+        # on whatever non-reentrant lock (registry, flight ring) the
+        # interrupted frame already holds
+        self._flight_request: Optional[str] = None
         # resilience layer (cloud/retry.py): every provider talks to the
         # cloud through classified retries + per-API circuit breakers — the
         # AWS-SDK retry behavior the reference relies on implicitly
@@ -255,6 +285,14 @@ class Operator:
             log.exception(
                 "controller %s reconcile failed; requeued in %.1fs", name, delay
             )
+            if self.settings.flight_dir and entry is None:
+                # preserve the ticks LEADING UP to the crash (this tick's
+                # own slice lands on the ring in _observe_tick, after the
+                # remaining controllers run).  Only the failure that
+                # ENTERS backoff dumps: a persistently crashing
+                # controller writes one artifact per crash episode, not
+                # one per retry forever (dumps are never pruned)
+                self.dump_flight("controller_crash")
             return
         if entry is not None:
             del self._ctrl_backoff[name]
@@ -288,7 +326,15 @@ class Operator:
                 self.elector.identity if self.elector is not None else "",
             )
         )
+        # the diagnosis tail runs even when the tick abdicates or a
+        # controller layer raises: a minted tick is a recorded tick
+        t0 = time.perf_counter()
+        try:
+            self._run_controllers()
+        finally:
+            self._observe_tick(time.perf_counter() - t0)
 
+    def _run_controllers(self) -> None:
         # re-arm the shared cloud-API retry budget for this tick
         self.retrying.begin_tick()
         sequence = [
@@ -337,6 +383,71 @@ class Operator:
             self._pricing_updated_at = (
                 now if ok else now - PRICING_UPDATE_PERIOD + PRICING_RETRY_PERIOD
             )
+
+    # ------------------------------------------------------------ diagnosis
+    def _observe_tick(self, dur_s: float) -> None:
+        """The per-tick diagnosis tail: observe the tick's wall duration,
+        evaluate the SLO rules, scan for phase-latency anomalies, and
+        snapshot the tick into the flight recorder — in that order, so
+        the flight slice captures any SLOBreach/AnomalyDetected events
+        this very tick produced.  A fresh breach dumps the ring when
+        ``settings.flight_dir`` is configured."""
+        self.registry.observe(
+            "karpenter_reconcile_tick_duration_seconds", dur_s
+        )
+        breaches = self.slo.evaluate()
+        self.detector.scan()
+        summary = {
+            "pending": len(self.kube.pending_pods()),
+            "nodes": len(self.kube.nodes),
+            "claims": len(self.kube.node_claims),
+        }
+        instances = getattr(self.cloud, "instances", None)
+        if instances is not None:
+            summary["running"] = sum(
+                1 for i in instances.values() if i.state == "running"
+            )
+        self.flight.record(
+            self._tick_seq, current_trace_id(), dur_s, summary
+        )
+        request = self._flight_request
+        if request:
+            self._flight_request = None
+            path = self.dump_flight(
+                request, directory=self.settings.flight_dir or "."
+            )
+            log.info("flight recorder dumped to %s (%s)", path, request)
+        if breaches and self.settings.flight_dir:
+            path = self.dump_flight("slo_breach")
+            log.warning(
+                "SLO breach (%s); flight recorder dumped to %s",
+                ", ".join(breaches), path,
+            )
+
+    def request_flight_dump(self, trigger: str) -> None:
+        """Ask for a flight dump at the end of the current/next tick.
+        Safe to call from a signal handler (a single attribute write);
+        the dump itself runs in ``_observe_tick``, falling back to the
+        working directory when ``flight_dir`` is unset so SIGUSR1 always
+        produces an artifact."""
+        self._flight_request = trigger
+
+    def dump_flight(
+        self, trigger: str, directory: Optional[str] = None
+    ) -> Optional[str]:
+        """Dump the flight ring to ``<dir>/flight-<trace_id>-<trigger>
+        .jsonl``; ``directory`` falls back to ``settings.flight_dir``
+        (None when neither is set — the ring stays in-memory, still
+        served at /debug/flight)."""
+        directory = directory or self.settings.flight_dir
+        if not directory:
+            return None
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(
+            directory,
+            f"flight-{current_trace_id() or 'boot'}-{trigger}.jsonl",
+        )
+        return self.flight.dump(path, trigger=trigger)
 
     def run(self, interval_s: float = 1.0) -> None:
         """Blocking controller-manager loop for real deployments.  A tick

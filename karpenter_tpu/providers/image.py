@@ -104,6 +104,7 @@ class ImageProvider:
 
     def __init__(self, cloud: FakeCloud, clock: Clock, registry=None):
         self.cloud = cloud
+        self.registry = registry
         self._cache = TTLCache(clock, DEFAULT_TTL)
         self._stale = StaleGuard("image", clock, registry)
 
@@ -141,7 +142,14 @@ class ImageProvider:
         return out
 
     def invalidate(self) -> None:
+        """Flush the image cache (catalog roll).  Ledgered: the compile
+        storms and drift churn that follow a roll start HERE, and the
+        doctor's "compile-cache misses spiked after the catalog roll"
+        correlation needs the trigger to be a ledger fact, not an
+        inference (obs/doctor.py)."""
         self._cache.flush()
+        if self.registry is not None:
+            self.registry.event("CatalogRolled", provider="image")
 
 
 def image_family(node_class: NodeClass) -> ImageFamily:

@@ -168,6 +168,11 @@ def build_report(runner) -> dict:
             "checked_ticks": runner.checker.checked_ticks,
             "violations": [str(v) for v in runner.checker.violations],
         },
+        # scenario-declared SLO rules (obs/slo.py), evaluated by the real
+        # engine each tick: breach/recovery counts, final status, and
+        # total simulated time spent breached — deterministic, so replays
+        # reproduce it byte-for-byte
+        "slo": env.operator.slo.report(),
     }
 
 

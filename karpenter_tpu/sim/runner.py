@@ -38,6 +38,7 @@ from karpenter_tpu.cloud.fake.backend import (
     FakeImage,
     MachineShape,
 )
+from karpenter_tpu.obs.slo import SLORule
 from karpenter_tpu.sim.invariants import InvariantChecker
 from karpenter_tpu.sim.report import build_report
 from karpenter_tpu.sim.trace import TraceWriter, read_tape
@@ -101,6 +102,13 @@ class Scenario:
     settle_rounds: int = 30
     settle_step_s: float = 2.0
     schedule_deadline_s: float = 420.0
+    # scenario-declared SLO rules (obs/slo.py), evaluated by the REAL
+    # operator engine once per tick.  The runner replaces the operator's
+    # production defaults with exactly this list: sim rules must read
+    # only deterministic signals (pending-pod age, circuit state, ...),
+    # never host wall time, so breach/recovery ledger lines replay
+    # byte-identically.  Empty = the engine idles.
+    slo_rules: List[SLORule] = field(default_factory=list)
     description: str = ""
 
 
@@ -153,6 +161,13 @@ class ScenarioRunner:
         op.provisioner.launch_concurrency = 1
         if op.interruption is not None:
             op.interruption.workers = 1
+        # the sim evaluates the SCENARIO's SLO rules (deterministic
+        # signals only) instead of the production defaults — tick
+        # durations are host wall time, and the anomaly detector judges
+        # wall-time series, so both would contaminate the byte-compared
+        # ledger surface
+        op.slo.replace_rules(scenario.slo_rules)
+        op.detector.enabled = False
         self.env.cloud.chaos.reseed(seed + 1)
         self.rng = random.Random(seed)
         self.view = SimView(self)
@@ -496,6 +511,20 @@ def _api_storm_catalog_roll(ticks: int) -> Scenario:
                 }
             ),
         ],
+        slo_rules=[
+            SLORule(
+                name="cloud-circuit-open", signal="circuits_open",
+                threshold=0.0, op=">", budget=0.1,
+                fast_window_s=10.0, slow_window_s=30.0,
+                description="cloud circuit breakers open under the storm",
+            ),
+            SLORule(
+                name="pending-pod-age", signal="pending_pod_age_max",
+                threshold=60.0, op=">", budget=0.1,
+                fast_window_s=20.0, slow_window_s=60.0,
+                description="pods must nominate within a simulated minute",
+            ),
+        ],
     )
 
 
@@ -525,6 +554,43 @@ def _diurnal_interruption(ticks: int) -> Scenario:
                                    "kw": {"rate": 0.0}}),
                     ],
                 }
+            ),
+        ],
+    )
+
+
+@scenario(
+    "slo-burn",
+    "a short blackout opens circuit breakers: deterministic SLO "
+    "burn-rate breach, then recovery — the diagnosis layer's acceptance "
+    "scenario in miniature",
+)
+def _slo_burn(ticks: int) -> Scenario:
+    t1 = max(3, ticks // 6)
+    return Scenario(
+        "slo-burn",
+        workloads=[
+            Steady(rate=0.3),
+            Script(
+                {
+                    t1: [("chaos", {"op": "add_blackout",
+                                    "kw": {"duration": 8.0}})],
+                }
+            ),
+        ],
+        slo_rules=[
+            SLORule(
+                name="cloud-circuit-open", signal="circuits_open",
+                threshold=0.0, op=">", budget=0.1,
+                fast_window_s=10.0, slow_window_s=30.0,
+                description="the blackout opens breakers; closing them "
+                "recovers the rule",
+            ),
+            SLORule(
+                name="pending-pod-age", signal="pending_pod_age_max",
+                threshold=60.0, op=">", budget=0.1,
+                fast_window_s=20.0, slow_window_s=60.0,
+                description="pods must nominate within a simulated minute",
             ),
         ],
     )
@@ -566,6 +632,19 @@ def chaos_soak_scenario(faulty_ticks: int) -> Scenario:
         ],
         tick_jitter=(0.5, 1.0, 2.0),
         settle_rounds=40,
+        # the acceptance scenario for the diagnosis layer: the blackout
+        # opens circuit breakers -> burn-rate breach; the post-clear
+        # recovery closes them -> SLORecovered.  Both land in the ledger
+        # (and so in the byte-compared `led` trace lines) with the
+        # breaching tick's trace ID.
+        slo_rules=[
+            SLORule(
+                name="cloud-circuit-open", signal="circuits_open",
+                threshold=0.0, op=">", budget=0.1,
+                fast_window_s=10.0, slow_window_s=30.0,
+                description="cloud circuit breakers open under chaos",
+            ),
+        ],
     )
 
 

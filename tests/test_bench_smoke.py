@@ -115,7 +115,9 @@ class TestCompare:
     ):
         """Drive the REAL --compare path at tiny scale: a prior file with
         generously slower values (so measurement noise cannot fake a
-        regression) compares clean, prints per-line deltas, returns 0."""
+        regression) compares clean, prints per-line deltas, returns 0 —
+        and --compare-out writes the machine-readable verdict with the
+        schema CI and `doctor --bench` ingest."""
         prior = tmp_path / "prior.jsonl"
         prior.write_text(
             "\n".join(
@@ -124,7 +126,8 @@ class TestCompare:
             )
             + "\n"
         )
-        rc = bench.main(tiny=True, compare=str(prior))
+        out = tmp_path / "verdict.json"
+        rc = bench.main(tiny=True, compare=str(prior), compare_out=str(out))
         captured = capsys.readouterr()
         assert rc == 0
         assert "REGRESSION" not in captured.err
@@ -132,6 +135,29 @@ class TestCompare:
         # stdout stayed the machine-readable line stream
         for line in captured.out.strip().splitlines():
             assert "metric" in json.loads(line)
+        # the verdict schema (the --compare-out satellite)
+        verdict = json.loads(out.read_text())
+        assert verdict["baseline"] == str(prior)
+        assert verdict["ok"] is True and verdict["regressed"] == []
+        assert verdict["threshold"] == bench.COMPARE_THRESHOLD
+        assert len(verdict["lines"]) >= len(bench_lines)
+        for line in verdict["lines"]:
+            assert {"metric", "prior_ms", "new_ms", "delta_pct",
+                    "regressed", "status"} <= set(line)
+            assert line["status"] in ("compared", "new", "absent")
+            assert line["regressed"] is False
+
+    def test_compare_verdict_flags_regressions(self):
+        old = [{"metric": "a_p50", "value": 100.0}]
+        new = [{"metric": "a_p50", "value": 130.0},
+               {"metric": "b_p50", "value": 5.0}]
+        verdict = bench.compare_verdict(new, old)
+        assert verdict["ok"] is False
+        assert verdict["regressed"] == ["a_p50"]
+        by_metric = {l["metric"]: l for l in verdict["lines"]}
+        assert by_metric["a_p50"]["delta_pct"] == pytest.approx(30.0)
+        assert by_metric["a_p50"]["regressed"] is True
+        assert by_metric["b_p50"]["status"] == "new"
 
 
 class TestMarginalEstimate:

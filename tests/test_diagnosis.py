@@ -1,0 +1,622 @@
+"""The diagnosis layer (docs/designs/observability.md §5): SLO burn-rate
+engine, streaming anomaly detection, tick flight recorder, and the
+``doctor`` CLI — plus their operator wiring and the simulator's
+scenario-declared rules with byte-identical breach/recovery replay."""
+
+import json
+import os
+
+import pytest
+
+from karpenter_tpu.api import Pod, Resources, Settings
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.obs.context import set_tick
+from karpenter_tpu.obs.detect import AnomalyDetector, robust_baseline
+from karpenter_tpu.obs.doctor import diagnose, render_diagnosis
+from karpenter_tpu.obs.events import EventLedger
+from karpenter_tpu.obs.flight import FlightRecorder, load_flight, read_flight
+from karpenter_tpu.obs.slo import (
+    BURN_CAP,
+    SIGNALS,
+    SLOEngine,
+    SLORule,
+    default_rules,
+)
+from karpenter_tpu.testing import Environment
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_tick():
+    set_tick("")
+    yield
+    set_tick("")
+
+
+def _engine(rules, clock=None):
+    clock = clock or FakeClock()
+    reg = Registry()
+    reg.ledger = EventLedger(clock=clock, registry=reg)
+    return SLOEngine(reg, clock, rules=rules), reg, clock
+
+
+# ------------------------------------------------------------- SLO engine
+class TestSLOEngine:
+    RULE = SLORule(
+        name="pending", signal="pending_pod_age_max", threshold=10.0,
+        budget=0.5, fast_window_s=10.0, slow_window_s=20.0,
+    )
+
+    def test_breach_needs_both_windows_then_recovers(self):
+        eng, reg, clock = _engine([self.RULE])
+        set_tick("tick-000001")
+        # healthy history first: the slow window must confirm, so a
+        # single violating tick cannot page
+        for _ in range(20):
+            reg.set("karpenter_pods_pending_age_seconds", 1.0)
+            clock.step(1.0)
+            assert eng.evaluate() == []
+        reg.set("karpenter_pods_pending_age_seconds", 99.0)
+        clock.step(1.0)
+        assert eng.evaluate() == []  # fast window pages, slow not yet
+        assert reg.gauge(
+            "karpenter_slo_burn_rate", {"rule": "pending", "window": "fast"}
+        ) > 0.0
+        breached_at = None
+        for i in range(20):
+            clock.step(1.0)
+            if eng.evaluate() == ["pending"]:
+                breached_at = i
+                break
+        assert breached_at is not None
+        assert reg.gauge("karpenter_slo_status", {"rule": "pending"}) == 1.0
+        assert reg.counter(
+            "karpenter_slo_breaches_total", {"rule": "pending"}
+        ) == 1
+        (ev,) = [
+            e for e in reg.ledger.recent() if e.type == "SLOBreach"
+        ]
+        assert ev.attrs["rule"] == "pending"
+        assert ev.attrs["signal"] == "pending_pod_age_max"
+        assert ev.trace_id == "tick-000001"
+        assert float(ev.attrs["burn_fast"]) >= 1.0
+        assert float(ev.attrs["burn_slow"]) >= 1.0
+        # recovery: the fast window drains below burn 1
+        reg.set("karpenter_pods_pending_age_seconds", 0.0)
+        recovered = False
+        for _ in range(15):
+            clock.step(1.0)
+            eng.evaluate()
+            if reg.gauge("karpenter_slo_status", {"rule": "pending"}) == 0.0:
+                recovered = True
+                break
+        assert recovered
+        (rec,) = [
+            e for e in reg.ledger.recent() if e.type == "SLORecovered"
+        ]
+        assert rec.attrs["rule"] == "pending"
+        assert float(rec.attrs["breached_s"]) > 0.0
+        rep = eng.report()["rules"]["pending"]
+        assert rep["breaches"] == 1 and rep["recoveries"] == 1
+        assert rep["status"] == "ok" and rep["breached_s"] > 0.0
+
+    def test_zero_budget_rule_pages_immediately(self):
+        rule = SLORule(
+            name="mismatch", signal="verdict_mismatches", threshold=0.0,
+            budget=0.0, fast_window_s=10.0, slow_window_s=20.0,
+        )
+        eng, reg, clock = _engine([rule])
+        assert eng.evaluate() == []  # 0 mismatches: healthy
+        reg.inc("karpenter_consolidation_verdict_mismatch_total")
+        clock.step(1.0)
+        assert eng.evaluate() == ["mismatch"]
+        assert reg.gauge(
+            "karpenter_slo_burn_rate", {"rule": "mismatch", "window": "fast"}
+        ) == BURN_CAP
+
+    def test_signal_without_data_is_not_judged(self):
+        rule = SLORule(
+            name="hit-rate", signal="compile_cache_hit_rate",
+            threshold=0.5, op="<", budget=0.1,
+        )
+        eng, reg, clock = _engine([rule])
+        for _ in range(5):
+            clock.step(1.0)
+            assert eng.evaluate() == []
+        # fewer than 20 observations: the signal stays None, no gauges
+        assert reg.gauge("karpenter_slo_status", {"rule": "hit-rate"}) is None
+
+    def test_floor_rule_uses_less_than(self):
+        rule = SLORule(
+            name="hit-rate", signal="compile_cache_hit_rate",
+            threshold=0.5, op="<", budget=0.0,
+            fast_window_s=10.0, slow_window_s=10.0,
+        )
+        eng, reg, clock = _engine([rule])
+        reg.inc(
+            "karpenter_solver_compile_cache_misses_total",
+            {"consumer": "provisioner"}, by=30,
+        )
+        clock.step(1.0)
+        assert eng.evaluate() == ["hit-rate"]  # 0% hit rate < 50% floor
+
+    def test_disabled_rule_never_evaluates(self):
+        rule = SLORule(
+            name="pending", signal="pending_pod_age_max", threshold=-1.0,
+            budget=0.0, enabled=False,
+        )
+        eng, reg, clock = _engine([rule])
+        reg.set("karpenter_pods_pending_age_seconds", 5.0)
+        assert eng.evaluate() == []
+        assert reg.gauge("karpenter_slo_status", {"rule": "pending"}) is None
+
+
+class TestDefaultRules:
+    def test_defaults_cover_the_issue_signal_set(self):
+        rules = {r.name: r for r in default_rules()}
+        assert {
+            "tick-duration-p99", "pending-pod-age", "verdict-mismatch",
+            "cloud-circuit-open", "compile-cache-hit-rate",
+            "provider-staleness",
+        } <= set(rules)
+        for r in rules.values():
+            assert r.signal in SIGNALS
+
+    def test_settings_overrides_merge_and_extend(self):
+        s = Settings(
+            cluster_name="t",
+            slo_rules={
+                "pending-pod-age": {"threshold": 30.0, "budget": 0.2},
+                "tick-duration-p99": {"enabled": False},
+                "my-staleness": {
+                    "signal": "provider_staleness_max", "threshold": 5.0,
+                },
+            },
+        )
+        rules = {r.name: r for r in default_rules(s)}
+        assert rules["pending-pod-age"].threshold == 30.0
+        assert rules["pending-pod-age"].budget == 0.2
+        assert not rules["tick-duration-p99"].enabled
+        assert rules["my-staleness"].signal == "provider_staleness_max"
+
+    def test_unknown_signal_and_missing_signal_rejected(self):
+        with pytest.raises(ValueError, match="unknown signal"):
+            default_rules(
+                Settings(cluster_name="t", slo_rules={
+                    "x": {"signal": "nope", "threshold": 1.0},
+                })
+            )
+        with pytest.raises(ValueError, match="must name a signal"):
+            default_rules(
+                Settings(cluster_name="t", slo_rules={
+                    "x": {"threshold": 1.0},
+                })
+            )
+
+
+# ------------------------------------------------------ anomaly detection
+class TestAnomalyDetector:
+    def _detector(self, **kw):
+        clock = FakeClock()
+        reg = Registry()
+        reg.ledger = EventLedger(clock=clock, registry=reg)
+        det = AnomalyDetector(reg, clock, **kw)
+        return det, reg, clock
+
+    def test_spike_detected_with_attribution(self):
+        det, reg, clock = self._detector()
+        for _ in range(16):
+            reg.observe(
+                "karpenter_solver_phase_seconds", 0.004, {"phase": "compile"}
+            )
+        assert det.scan() == []  # a flat baseline is healthy
+        reg.observe(
+            "karpenter_solver_phase_seconds", 0.2, {"phase": "compile"}
+        )
+        (det_ev,) = det.scan()
+        assert det_ev["series"] == "karpenter_solver_phase_seconds"
+        assert det_ev["phase"] == "compile"
+        assert det_ev["baseline_s"] == pytest.approx(0.004)
+        assert det_ev["observed_s"] == pytest.approx(0.2)
+        assert det_ev["magnitude"] == pytest.approx(50.0)
+        (ev,) = [
+            e for e in reg.ledger.recent() if e.type == "AnomalyDetected"
+        ]
+        assert ev.attrs["phase"] == "compile"
+        assert reg.counter(
+            "karpenter_anomaly_detected_total",
+            {"series": "karpenter_solver_phase_seconds", "phase": "compile"},
+        ) == 1
+
+    def test_cold_series_and_micro_jitter_stay_quiet(self):
+        det, reg, clock = self._detector()
+        # below min_baseline: unjudgeable
+        for _ in range(4):
+            reg.observe(
+                "karpenter_solver_phase_seconds", 0.001, {"phase": "pad"}
+            )
+        reg.observe("karpenter_solver_phase_seconds", 0.5, {"phase": "pad"})
+        assert det.scan() == []
+        # microsecond wiggle never pages even at huge relative magnitude
+        for _ in range(16):
+            reg.observe(
+                "karpenter_solver_phase_seconds", 1e-5, {"phase": "decode"}
+            )
+        reg.observe(
+            "karpenter_solver_phase_seconds", 31e-5, {"phase": "decode"}
+        )
+        assert det.scan() == []  # 31x the baseline, but under min_abs_s
+
+    def test_cooldown_suppresses_repeats_until_clock_advances(self):
+        det, reg, clock = self._detector(cooldown_s=60.0)
+        for _ in range(16):
+            reg.observe(
+                "karpenter_solver_phase_seconds", 0.004, {"phase": "compile"}
+            )
+        det.scan()
+        reg.observe(
+            "karpenter_solver_phase_seconds", 0.2, {"phase": "compile"}
+        )
+        assert len(det.scan()) == 1
+        reg.observe(
+            "karpenter_solver_phase_seconds", 0.3, {"phase": "compile"}
+        )
+        assert det.scan() == []  # within cooldown
+        clock.step(61.0)
+        reg.observe(
+            "karpenter_solver_phase_seconds", 2.0, {"phase": "compile"}
+        )
+        assert len(det.scan()) == 1
+
+    def test_disabled_detector_is_inert(self):
+        det, reg, clock = self._detector(enabled=False)
+        for _ in range(16):
+            reg.observe(
+                "karpenter_solver_phase_seconds", 0.004, {"phase": "compile"}
+            )
+        reg.observe(
+            "karpenter_solver_phase_seconds", 5.0, {"phase": "compile"}
+        )
+        assert det.scan() == []
+
+    def test_robust_baseline_mad_floor(self):
+        med, scale = robust_baseline([0.01] * 9)
+        assert med == 0.01 and scale == pytest.approx(0.001)  # 10% floor
+
+
+# -------------------------------------------------------- flight recorder
+class TestFlightRecorder:
+    def _recorder(self, capacity=4):
+        clock = FakeClock()
+        reg = Registry()
+        led = EventLedger(clock=clock, registry=reg)
+        reg.ledger = led
+        return FlightRecorder(clock, reg, ledger=led, capacity=capacity), \
+            reg, led, clock
+
+    def test_ring_bounded_and_slices_captured(self):
+        fr, reg, led, clock = self._recorder(capacity=4)
+        for i in range(6):
+            clock.step(1.0)
+            led.emit("PodNominated", pod=f"p-{i}")
+            reg.inc("karpenter_nodeclaims_launched", {"nodepool": "default"})
+            reg.observe(
+                "karpenter_solver_phase_seconds", 0.01 * (i + 1),
+                {"phase": "compile"},
+            )
+            fr.record(i + 1, f"tick-{i + 1:06d}", 0.005, {"pending": i})
+        lines = fr.dump_lines("manual")
+        flight = read_flight("\n".join(lines))
+        assert flight["meta"]["trigger"] == "manual"
+        assert flight["meta"]["ticks"] == 4  # bounded: last 4 of 6
+        ticks = flight["ticks"]
+        assert [t["seq"] for t in ticks] == [3, 4, 5, 6]
+        # each tick carries exactly its own ledger slice...
+        assert [t["events"][0]["attrs"]["pod"] for t in ticks] == [
+            "p-2", "p-3", "p-4", "p-5",
+        ]
+        # ...its counter deltas...
+        assert all(
+            t["counters"]['karpenter_nodeclaims_launched{nodepool=default}']
+            == 1.0
+            for t in ticks
+        )
+        # ...and per-phase histogram deltas summing to that tick's time
+        h = ticks[-1]["hists"][
+            "karpenter_solver_phase_seconds{phase=compile}"
+        ]
+        assert h["count"] == 1 and h["sum_s"] == pytest.approx(0.06)
+
+    def test_dump_writes_jsonl_and_counts_trigger(self, tmp_path):
+        fr, reg, led, clock = self._recorder()
+        fr.record(1, "tick-000001", 0.01, {"pending": 0})
+        path = tmp_path / "flight.jsonl"
+        fr.dump(str(path), trigger="slo_breach")
+        assert reg.counter(
+            "karpenter_flight_dumps_total", {"trigger": "slo_breach"}
+        ) == 1
+        flight = load_flight(str(path))
+        assert flight["meta"]["trigger"] == "slo_breach"
+        assert len(flight["ticks"]) == 1
+
+    def test_dump_renders_through_obs_cli(self, tmp_path, capsys):
+        """Acceptance: a flight dump renders through the existing
+        `python -m karpenter_tpu obs` path into valid Chrome-trace JSON."""
+        from karpenter_tpu.__main__ import main as cli_main
+
+        fr, reg, led, clock = self._recorder()
+        for i in range(3):
+            clock.step(1.0)
+            led.emit("CircuitOpen", api="create_fleet", failures=4)
+            fr.record(i + 1, f"tick-{i + 1:06d}", 0.004, {"pending": 2,
+                                                          "nodes": 1})
+        path = tmp_path / "flight-tick-000003-slo_breach.jsonl"
+        fr.dump(str(path), trigger="slo_breach")
+        out = tmp_path / "flight.chrome.json"
+        rc = cli_main(["obs", str(path), "--out", str(out)])
+        assert rc == 0
+        chrome = json.loads(out.read_text())
+        events = chrome["traceEvents"]
+        assert events
+        phases = {e["ph"] for e in events}
+        assert {"X", "i", "M", "C"} <= phases
+        ticks = [e for e in events if e["ph"] == "X"]
+        assert any(e["name"] == "tick 3" for e in ticks)
+        instants = [e for e in events if e["ph"] == "i"]
+        assert all(e["name"] == "CircuitOpen" for e in instants)
+        captured = capsys.readouterr()
+        assert "cluster events recorded in the flight dump" in captured.out
+        assert "CircuitOpen" in captured.out
+
+
+# ------------------------------------------------------------------ doctor
+def _synthetic_regression_dump(tmp_path):
+    """A seeded synthetic regression: 16 warm ticks, then a catalog roll
+    forces a compile-cache miss storm — compile-phase time per tick blows
+    up 40x while every other phase holds steady."""
+    clock = FakeClock()
+    reg = Registry()
+    led = EventLedger(clock=clock, registry=reg)
+    reg.ledger = led
+    fr = FlightRecorder(clock, reg, ledger=led, capacity=64)
+    for i in range(24):
+        clock.step(1.0)
+        set_tick(f"tick-{i + 1:06d}")
+        warm = i < 16
+        if i == 16:
+            reg.event("CatalogRolled", provider="image")
+        reg.observe(
+            "karpenter_solver_phase_seconds",
+            0.002 if warm else 0.08, {"phase": "compile"},
+        )
+        reg.observe(
+            "karpenter_solver_phase_seconds", 0.004, {"phase": "dispatch"}
+        )
+        reg.inc(
+            "karpenter_solver_compile_cache_hits_total"
+            if warm else "karpenter_solver_compile_cache_misses_total",
+            {"consumer": "provisioner"},
+        )
+        fr.record(i + 1, f"tick-{i + 1:06d}", 0.01, {"pending": 0})
+    path = tmp_path / "flight-regression.jsonl"
+    fr.dump(str(path), trigger="manual")
+    return path
+
+
+class TestDoctor:
+    def test_names_regressing_phase_and_cites_trigger(self, tmp_path):
+        """Acceptance: on the synthetic catalog-roll regression, doctor
+        names the regressing phase AND cites the triggering event in its
+        suspected-cause output."""
+        path = _synthetic_regression_dump(tmp_path)
+        diag = diagnose(load_flight(str(path)))
+        assert "solver/compile" in diag["regressing_phases"]
+        assert "solver/dispatch" not in diag["regressing_phases"]
+        causes = diag["suspected_causes"]
+        assert causes, diag
+        roll_cause = causes[0]
+        assert "CatalogRolled" in roll_cause
+        assert "compile-cache misses spiked" in roll_cause
+        assert "solver/compile" in roll_cause
+        assert "8 misses after vs 0 before" in roll_cause
+        # the terminal rendering carries the same story
+        text = render_diagnosis(diag)
+        assert "REGRESSING" in text
+        assert "suspected causes:" in text
+        assert "CatalogRolled" in text
+
+    def test_circuit_open_preceding_stall_is_a_cause(self, tmp_path):
+        clock = FakeClock()
+        reg = Registry()
+        led = EventLedger(clock=clock, registry=reg)
+        reg.ledger = led
+        fr = FlightRecorder(clock, reg, ledger=led, capacity=64)
+        for i in range(12):
+            clock.step(1.0)
+            if i == 4:
+                led.emit("CircuitOpen", api="create_fleet", failures=5)
+            pending = 0 if i < 4 else 3 * (i - 3)  # stall after the open
+            fr.record(i + 1, f"tick-{i + 1:06d}", 0.01,
+                      {"pending": pending})
+        path = tmp_path / "flight-stall.jsonl"
+        fr.dump(str(path), trigger="manual")
+        diag = diagnose(load_flight(str(path)))
+        (cause,) = [
+            c for c in diag["suspected_causes"] if "CircuitOpen" in c
+        ]
+        assert "create_fleet" in cause
+        assert "provisioning stall" in cause
+
+    def test_bench_verdict_folds_into_causes(self, tmp_path):
+        path = _synthetic_regression_dump(tmp_path)
+        verdict = {
+            "ok": False,
+            "regressed": ["schedule_10k_pods_500_types_p50"],
+            "lines": [],
+        }
+        diag = diagnose(load_flight(str(path)), bench_verdict=verdict)
+        assert any(
+            "schedule_10k_pods_500_types_p50" in c
+            for c in diag["suspected_causes"]
+        )
+
+    def test_cli_on_dump_and_live_endpoint(self, tmp_path, capsys):
+        from karpenter_tpu.__main__ import main as cli_main
+        from karpenter_tpu.obs.http import start_telemetry
+
+        path = _synthetic_regression_dump(tmp_path)
+        rc = cli_main(["doctor", str(path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "suspected causes:" in out and "CatalogRolled" in out
+        # live mode: fetch the same ring from /debug/flight
+        clock = FakeClock()
+        reg = Registry()
+        led = EventLedger(clock=clock, registry=reg)
+        reg.ledger = led
+        fr = FlightRecorder(clock, reg, ledger=led, capacity=8)
+        fr.record(1, "tick-000001", 0.01, {"pending": 0})
+        server = start_telemetry(0, reg, ledger=led, flight=fr,
+                                 host="127.0.0.1")
+        try:
+            port = server.server_address[1]
+            rc = cli_main(["doctor", f"http://127.0.0.1:{port}"])
+            assert rc == 0
+            assert "flight dump: 1 tick(s)" in capsys.readouterr().out
+        finally:
+            server.shutdown()
+
+    def test_cli_rejects_garbage(self, tmp_path, capsys):
+        from karpenter_tpu.__main__ import main as cli_main
+
+        bad = tmp_path / "not-a-flight.json"
+        bad.write_text('{"hello": 1}\n')
+        rc = cli_main(["doctor", str(bad)])
+        assert rc == 64
+        assert "doctor:" in capsys.readouterr().err
+
+
+# ------------------------------------------------------- operator wiring
+class TestOperatorDiagnosis:
+    def test_breach_dumps_flight_to_flight_dir(self, tmp_path):
+        """An always-violated zero-budget rule breaches on the first
+        tick; the operator dumps the flight ring into flight_dir and the
+        breach is a ledger fact on the tick's trace ID."""
+        env = Environment(
+            settings=Settings(
+                cluster_name="test",
+                flight_dir=str(tmp_path),
+                slo_rules={
+                    "always-fire": {
+                        "signal": "pending_pod_age_max",
+                        "threshold": -1.0, "budget": 0.0,
+                        "fast_window_s": 5.0, "slow_window_s": 5.0,
+                    },
+                },
+            )
+        )
+        env.default_node_class()
+        env.default_node_pool()
+        env.step(1.0)
+        breaches = [
+            e for e in env.operator.ledger.recent() if e.type == "SLOBreach"
+        ]
+        assert breaches and breaches[0].attrs["rule"] == "always-fire"
+        assert breaches[0].trace_id.startswith("tick-")
+        dumps = [f for f in os.listdir(tmp_path) if "slo_breach" in f]
+        assert len(dumps) == 1
+        assert env.registry.counter(
+            "karpenter_flight_dumps_total", {"trigger": "slo_breach"}
+        ) == 1
+        flight = load_flight(str(tmp_path / dumps[0]))
+        # the dumped ring contains the breaching tick INCLUDING its own
+        # SLOBreach event (the diagnosis tail records after evaluating)
+        assert any(
+            ev["type"] == "SLOBreach"
+            for t in flight["ticks"] for ev in t["events"]
+        )
+        # a persisting breach must not dump again every tick
+        env.step(1.0)
+        assert env.registry.counter(
+            "karpenter_flight_dumps_total", {"trigger": "slo_breach"}
+        ) == 1
+
+    def test_controller_crash_dumps_flight(self, tmp_path):
+        env = Environment(
+            settings=Settings(
+                cluster_name="test", flight_dir=str(tmp_path),
+            )
+        )
+        env.default_node_class()
+        env.default_node_pool()
+        env.step(1.0)
+
+        def boom():
+            raise RuntimeError("synthetic controller crash")
+
+        env.operator.tagging.reconcile = boom
+        env.step(1.0)
+        dumps = [f for f in os.listdir(tmp_path) if "controller_crash" in f]
+        assert dumps
+        assert env.registry.counter(
+            "karpenter_flight_dumps_total", {"trigger": "controller_crash"}
+        ) == 1.0
+
+    def test_tick_duration_histogram_and_flight_ring_grow(self):
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool()
+        for _ in range(3):
+            env.step(1.0)
+        h = env.registry.histograms[
+            "karpenter_reconcile_tick_duration_seconds"
+        ][()]
+        assert h.count == 3
+        assert all(v > 0 for v in h.samples)
+        assert len(env.operator.flight._ring) == 3
+
+
+# ------------------------------------ simulator: byte-identical breaches
+@pytest.mark.sim
+class TestSimSLO:
+    def test_chaos_breach_and_recovery_replay_byte_identical(self, tmp_path):
+        """Acceptance: a seeded sim scenario with injected chaos trips an
+        SLO burn-rate breach and a later recovery, both ledger events
+        with the tick's trace ID, and the run is byte-identical across
+        --replay (led lines + `slo` report section included)."""
+        from karpenter_tpu.sim.runner import replay, run_scenario
+        from karpenter_tpu.sim.trace import TraceWriter, read_trace
+
+        p1, p2 = tmp_path / "t1.jsonl", tmp_path / "t2.jsonl"
+        _, report = run_scenario(
+            "slo-burn", seed=3, ticks=30, trace=TraceWriter(str(p1))
+        )
+        slo = report["slo"]["rules"]["cloud-circuit-open"]
+        assert slo["breaches"] >= 1 and slo["recoveries"] >= 1
+        assert slo["status"] == "ok" and slo["breached_s"] > 0.0
+        led = [l for l in read_trace(str(p1)) if l["t"] == "led"]
+        breaches = [l for l in led if l["type"] == "SLOBreach"]
+        recoveries = [l for l in led if l["type"] == "SLORecovered"]
+        assert breaches and recoveries
+        assert breaches[0]["trace_id"].startswith("tick-")
+        assert recoveries[0]["tick"] > breaches[0]["tick"]
+        assert breaches[0]["attrs"]["rule"] == "cloud-circuit-open"
+        # counts agree across the three surfaces: report slo section,
+        # report cluster_events, and the trace's led lines
+        counts = report["cluster_events"]["counts"]
+        assert counts["SLOBreach"] == len(breaches) == slo["breaches"]
+        assert counts["SLORecovered"] == len(recoveries) == slo["recoveries"]
+        # replay: byte-identical trace (led lines included), equal report
+        _, replayed, recorded = replay(str(p1), trace=TraceWriter(str(p2)))
+        assert p1.read_text() == p2.read_text()
+        assert recorded == replayed == report
+
+    def test_chaos_soak_declares_the_acceptance_rule(self):
+        from karpenter_tpu.sim.runner import SCENARIOS
+
+        for name in ("chaos-soak", "api-storm+catalog-roll", "slo-burn"):
+            scn = SCENARIOS[name](80)
+            assert any(
+                r.name == "cloud-circuit-open" and r.signal == "circuits_open"
+                for r in scn.slo_rules
+            ), name

@@ -18,6 +18,10 @@ baked into the image, so this enforces the checks that catch real rot:
    .observe/.time/...) appears in docs/metrics.md — the doc-rot guard
    with teeth: a new series cannot ship without regenerating the
    reference page (and with it the /metrics HELP/TYPE catalog).
+6. every ledger event-type literal emitted via `Registry.event(...)` /
+   `EventLedger.emit(...)` appears in docs/designs/observability.md —
+   the same teeth for the decision-event taxonomy: SLOBreach,
+   AnomalyDetected, and whatever comes next cannot ship undocumented.
 """
 
 import ast
@@ -273,6 +277,97 @@ def test_metric_doc_lint_has_teeth():
     )
     hits = metric_doc_offenders(src, "karpenter_tpu/x.py", documented)
     assert len(hits) == 1 and "karpenter_rogue_seconds" in hits[0], hits
+
+
+# rule 6: the event-emission verbs.  `Registry.event` and
+# `EventLedger.emit` both take the event TYPE as their first positional
+# argument; a CamelCase string literal there is a published ledger event
+# and must be documented in the observability design's taxonomy.
+_EVENT_VERBS = frozenset({"event", "emit"})
+
+# event types are CamelCase identifiers (PodNominated, SLOBreach); the
+# shape filter keeps unrelated `.event(tick, ...)` / `.emit(...)` call
+# sites (ints, lowercase kinds) out of scope
+_EVENT_TYPE_RE = re.compile(r"[A-Z][A-Za-z0-9]*")
+
+
+def documented_event_types() -> set:
+    """Every backticked CamelCase identifier in the observability design
+    doc — a superset of the event taxonomy (class names in backticks are
+    harmless extras; the lint only needs emitted literals ⊆ this set)."""
+    doc = (
+        pathlib.Path(karpenter_tpu.__path__[0]).parent
+        / "docs" / "designs" / "observability.md"
+    )
+    return set(re.findall(r"`([A-Z][A-Za-z0-9]*)`", doc.read_text()))
+
+
+def event_doc_offenders(source: str, rel: str, documented: set):
+    """AST scan: every `<anything>.event("CamelCase", ...)` /
+    `<anything>.emit("CamelCase", ...)` call must name a documented event
+    type.  Dynamic types (variables, f-strings) are out of scope — the
+    doc cannot enumerate them either."""
+    tree = ast.parse(source)
+    offenders = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _EVENT_VERBS
+            and node.args
+        ):
+            continue
+        first = node.args[0]
+        if not (
+            isinstance(first, ast.Constant)
+            and isinstance(first.value, str)
+            and _EVENT_TYPE_RE.fullmatch(first.value)
+        ):
+            continue
+        if first.value not in documented:
+            offenders.append(
+                f"{rel}:{node.lineno}: event type {first.value!r} passed to "
+                f".{node.func.attr}() but absent from "
+                "docs/designs/observability.md"
+            )
+    return offenders
+
+
+def test_ledger_event_literals_documented():
+    """Doc-rot guard for the event taxonomy: an event-type literal
+    reaching the ledger without a docs/designs/observability.md entry
+    means someone added a decision event and skipped documenting what it
+    means and where it fires."""
+    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
+    documented = documented_event_types()
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(pkg_root.parent).as_posix()
+        offenders += event_doc_offenders(path.read_text(), rel, documented)
+    assert not offenders, (
+        "ledger event types not documented (add them to the taxonomy in "
+        "docs/designs/observability.md):\n" + "\n".join(offenders)
+    )
+
+
+def test_event_doc_lint_has_teeth():
+    """The checker fires on an undocumented CamelCase literal, stays
+    quiet on documented ones, dynamic types, and non-event `.event()`
+    overloads (the trace writer's `.event(tick, kind, data)`)."""
+    documented = {"NodeLaunched"}
+    src = (
+        "def f(reg, trace, t):\n"
+        "    reg.event('NodeLaunched', claim='x')\n"
+        "    reg.event('RogueEvent', oops=1)\n"
+        "    reg.ledger.emit('AnotherRogue')\n"
+        "    reg.event(t)\n"  # dynamic: out of scope
+        "    trace.event(3, 'pod_create', {})\n"  # int + lowercase kind
+    )
+    hits = event_doc_offenders(src, "karpenter_tpu/x.py", documented)
+    assert len(hits) == 2, hits
+    assert "RogueEvent" in hits[0] and "AnotherRogue" in hits[1], hits
 
 
 def test_scheduler_update_lint_has_teeth():

@@ -119,6 +119,22 @@ class TestEventLedger:
         assert [ev.seq for ev in fresh] == [16, 17, 18, 19, 20]
         assert led.counts() == {"PodNominated": 8}
 
+    def test_read_reports_dropped_and_respects_limit(self):
+        """The cursor satellite's ledger half: a reader whose cursor fell
+        behind the ring sees HOW MANY events it lost, and a limit pages
+        from the old end so catching up never skips."""
+        led = EventLedger(clock=FakeClock(), capacity=8)
+        for i in range(20):
+            led.emit("PodNominated", pod=f"p-{i}")
+        events, dropped = led.read(0)
+        assert [ev.seq for ev in events] == list(range(13, 21))
+        assert dropped == 12
+        events, dropped = led.read(15, limit=3)
+        assert [ev.seq for ev in events] == [16, 17, 18]
+        assert dropped == 0
+        events, dropped = led.read(20)
+        assert events == [] and dropped == 0
+
     def test_jsonl_sink(self, tmp_path):
         path = tmp_path / "events.jsonl"
         led = EventLedger(clock=FakeClock(), sink_path=str(path))
@@ -285,9 +301,13 @@ class TestTelemetryEndpoint:
         assert _get(port, "/healthz") == (200, b"ok")
         status, body = _get(port, "/events")
         assert status == 200
-        events = json.loads(body)
-        assert events[0]["type"] == "NodeLaunched"
-        assert events[0]["attrs"]["claim"] == "nc-1"
+        payload = json.loads(body)
+        assert payload["events"][0]["type"] == "NodeLaunched"
+        assert payload["events"][0]["attrs"]["claim"] == "nc-1"
+        assert payload["last_seq"] == payload["events"][-1]["seq"]
+        assert payload["dropped"] == 0
+        assert payload["ring_counts"] == {"NodeLaunched": 1}
+        assert payload["total_counts"] == {"NodeLaunched": 1}
         status, body = _get(port, "/trace")
         assert status == 200
         payload = json.loads(body)
@@ -298,6 +318,130 @@ class TestTelemetryEndpoint:
         assert reg.counter(
             "karpenter_telemetry_scrapes_total", {"endpoint": "events"}
         ) == 1
+
+    def test_events_cursor_pages_forward(self, served):
+        """The /events?since_seq=N&limit=M satellite: a poller pages
+        forward from its cursor without re-reading (or silently missing)
+        events."""
+        port, reg = served
+        led = reg.ledger
+        for i in range(5):
+            led.emit("PodNominated", pod=f"p-{i}")
+        status, body = _get(port, "/events?since_seq=1&limit=2")
+        assert status == 200
+        payload = json.loads(body)
+        seqs = [ev["seq"] for ev in payload["events"]]
+        assert seqs == [2, 3]  # oldest first, capped by limit
+        assert payload["last_seq"] == 3
+        assert payload["dropped"] == 0
+        # next page picks up exactly where the cursor left off
+        payload2 = json.loads(_get(port, "/events?since_seq=3&limit=100")[1])
+        assert [ev["seq"] for ev in payload2["events"]] == [4, 5, 6]
+
+    def test_events_overflow_reports_dropped_and_both_counts(self):
+        """The counts-ambiguity satellite, endpoint-level: overflow the
+        ring and check that ring_counts (bounded) and total_counts
+        (cumulative) diverge honestly and dropped is reported."""
+        reg = Registry()
+        led = EventLedger(clock=FakeClock(), registry=reg, capacity=8)
+        reg.ledger = led
+        for i in range(20):
+            led.emit("PodNominated", pod=f"p-{i}")
+        server = start_telemetry(0, reg, ledger=led, host="127.0.0.1")
+        try:
+            port = server.server_address[1]
+            payload = json.loads(_get(port, "/events?since_seq=0")[1])
+            # ring holds the last 8 (seqs 13..20); 12 aged out unread
+            assert [ev["seq"] for ev in payload["events"]] == list(
+                range(13, 21)
+            )
+            assert payload["dropped"] == 12
+            assert payload["ring_counts"] == {"PodNominated": 8}
+            assert payload["total_counts"] == {"PodNominated": 20}
+            # a bare GET (no cursor) serves the NEWEST events — a human
+            # curl on a full ring must show what just happened, not the
+            # oldest survivors
+            bare = json.loads(_get(port, "/events?limit=3")[1])
+            assert [ev["seq"] for ev in bare["events"]] == [18, 19, 20]
+            assert bare["dropped"] == 0
+        finally:
+            server.shutdown()
+
+
+# --------------------------------------------------- concurrent scrapes
+def _assert_exposition_parses(text: str) -> None:
+    """Every non-comment line must be `name[{labels}] value` with a
+    finite float value — the contract a real Prometheus scraper needs."""
+    import re as _re
+
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, _, value = line.rpartition(" ")
+        assert head and _re.match(
+            r"^[a-z_][a-zA-Z0-9_]*(\{.*\})?$", head
+        ), f"unparseable exposition line: {line!r}"
+        float(value)
+
+
+class TestConcurrentScrapes:
+    def test_hammer_telemetry_while_operator_ticks(self):
+        """The satellite: threaded scrapes of /metrics + /events (+ the
+        flight ring) while the operator reconciles pods — no exceptions
+        on either side, and every exposition snapshot parses."""
+        from karpenter_tpu.obs.flight import read_flight
+
+        env = Environment()
+        env.default_node_class()
+        env.default_node_pool()
+        server = start_telemetry(
+            0, env.registry,
+            tracer=env.operator.tracer,
+            ledger=env.operator.ledger,
+            flight=env.operator.flight,
+            host="127.0.0.1",
+        )
+        port = server.server_address[1]
+        errors = []
+        stop = threading.Event()
+
+        def hammer(path):
+            while not stop.is_set():
+                try:
+                    status, body = _get(port, path)
+                    assert status == 200, (path, status)
+                    if path == "/metrics":
+                        _assert_exposition_parses(body.decode())
+                    elif path == "/events":
+                        json.loads(body)
+                    elif body:
+                        read_flight(body.decode())
+                except Exception as exc:  # noqa: BLE001 (collected)
+                    errors.append((path, repr(exc)))
+                    return
+
+        threads = [
+            threading.Thread(target=hammer, args=(p,), daemon=True)
+            for p in ("/metrics", "/events", "/metrics", "/debug/flight")
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(12):
+                env.kube.put_pod(
+                    Pod(requests=Resources(cpu=1, memory="1Gi"))
+                )
+                env.step(2.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5.0)
+            server.shutdown()
+        assert not errors, errors
+        scrapes = env.registry.counter(
+            "karpenter_telemetry_scrapes_total", {"endpoint": "metrics"}
+        )
+        assert scrapes > 0
 
 
 # -------------------------------------------- decision-site ledger events
