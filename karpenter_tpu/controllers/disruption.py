@@ -45,6 +45,7 @@ from karpenter_tpu.metrics.registry import (
     REGISTRY,
     Registry,
     export_compile_cache_counters,
+    export_resident_counters,
 )
 from karpenter_tpu.scheduling.solver import RemovalCandidate, TensorScheduler
 from karpenter_tpu.state.cluster import Cluster, StateNode
@@ -323,6 +324,7 @@ class DisruptionController:
         self._nominate_later: Dict[str, _Nomination] = {}
         # compile-cache counter values already exported to the registry
         self._cc_exported = (0, 0)
+        self._res_exported = (0, 0)  # resident hit/rebuild, same contract
         # pod key -> (orig pod, its epoch, resolved reqs, simulation copy):
         # a pod whose stored volume requirements differ from the fresh
         # resolution gets ONE stable copy reused across simulations and
@@ -344,6 +346,10 @@ class DisruptionController:
                 self._cc_exported = export_compile_cache_counters(
                     self.registry, self._scheduler, "disruption",
                     self._cc_exported,
+                )
+                self._res_exported = export_resident_counters(
+                    self.registry, self._scheduler, "disruption",
+                    self._res_exported,
                 )
 
     def _reconcile_pass(self) -> None:

@@ -40,6 +40,7 @@ from karpenter_tpu.metrics.registry import (
     REGISTRY,
     Registry,
     export_compile_cache_counters,
+    export_resident_counters,
 )
 from karpenter_tpu.scheduling.scheduler import SchedulingResult, VirtualNode
 from karpenter_tpu.scheduling.solver import TensorScheduler
@@ -134,6 +135,7 @@ class Provisioner:
         # (the scheduler counts monotonically; the registry counter gets
         # the per-reconcile delta)
         self._cc_exported = (0, 0)
+        self._res_exported = (0, 0)  # resident hit/rebuild, same contract
 
     # -------------------------------------------------------------- reconcile
     def reconcile(self) -> List[NodeClaim]:
@@ -235,6 +237,17 @@ class Provisioner:
         self._cc_exported = export_compile_cache_counters(
             self.registry, scheduler, "provisioner", self._cc_exported
         )
+        self._res_exported = export_resident_counters(
+            self.registry, scheduler, "provisioner", self._res_exported
+        )
+        if scheduler.last_delta_rows >= 0:
+            # delta size of a resident warm tick (scattered class rows +
+            # live columns + usage rows; 0 = pure no-change hit) — the
+            # sim report's solver.resident section reads its samples
+            self.registry.observe(
+                "karpenter_solver_resident_delta_rows",
+                float(scheduler.last_delta_rows),
+            )
         for pod_key, reason in result.unschedulable.items():
             self.kube.record_event("Pod", "FailedScheduling", pod_key, reason)
         # nominate pods placed on existing nodes (the kube-scheduler binds)

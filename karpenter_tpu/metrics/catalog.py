@@ -81,7 +81,9 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "histogram",
         "phase",
         "per-solve wall time of one solver phase (partition / compile / "
-        "pad / dispatch / device_block / oracle / decode / other) — "
+        "pad / dispatch / device_block / oracle / decode / delta / other; "
+        "delta is the resident-tensor plan+scatter that replaces "
+        "compile+pad on warm ticks) — "
         "disjoint self-times that sum to the solve's wall clock, observed "
         "by the provisioning controller after every scheduling solve; see "
         "the 'solve latency anatomy' section in the README for how to "
@@ -102,6 +104,33 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "steady-state cluster should see hits dominate — misses every "
         "tick mean something (pods, pools, live nodes) is being mutated "
         "in place",
+    ),
+    "karpenter_solver_resident_hits_total": (
+        "counter",
+        "consumer",
+        "solves (and consolidation base builds) served from the "
+        "device-resident cluster tensors (ops/resident.py) — the compiled "
+        "problem stayed on device and this tick's cluster diff applied as "
+        "donated scatter deltas (or no delta at all), skipping both the "
+        "host re-tensorize and the host->device upload",
+    ),
+    "karpenter_solver_resident_rebuilds_total": (
+        "counter",
+        "consumer",
+        "full tensorize+upload passes while the resident layer was "
+        "eligible to serve: the delta planner could not prove equivalence "
+        "(catalog roll, pool/daemonset mutation, constraint carriers, "
+        "extended-resource axis change, padded-bucket overflow, >50% "
+        "churn) or the state was cold; a warm steady cluster should see "
+        "hits dominate",
+    ),
+    "karpenter_solver_resident_delta_rows": (
+        "histogram",
+        "(none)",
+        "scattered tensor rows+columns of one resident warm tick (class "
+        "rows + live-node columns + usage rows; 0 = a pure no-change "
+        "hit), observed by the provisioner per resident solve — the delta "
+        "sizes the sim report's solver.resident section summarizes",
     ),
     "karpenter_consolidation_eval_batch_size": (
         "histogram",

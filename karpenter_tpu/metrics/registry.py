@@ -318,5 +318,30 @@ def export_compile_cache_counters(
     return (hits, misses)
 
 
+def export_resident_counters(
+    registry: "Registry", scheduler, consumer: str, exported: Tuple[int, int]
+) -> Tuple[int, int]:
+    """Mirror a TensorScheduler's monotonic resident-tensor hit/rebuild
+    counts into ``karpenter_solver_resident_{hits,rebuilds}_total
+    {consumer=}`` — the same delta-export contract as
+    :func:`export_compile_cache_counters` (two consumers, one scheduler
+    counter each, registry bumps by the delta)."""
+    hits, rebuilds = scheduler.resident_hits, scheduler.resident_rebuilds
+    prev_h, prev_r = exported
+    if hits > prev_h:
+        registry.inc(
+            "karpenter_solver_resident_hits_total",
+            {"consumer": consumer},
+            by=hits - prev_h,
+        )
+    if rebuilds > prev_r:
+        registry.inc(
+            "karpenter_solver_resident_rebuilds_total",
+            {"consumer": consumer},
+            by=rebuilds - prev_r,
+        )
+    return (hits, rebuilds)
+
+
 # process-global default registry (controllers accept an override)
 REGISTRY = Registry()

@@ -1,0 +1,904 @@
+"""Device-resident incremental cluster tensors (ROADMAP item 2).
+
+Every reconcile tick used to rebuild the pods x classes x configs tensors
+on the host and ship them device-ward, so warm-tick latency was dominated
+by re-tensorize + transfer rather than the solve itself.  This module
+keeps the PADDED solve tensors **resident on device across ticks** and
+updates them with **scatter deltas** instead of re-tensorization — the
+analogue of karpenter-core's in-memory cluster-state cache, which exists
+precisely so each scheduling pass starts from deltas, not a cold snapshot.
+
+Architecture (docs/designs/resident-tensors.md):
+
+- `ResidentState` owns one padded problem: host numpy MIRRORS (the source
+  of truth the delta planner edits) plus DEVICE buffers kept bit-identical
+  to them by replaying every edit through one jitted gather+scatter step
+  (`_delta_fn`) with **donated buffers**, so a warm update allocates no
+  new device memory and uploads only the changed rows/columns.
+- The **delta planner** diffs the incoming (pods, live nodes) against the
+  resident epoch using the PR-3 identity+epoch fingerprints — pod and
+  pool objects key by ``(id, _mut)``, live nodes by content — and turns
+  the diff into: a class-axis permutation (arrivals insert at their FFD
+  sort position, departures compact), a live-column permutation over the
+  config and node-slot axes, and scatter payloads for new/changed rows.
+- **Equivalence discipline**: the delta path must produce tensors
+  bit-equal to a from-scratch `compile_problem` at every step
+  (tests/test_resident_fuzz.py enforces it on single-device AND mesh
+  backends).  Row assembly is therefore SHARED with the compiler
+  (`tensorize.open_config_row` / `restrict_open_tier` /
+  `ffd_class_key`), and anything the planner cannot prove equivalent —
+  catalog roll, pool shape change, constraint carriers, axis changes,
+  bucket overflow — falls back to the full tensorize (counted in
+  ``karpenter_solver_resident_rebuilds_total``).
+- **Sharding**: when the scheduler's pack_fn is the mesh backend
+  (parallel/mesh.py), the buffers are placed with the SAME shardings the
+  sharded pack expects — feasibility and the config catalog over
+  "model", the node-slot state over "data" — so the resident path is the
+  same code single-device and 8-device.
+
+Eligibility (the "plain" subset — deliberately the same guard set as the
+batched-consolidation base in `TensorScheduler._build_removal_base`, so
+`_removal_base` can read these tensors directly): every batch pod free of
+pod affinity / topology spread / preferences / multi-OR-term node
+affinity / volume claims, and no bound pod on ANY existing node — live,
+cordoned, or draining — carrying pod affinity (partition_groups keys its
+symmetric-anti-affinity repel on all of them).  Everything else takes
+the ordinary compile path unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.api import Pod
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.ops.tensorize import (
+    BIG,
+    Catalog,
+    ClassMeta,
+    CompiledProblem,
+    ConfigMeta,
+    _fits_existing,
+    _vec,
+    ffd_class_key,
+    live_filter,
+    open_config_row,
+    restrict_open_tier,
+)
+from karpenter_tpu.ops.packer import PackResult, _bucket, _bucket_classes
+from karpenter_tpu.utils.trace import phase
+
+# a delta touching more than this fraction of the batch rebuilds instead:
+# past the midpoint the full compile is cheaper than planning and
+# scattering most of the tensor anyway (the +8 grace keeps tiny batches
+# from thrashing on integer effects)
+REBUILD_FRACTION = 0.5
+
+# node-slot headroom replicated from packer.node_slot_bound for the plain
+# shape (no constrained classes): E + min(n_pods, 256)
+_SLOT_HEADROOM = 256
+
+
+def _plain_pod(p: Pod) -> bool:
+    """The resident-expressible pod shape: no pod-level coupling, no
+    relax-eligible soft constraints, no volume claims — the same guard
+    set as the batched-removal base, so every delta is provably
+    order-independent at the class level."""
+    return not (
+        p.pod_affinity
+        or p.topology_spread
+        or p.preferred_affinity
+        or p.volume_claims
+        or len(p.node_affinity_terms()) > 1
+    )
+
+
+def _carrier_free(existing) -> bool:
+    """No bound pod anywhere in `existing` — live, cordoned, or draining —
+    may carry a pod-affinity term.  partition_groups routes batch classes
+    SELECTED by any existing carrier's anti term to the oracle (symmetric
+    anti-affinity repels incoming pods), a decision keyed to ALL existing
+    nodes that the delta planner cannot replay; `_compact_guard`'s
+    carrier clause reads the same set.  Live carriers additionally change
+    feasibility columns.  One rule covers all three — and it is why the
+    resident-hit path may store compact_ok=True without re-running the
+    guard."""
+    return not any(bp.pod_affinity for sn in existing for bp in sn.pods)
+
+
+def resident_capable(pack_fn) -> bool:
+    """Resident buffers can only serve pack backends that run in-process
+    on this host's devices: the default auto_pack dispatch or the
+    mesh-sharded kernel.  Sidecar/forced/custom pack_fns keep the plain
+    upload path (their transfer contract is their own)."""
+    from karpenter_tpu.ops.pallas_packer import auto_pack
+
+    return pack_fn is auto_pack or getattr(pack_fn, "mesh", None) is not None
+
+
+def _catalog_key(solver) -> tuple:
+    """Identity+epoch fingerprint of everything the catalog derives from
+    (the PR-3 invalidation contract): a rolled inventory list, a mutated
+    pool, or a changed daemonset set obsoletes every resident tensor."""
+    return (
+        tuple((id(p), p.__dict__.get("_mut", 0)) for p in solver.pools),
+        tuple(sorted((k, id(v)) for k, v in solver.instance_types.items())),
+        tuple((id(d), d.__dict__.get("_mut", 0)) for d in solver.daemonsets),
+    )
+
+
+def _node_sched_fp(sn) -> tuple:
+    """The node content that drives ADMISSION (the feasibility column and
+    the allocatable row): labels, taints, allocatable."""
+    return (
+        tuple(sorted(sn.labels.items())),
+        tuple(map(repr, sn.taints)),
+        tuple(sorted(sn.allocatable.items())),
+    )
+
+
+def _node_usage_fp(sn) -> tuple:
+    """The node content that drives PREFILL (used0/npods0): usage plus
+    the bound-pod identity+epoch set (a mutated bound pod could grow
+    pod affinity, which the eligibility guard must re-check)."""
+    return (
+        tuple(sorted(sn.used.items())),
+        tuple((id(bp), bp.__dict__.get("_mut", 0)) for bp in sn.pods),
+    )
+
+
+class _Cls:
+    """One resident class: the compile's ClassMeta plus planner caches."""
+
+    __slots__ = ("cm", "key", "req_vec", "sched", "sort_key")
+
+    def __init__(self, cm: ClassMeta, key, axes):
+        self.cm = cm
+        self.key = key  # the interned ClassKey
+        self.req_vec = _vec(cm.requests, axes)
+        rep = cm.pods[0]
+        # signature-determined, so any member's is equivalent — computed
+        # once per class and kept even if the original rep departs
+        self.sched = rep.scheduling_requirements(preferred=True)
+        self.sort_key = ffd_class_key(cm)
+
+
+# (mesh-or-None) -> jitted delta step; one entry per mesh object (plus the
+# single-device None entry), retraced per padded-shape bucket
+_DELTA_JITS: dict = {}
+
+
+def _mesh_shardings(mesh) -> dict:
+    """The ONE axis-spec table for every resident buffer — `_delta_fn`'s
+    in/out shardings and `_device_seed`'s placements must agree exactly,
+    or the donated jit reshards (a silent copy per warm tick) instead of
+    reusing the buffers in place."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from karpenter_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    return dict(
+        repl=NamedSharding(mesh, P()),
+        on_c=NamedSharding(mesh, P(MODEL_AXIS)),
+        on_c2=NamedSharding(mesh, P(MODEL_AXIS, None)),
+        on_gc=NamedSharding(mesh, P(None, MODEL_AXIS)),
+        on_k=NamedSharding(mesh, P(DATA_AXIS)),
+        on_k2=NamedSharding(mesh, P(DATA_AXIS, None)),
+        on_sk=NamedSharding(mesh, P(None, DATA_AXIS)),
+    )
+
+
+def _delta_fn(mesh):
+    fn = _DELTA_JITS.get(mesh)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    def step(
+        req, cnt, feas, alloc, price, used0, npods0,
+        g_perm, c_perm, k_perm,
+        g_idx, g_req, g_cnt, g_feas,
+        col_idx, col_alloc, col_price, col_feas,
+        k_idx, k_used, k_np,
+        e_new, fe,
+    ):
+        # 1) permutations: class rows compact/insert to the new FFD
+        #    order, live columns follow the new snapshot order; fresh and
+        #    vacated positions gather from the reserved scratch slot,
+        #    which permanently holds canonical pad values
+        req = req[g_perm]
+        cnt = cnt[g_perm]
+        feas = feas[g_perm][:, c_perm]
+        alloc = alloc[c_perm]
+        price = price[c_perm]
+        used0 = used0[k_perm]
+        npods0 = npods0[k_perm]
+        # 2) scatters: new/changed class rows, then new/changed live
+        #    columns (payloads come from the final host mirror, so the
+        #    row/column overlap cells agree by construction; padded
+        #    payload entries target the scratch slots with canonical pad
+        #    values, leaving them invariant)
+        req = req.at[g_idx].set(g_req)
+        cnt = cnt.at[g_idx].set(g_cnt)
+        feas = feas.at[g_idx].set(g_feas)
+        alloc = alloc.at[col_idx].set(col_alloc)
+        price = price.at[col_idx].set(col_price)
+        feas = feas.at[:, col_idx].set(col_feas)
+        used0 = used0.at[k_idx].set(k_used)
+        npods0 = npods0.at[k_idx].set(k_np)
+        kp = used0.shape[0]
+        iota = jnp.arange(kp, dtype=jnp.int32)
+        cfg0 = jnp.where(iota < e_new, fe + iota, -1)
+        return req, cnt, feas, alloc, price, used0, npods0, cfg0
+
+    donate = tuple(range(7))  # the seven resident buffers reuse in place
+    if mesh is None:
+        fn = jax.jit(step, donate_argnums=donate)
+    else:
+        sh = _mesh_shardings(mesh)
+        repl, on_c, on_c2, on_gc, on_k, on_k2 = (
+            sh["repl"], sh["on_c"], sh["on_c2"], sh["on_gc"], sh["on_k"],
+            sh["on_k2"],
+        )
+        fn = jax.jit(
+            step,
+            donate_argnums=donate,
+            in_shardings=(
+                repl, repl, on_gc, on_c2, on_c, on_k2, on_k,  # buffers
+                repl, repl, repl,  # permutations
+                repl, repl, repl, repl,  # class scatters
+                repl, repl, repl, repl,  # column scatters
+                repl, repl, repl,  # slot scatters
+                repl, repl,  # e_new, fe
+            ),
+            out_shardings=(
+                repl, repl, on_gc, on_c2, on_c, on_k2, on_k, on_k
+            ),
+        )
+    _DELTA_JITS[mesh] = fn
+    return fn
+
+
+def _pad_idx(idx: List[int], scratch: int, floor: int = 4) -> np.ndarray:
+    """Pad a scatter index list to its power-of-two bucket with the
+    scratch slot (whose payload entries are canonical pad values), so the
+    delta jit compiles once per bucket instead of once per delta size."""
+    n = _bucket(max(len(idx), 1), floor=floor)
+    return np.asarray(idx + [scratch] * (n - len(idx)), np.int32)
+
+
+class ResidentState:
+    """One device-resident padded problem plus the metadata to diff it."""
+
+    def __init__(self):
+        # identity / catalog epoch
+        self.cat_key: tuple = ()
+        self.axes: Tuple[str, ...] = ()
+        self.catalog: Optional[Catalog] = None
+        self.pools: list = []
+        self.pools_by_name: dict = {}
+        self.fe = 0  # first_existing == len(catalog.configs)
+        self.pack_fn_ref = None
+        self.mesh = None
+        self.pins: tuple = ()  # keep every id-keyed object allocated
+        # classes / pods
+        self.cls: List[_Cls] = []
+        self.slot_of: Dict[object, int] = {}  # ClassKey -> g
+        self.pod_entry: Dict[int, tuple] = {}  # id -> (pod, mut, ClassKey)
+        self.extra_axes: Dict[str, int] = {}  # extra axis -> using classes
+        # live nodes
+        self.live: list = []
+        self.node_pos: Dict[str, int] = {}
+        self.node_fp: Dict[str, tuple] = {}
+        self.configs_live: List[ConfigMeta] = []
+        # padded host mirrors (source of truth; device mirrors them)
+        self.Gp = self.Cp = self.Kp = self.R = 0
+        self.h_req = self.h_cnt = self.h_feas = None
+        self.h_alloc = self.h_price = self.h_openable = None
+        self.h_used0 = self.h_npods0 = None
+        # device buffers
+        self.d_req = self.d_cnt = self.d_feas = None
+        self.d_alloc = self.d_price = self.d_openable = None
+        self.d_used0 = self.d_npods0 = self.d_cfg0 = None
+        self.d_maxper = self.d_slot = self.d_sig0 = None
+        # current snapshot (what the solver's compile cache stores)
+        self.prob: Optional[CompiledProblem] = None
+        self.last_delta_rows = 0
+
+    # -------------------------------------------------------------- build
+    @classmethod
+    def build(cls, solver, pods: List[Pod], prob: CompiledProblem, catalog):
+        """Seed a state from a freshly-compiled problem, or None when the
+        problem falls outside the resident-expressible shape."""
+        if prob is None or not prob.supported or prob.compile_relaxed:
+            return None
+        if prob.n_track_slots != 1:
+            return None
+        for cm in prob.classes:
+            if (
+                cm.group_size
+                or cm.zone_pin
+                or cm.rep_override is not None
+                or cm.pool_allow is not None
+                or cm.infeasible
+            ):
+                return None
+        if len(set(map(id, pods))) != len(pods):
+            return None  # duplicate objects would double-count a class
+        for p in pods:
+            if not _plain_pod(p):
+                return None
+        fe = len(catalog.configs)
+        live = [cfg.existing for cfg in prob.configs[fe:]]
+        if not _carrier_free(solver.existing):
+            return None  # carriers (even on non-live nodes) change the partition
+        st = cls()
+        st.cat_key = _catalog_key(solver)
+        st.axes = prob.axes
+        st.catalog = catalog
+        st.pools = list(solver.pools)
+        st.pools_by_name = {p.name: p for p in catalog.pools}
+        st.fe = fe
+        st.pack_fn_ref = solver.pack_fn
+        st.mesh = getattr(solver.pack_fn, "mesh", None)
+        st.pins = (
+            tuple(solver.pools),
+            tuple(solver.instance_types.values()),
+            tuple(solver.daemonsets),
+        )
+        G, C = prob.feas.shape
+        E = C - fe
+        n_pods = prob.total_pods()
+        st.R = len(prob.axes)
+        st.Gp = _bucket_classes(G + 1)
+        st.Cp = _bucket(C + 1)
+        st.Kp = _bucket(E + min(n_pods, _SLOT_HEADROOM) + 1)
+        for g, cm in enumerate(prob.classes):
+            key = cm.pods[0].class_key()
+            st.cls.append(_Cls(cm, key, st.axes))
+            st.slot_of[key] = g
+            for ax in cm.requests.keys():
+                if ax not in L.WELL_KNOWN_RESOURCES:
+                    st.extra_axes[ax] = st.extra_axes.get(ax, 0) + 1
+            for p in cm.pods:
+                st.pod_entry[id(p)] = (p, p.__dict__.get("_mut", 0), key)
+        st.live = list(live)
+        st.configs_live = list(prob.configs[fe:])
+        for e, sn in enumerate(live):
+            st.node_pos[sn.name] = e
+            st.node_fp[sn.name] = (_node_sched_fp(sn), _node_usage_fp(sn))
+        # padded mirrors (pad_problem's conventions: price inf, cfg -1)
+        st.h_req = np.zeros((st.Gp, st.R), np.float32)
+        st.h_req[:G] = prob.req
+        st.h_cnt = np.zeros(st.Gp, np.int32)
+        st.h_cnt[:G] = prob.cnt
+        st.h_feas = np.zeros((st.Gp, st.Cp), bool)
+        st.h_feas[:G, :C] = prob.feas
+        st.h_alloc = np.zeros((st.Cp, st.R), np.float32)
+        st.h_alloc[:C] = prob.alloc
+        st.h_price = np.full(st.Cp, np.inf, np.float32)
+        st.h_price[:C] = prob.price
+        st.h_openable = np.zeros(st.Cp, bool)
+        st.h_openable[:C] = prob.openable
+        st.h_used0 = np.zeros((st.Kp, st.R), np.float32)
+        st.h_used0[:E] = prob.used0
+        st.h_npods0 = np.zeros(st.Kp, np.int32)
+        st.h_npods0[:E] = prob.npods0
+        st._device_seed()
+        st.prob = prob
+        st.last_delta_rows = 0
+        return st
+
+    def _device_seed(self) -> None:
+        """Upload the mirrors once (the rebuild's one full transfer) with
+        the pack backend's shardings, plus the pack-time constants the
+        plain shape never mutates (maxper=BIG, slot=0, sig0=0)."""
+        import jax
+
+        E = len(self.live)
+        cfg0 = np.full(self.Kp, -1, np.int32)
+        cfg0[:E] = np.arange(self.fe, self.fe + E, dtype=np.int32)
+        maxper = np.full(self.Gp, BIG, np.int32)
+        slot = np.zeros(self.Gp, np.int32)
+        sig0 = np.zeros((2, self.Kp), np.int32)  # Sp bucket floor is 2
+        if self.mesh is None:
+            names = ("repl", "on_c", "on_c2", "on_gc", "on_k", "on_k2",
+                     "on_sk")
+            put = {k: jax.device_put for k in names}
+        else:
+            sh = _mesh_shardings(self.mesh)
+            put = {
+                k: (lambda a, s=s: jax.device_put(a, s))
+                for k, s in sh.items()
+            }
+        self.d_req = put["repl"](self.h_req)
+        self.d_cnt = put["repl"](self.h_cnt)
+        self.d_feas = put["on_gc"](self.h_feas)
+        self.d_alloc = put["on_c2"](self.h_alloc)
+        self.d_price = put["on_c"](self.h_price)
+        self.d_openable = put["on_c"](self.h_openable)
+        self.d_used0 = put["on_k2"](self.h_used0)
+        self.d_npods0 = put["on_k"](self.h_npods0)
+        self.d_cfg0 = put["on_k"](cfg0)
+        self.d_maxper = put["repl"](maxper)
+        self.d_slot = put["repl"](slot)
+        self.d_sig0 = put["on_sk"](sig0)
+
+    # ------------------------------------------------------------ refresh
+    def try_refresh(
+        self, solver, pods: List[Pod], cat_key, live_new, node_fps
+    ) -> bool:
+        """Two-phase delta: PLAN validates eligibility and computes the
+        permutations/scatters without touching any state (so a bail-out
+        leaves the state coherent), APPLY edits the mirrors and replays
+        the identical edit on device through the donated jit.  cat_key /
+        live_new / node_fps are the tick-wide invariants `refresh`
+        computed once for every candidate state."""
+        plan = self._plan(solver, pods, cat_key, live_new, node_fps)
+        if plan is None:
+            return False
+        self._apply(plan, pods)
+        return True
+
+    def _plan(self, solver, pods: List[Pod], cat_key, live_new, node_fps):
+        if solver.pack_fn is not self.pack_fn_ref:
+            return None
+        if cat_key != self.cat_key:
+            return None  # catalog roll / pool mutation: full rebuild
+        # ---- live nodes --------------------------------------------------
+        E_new = len(live_new)
+        if self.fe + E_new + 1 > self.Cp:
+            return None  # live-column bucket overflow
+        node_plan = []  # (sn, old_pos_or_None, sched_changed, usage_changed)
+        names_new = set()
+        for sn, (sched_fp, usage_fp) in zip(live_new, node_fps):
+            if sn.name in names_new:
+                return None  # duplicate names would alias columns
+            names_new.add(sn.name)
+            old = self.node_pos.get(sn.name)
+            if old is None:
+                sched_ch = usage_ch = True
+            else:
+                prev_sched, prev_usage = self.node_fp[sn.name]
+                sched_ch = sched_fp != prev_sched
+                usage_ch = usage_fp != prev_usage
+            node_plan.append((sn, old, sched_ch, usage_ch, sched_fp, usage_fp))
+        # ---- pods --------------------------------------------------------
+        cur_ids = set()
+        adds: List[Tuple[Pod, object]] = []
+        drops: List[Tuple[Pod, object]] = []
+        first_occ: Dict[object, int] = {}
+        for i, p in enumerate(pods):
+            pid = id(p)
+            if pid in cur_ids:
+                return None  # duplicate pod object
+            cur_ids.add(pid)
+            ent = self.pod_entry.get(pid)
+            mut = p.__dict__.get("_mut", 0)
+            if ent is not None and ent[1] == mut:
+                ck = ent[2]
+            else:
+                if not _plain_pod(p):
+                    return None
+                ck = p.class_key()
+                if ent is not None:
+                    drops.append((p, ent[2]))
+                adds.append((p, ck))
+            if ck not in first_occ:
+                first_occ[ck] = i
+        for pid, ent in self.pod_entry.items():
+            if pid not in cur_ids:
+                drops.append((ent[0], ent[2]))
+        churn = len(adds) + len(drops)
+        if churn > REBUILD_FRACTION * max(len(pods), 1) + 8:
+            return None  # past the midpoint a full compile is cheaper
+        # ---- axis stability ---------------------------------------------
+        # an arriving extended resource (or the departure of the only
+        # class carrying one) changes the axis set, which re-shapes every
+        # tensor: full rebuild
+        extra = dict(self.extra_axes)
+        add_by_class: Dict[object, List[Pod]] = {}
+        for p, ck in adds:
+            add_by_class.setdefault(ck, []).append(p)
+        drop_by_class: Dict[object, set] = {}
+        for p, ck in drops:
+            drop_by_class.setdefault(ck, set()).add(id(p))
+
+        def class_extras(requests) -> list:
+            return [
+                ax for ax in requests.keys()
+                if ax not in L.WELL_KNOWN_RESOURCES
+            ]
+
+        touched = set(add_by_class) | set(drop_by_class)
+        survivors: List[Tuple[_Cls, List[Pod]]] = []  # (cls, new members)
+        removed_keys = set()
+        for c in self.cls:
+            if c.key not in touched:
+                survivors.append((c, c.cm.pods))
+                continue
+            dropset = drop_by_class.get(c.key, ())
+            members = [p for p in c.cm.pods if id(p) not in dropset]
+            members += add_by_class.pop(c.key, [])
+            if members:
+                survivors.append((c, members))
+            else:
+                removed_keys.add(c.key)
+                for ax in class_extras(c.cm.requests):
+                    extra[ax] -= 1
+                    if extra[ax] == 0:
+                        del extra[ax]
+        fresh: List[Tuple[object, List[Pod]]] = []
+        for ck, members in add_by_class.items():
+            fresh.append((ck, members))
+            for ax in class_extras(members[0].requests):
+                if ax not in self.axes:
+                    return None  # new axis: tensors re-shape
+                extra[ax] = extra.get(ax, 0) + 1
+        if set(extra) != set(self.extra_axes):
+            # the axis SET must stay exactly the state's (a vanished axis
+            # would make a from-scratch compile narrower than our tensors)
+            return None
+        G_new = len(survivors) + len(fresh)
+        if G_new + 1 > self.Gp:
+            return None  # class bucket overflow
+        n_pods = len(pods)
+        if E_new + min(n_pods, _SLOT_HEADROOM) + 1 > self.Kp:
+            return None  # node-slot bucket overflow
+        return dict(
+            node_plan=node_plan,
+            survivors=survivors,
+            fresh=fresh,
+            removed_keys=removed_keys,
+            adds=adds,
+            drops=drops,
+            first_occ=first_occ,
+            extra=extra,
+            E_new=E_new,
+        )
+
+    def _apply(self, plan: dict, pods: List[Pod]) -> None:
+        fe, Gp, Cp, Kp = self.fe, self.Gp, self.Cp, self.Kp
+        first_occ = plan["first_occ"]
+        # ---- new class order: exactly the from-scratch compile's -------
+        # stable FFD sort over first-occurrence order == sort by the
+        # (ffd key, first occurrence) pair, which is total per class
+        entries: List[Tuple[tuple, int, Optional[_Cls], object, list]] = []
+        old_pos = {id(c): g for g, c in enumerate(self.cls)}
+        for c, members in plan["survivors"]:
+            entries.append(
+                (c.sort_key, first_occ[c.key], c, c.key, members)
+            )
+        for ck, members in plan["fresh"]:
+            rep = members[0]
+            cm = ClassMeta(
+                pods=members,
+                requests=rep.requests,
+                signature=rep.constraint_signature(),
+            )
+            nc = _Cls(cm, ck, self.axes)
+            entries.append((nc.sort_key, first_occ[ck], nc, ck, members))
+        entries.sort(key=lambda e: (e[0], e[1]))
+
+        g_perm = np.full(Gp, Gp - 1, np.int32)  # scratch = canonical pad
+        class_scatter: List[int] = []
+        new_cls: List[_Cls] = []
+        meta_changed = False
+        for gnew, (_, _, c, ck, members) in enumerate(entries):
+            src = old_pos.get(id(c))
+            if src is None:
+                class_scatter.append(gnew)  # brand-new class
+            else:
+                g_perm[gnew] = src
+                if len(members) != len(c.cm.pods):
+                    class_scatter.append(gnew)  # count changed
+            if members is not c.cm.pods:
+                # REBIND a fresh ClassMeta rather than edit in place:
+                # snapshots stored in the solver's compile cache share
+                # these meta objects, and an in-place edit would desync a
+                # cached problem's copied cnt from its class membership
+                c.cm = replace(c.cm, pods=members)
+                meta_changed = True
+            new_cls.append(c)
+        # ---- live-column order: the new snapshot's ----------------------
+        node_plan = plan["node_plan"]
+        E_new = plan["E_new"]
+        c_perm = np.full(Cp, Cp - 1, np.int32)
+        c_perm[:fe] = np.arange(fe, dtype=np.int32)
+        k_perm = np.full(Kp, Kp - 1, np.int32)
+        col_scatter: List[int] = []  # NEW-order positions e
+        used_scatter: List[int] = []
+        live_new: list = []
+        configs_new: List[ConfigMeta] = []
+        for e, (sn, old, sched_ch, usage_ch, _, _) in enumerate(node_plan):
+            if old is not None:
+                c_perm[fe + e] = fe + old
+                k_perm[e] = old
+            if sched_ch:
+                col_scatter.append(e)
+            if usage_ch:
+                used_scatter.append(e)
+            live_new.append(sn)
+            if old is not None and not sched_ch:
+                # fresh ConfigMeta, same column: older snapshots keep the
+                # wrapper they compiled against (content-equal wrappers
+                # are interchangeable — the compile-cache doctrine), the
+                # next snapshot reads the current one
+                configs_new.append(
+                    replace(self.configs_live[old], existing=sn)
+                )
+            else:
+                configs_new.append(
+                    ConfigMeta(
+                        pool=None,
+                        instance_type=None,
+                        zone=sn.zone,
+                        capacity_type=sn.capacity_type,
+                        price=0.0,
+                        existing=sn,
+                    )
+                )
+        identity_g = bool((g_perm[: len(entries)] ==
+                           np.arange(len(entries))).all()) and len(
+            entries
+        ) == len(self.cls)
+        identity_c = bool(
+            (c_perm[fe : fe + E_new] ==
+             np.arange(fe, fe + E_new)).all()
+        ) and E_new == len(self.live)
+        # ---- host mirror: permutations ----------------------------------
+        if not (identity_g and identity_c):
+            self.h_req = self.h_req[g_perm]
+            self.h_cnt = self.h_cnt[g_perm]
+            self.h_feas = self.h_feas[g_perm][:, c_perm]
+            self.h_alloc = self.h_alloc[c_perm]
+            self.h_price = self.h_price[c_perm]
+            self.h_used0 = self.h_used0[k_perm]
+            self.h_npods0 = self.h_npods0[k_perm]
+        G_new = len(entries)
+        # ---- host mirror: class-row scatters ----------------------------
+        catalog = self.catalog
+        for gnew in class_scatter:
+            c = new_cls[gnew]
+            cm = c.cm
+            self.h_req[gnew] = c.req_vec
+            self.h_cnt[gnew] = len(cm.pods)
+            if g_perm[gnew] == Gp - 1:  # brand-new: assemble the full row
+                rep = cm.pods[0]
+                open_row = open_config_row(
+                    catalog, rep, cm.signature, self.pools_by_name
+                )
+                open_row = restrict_open_tier(catalog, open_row, c.req_vec)
+                row = np.zeros(Cp, bool)
+                row[:fe] = open_row
+                for e, sn in enumerate(live_new):
+                    row[fe + e] = _fits_existing(rep, c.sched, sn)
+                self.h_feas[gnew] = row
+        # ---- host mirror: live-column scatters --------------------------
+        for e in col_scatter:
+            sn = live_new[e]
+            col = fe + e
+            self.h_alloc[col] = _vec(sn.allocatable, self.axes)
+            self.h_price[col] = 0.0
+            for g in range(G_new):
+                self.h_feas[g, col] = _fits_existing(
+                    new_cls[g].cm.pods[0], new_cls[g].sched, sn
+                )
+            self.h_feas[G_new:, col] = False
+        for e in used_scatter:
+            sn = live_new[e]
+            self.h_used0[e] = _vec(sn.used, self.axes)
+            self.h_npods0[e] = len(sn.pods)
+        # ---- device: one donated gather+scatter step --------------------
+        n_delta = len(class_scatter) + len(col_scatter) + len(used_scatter)
+        if n_delta or not (identity_g and identity_c):
+            g_idx = _pad_idx(class_scatter, Gp - 1)
+            col_idx = _pad_idx([fe + e for e in col_scatter], Cp - 1)
+            k_idx = _pad_idx(used_scatter, Kp - 1)
+            fn = _delta_fn(self.mesh)
+            import warnings
+
+            with warnings.catch_warnings():
+                # CPU XLA occasionally declines a donation; the fallback
+                # is a copy, not an error — keep the log surface quiet
+                warnings.filterwarnings(
+                    "ignore", message=".*donated.*", category=UserWarning
+                )
+                (
+                    self.d_req, self.d_cnt, self.d_feas, self.d_alloc,
+                    self.d_price, self.d_used0, self.d_npods0, self.d_cfg0,
+                ) = fn(
+                    self.d_req, self.d_cnt, self.d_feas, self.d_alloc,
+                    self.d_price, self.d_used0, self.d_npods0,
+                    g_perm, c_perm, k_perm,
+                    g_idx, self.h_req[g_idx], self.h_cnt[g_idx],
+                    self.h_feas[g_idx],
+                    col_idx, self.h_alloc[col_idx], self.h_price[col_idx],
+                    self.h_feas[:, col_idx],
+                    k_idx, self.h_used0[k_idx], self.h_npods0[k_idx],
+                    np.int32(E_new), np.int32(fe),
+                )
+        # ---- bookkeeping -------------------------------------------------
+        self.cls = new_cls
+        self.slot_of = {c.key: g for g, c in enumerate(new_cls)}
+        for p, ck in plan["drops"]:
+            self.pod_entry.pop(id(p), None)
+        for p, ck in plan["adds"]:
+            self.pod_entry[id(p)] = (p, p.__dict__.get("_mut", 0), ck)
+        self.extra_axes = plan["extra"]
+        self.live = live_new
+        self.configs_live = configs_new
+        self.node_pos = {sn.name: e for e, sn in enumerate(live_new)}
+        self.node_fp = {
+            sn.name: (fp_s, fp_u)
+            for (sn, _, _, _, fp_s, fp_u) in node_plan
+        }
+        self.last_delta_rows = n_delta
+        # meta_changed alone (an equal-count membership swap) produces no
+        # tensor delta but DOES change which pod objects decode assigns —
+        # the snapshot must refresh for it too
+        self.prob = self._snapshot() if meta_changed or n_delta or not (
+            identity_g and identity_c
+        ) else self.prob
+
+    # ----------------------------------------------------------- snapshot
+    def _snapshot(self) -> CompiledProblem:
+        """A CompiledProblem over COPIES of the unpadded mirror regions —
+        decode (and its lazy widen thunks) must never alias mirrors a
+        later delta will edit in place."""
+        G = len(self.cls)
+        E = len(self.live)
+        C = self.fe + E
+        return CompiledProblem(
+            axes=self.axes,
+            classes=[c.cm for c in self.cls],
+            configs=list(self.catalog.configs) + list(self.configs_live),
+            req=self.h_req[:G].copy(),
+            cnt=self.h_cnt[:G].copy(),
+            maxper=np.full(G, BIG, np.int32),
+            slot=np.zeros(G, np.int32),
+            alloc=self.h_alloc[:C].copy(),
+            price=self.h_price[:C].copy(),
+            openable=self.h_openable[:C].copy(),
+            feas=self.h_feas[:G, :C].copy(),
+            pool_daemon_overhead=self.catalog.pool_overhead,
+            used0=self.h_used0[:E].copy(),
+            cfg0=np.arange(self.fe, self.fe + E, dtype=np.int32),
+            npods0=self.h_npods0[:E].copy(),
+            sig_used0=np.zeros((1, E), np.int32),
+            n_track_slots=1,
+        )
+
+    def problem(self) -> CompiledProblem:
+        if self.prob is None:
+            self.prob = self._snapshot()
+        return self.prob
+
+    def groups(self) -> list:
+        """partition_groups-shaped (key, members) list for the solver's
+        compile-cache entry (consumed only for re-storage; resident
+        batches never have an oracle half)."""
+        return [
+            ((c.cm.signature, c.cm.requests), list(c.cm.pods))
+            for c in self.cls
+        ]
+
+    # ---------------------------------------------------------------- pack
+    @property
+    def pack(self):
+        """A pack_fn over the RESIDENT buffers: zero per-solve upload (the
+        tensors are already on device; only the scalar slot cursor
+        travels).  An explicit k_slots (the solver's overflow retry, or a
+        caller sizing its own padding) falls back to the ordinary upload
+        path over the snapshot problem."""
+        fn = self.__dict__.get("_pack_fn")
+        if fn is None:
+
+            def pack(prob, k_slots: int = 0, objective: str = "nodes"):
+                if k_slots and k_slots != self.Kp:
+                    return self._fallback_pack(prob, k_slots, objective)
+                return self._device_pack(objective)
+
+            pack.kernel_name = (
+                "scan-sharded" if self.mesh is not None else "scan"
+            )
+            pack.resident = True
+            fn = self.__dict__["_pack_fn"] = pack
+        return fn
+
+    def _device_pack(self, objective: str) -> PackResult:
+        E = np.int32(len(self.live))
+        if self.mesh is not None:
+            from karpenter_tpu.parallel.mesh import _sharded_pack
+
+            fn = _sharded_pack(self.mesh, self.Kp, objective)
+            return fn(
+                self.d_req, self.d_cnt, self.d_maxper, self.d_slot,
+                self.d_feas, self.d_alloc, self.d_price, self.d_openable,
+                self.d_used0, self.d_cfg0, self.d_npods0, E, self.d_sig0,
+            )
+        from karpenter_tpu.ops.packer import pack_kernel
+
+        return pack_kernel(
+            self.d_req, self.d_cnt, self.d_maxper, self.d_slot,
+            self.d_feas, self.d_alloc, self.d_price, self.d_openable,
+            self.d_used0, self.d_cfg0, self.d_npods0, E, self.d_sig0,
+            k_slots=self.Kp, objective=objective,
+        )
+
+    def _fallback_pack(self, prob, k_slots: int, objective: str):
+        if self.mesh is not None:
+            from karpenter_tpu.parallel.mesh import mesh_pack_fn
+
+            return mesh_pack_fn(self.mesh)(prob, k_slots, objective)
+        from karpenter_tpu.ops.packer import run_pack
+
+        return run_pack(prob, k_slots, objective)
+
+
+class ResidentCache:
+    """A small LRU of resident states (the provisioner's pending set and
+    the deprovisioner's repack/base universes alternate on one scheduler;
+    two slots keep both warm without letting device buffers accumulate)."""
+
+    CAP = 2
+
+    def __init__(self):
+        self.states: List[ResidentState] = []
+
+    def refresh(self, solver, pods: List[Pod]) -> Optional[ResidentState]:
+        """Delta-update the first state that can absorb this tick's diff;
+        None when every state misses (the caller runs the full compile
+        and seeds a state via `rebuild`)."""
+        if not self.states:
+            return None
+        # tick-wide invariants — identical for every candidate state, so
+        # the O(existing bound pods) carrier scan and the per-live-node
+        # fingerprint tuples are built once per call, not once per slot
+        if not _carrier_free(solver.existing):
+            # a carrier appeared — possibly on a NON-live node the live
+            # filter hides (a cordoned node's bound anti term still
+            # repels batch pods in the full compile's partition)
+            return None
+        cat_key = _catalog_key(solver)
+        live_new = live_filter(solver.existing)
+        node_fps = [
+            (_node_sched_fp(sn), _node_usage_fp(sn)) for sn in live_new
+        ]
+        for st in list(self.states):
+            if st.try_refresh(solver, pods, cat_key, live_new, node_fps):
+                self.states.remove(st)
+                self.states.append(st)  # most-recently-used last
+                return st
+        return None
+
+    def rebuild(
+        self, solver, pods: List[Pod], prob: CompiledProblem, catalog
+    ) -> Optional[ResidentState]:
+        if catalog is None or not resident_capable(solver.pack_fn):
+            return None
+        with phase("delta"):
+            st = ResidentState.build(solver, pods, prob, catalog)
+        if st is None:
+            return None
+        while len(self.states) >= self.CAP:
+            self.states.pop(0)
+        self.states.append(st)
+        return st
+
+    def match(self, prob: CompiledProblem, pack_fn=None) -> Optional[ResidentState]:
+        """The state whose CURRENT snapshot is exactly `prob` (identity):
+        a compile-cache hit re-serving that snapshot may pack straight
+        from the resident buffers with no delta at all.  ``pack_fn``
+        fences against a backend swap between ticks — a state built for
+        one backend must not serve another's solve."""
+        for st in self.states:
+            if st.prob is prob and (
+                pack_fn is None or st.pack_fn_ref is pack_fn
+            ):
+                return st
+        return None

@@ -1034,6 +1034,20 @@ def _daemon_overhead(
     return out
 
 
+def live_filter(existing) -> list:
+    """The schedulable subset of `existing`: nodes neither marked for
+    deletion nor cordoned.  The ONE definition — `compile_problem`'s
+    existing-node rows and the resident delta planner (ops/resident.py)
+    must agree on it exactly, or the resident path keeps columns a
+    from-scratch compile would drop."""
+    return [
+        sn
+        for sn in existing
+        if not sn.marked_for_deletion()
+        and not (sn.node is not None and sn.node.cordoned)
+    ]
+
+
 def compile_problem(
     pods: Sequence[Pod],
     pools: Sequence[NodePool],
@@ -1079,12 +1093,7 @@ def compile_problem(
     R = len(axes)
 
     # ----------------------------------------------- existing-node rows
-    live = [
-        sn
-        for sn in existing
-        if not sn.marked_for_deletion()
-        and not (sn.node is not None and sn.node.cordoned)
-    ]
+    live = live_filter(existing)
     first_existing = len(catalog.configs)
     configs = list(catalog.configs) + [
         ConfigMeta(
@@ -1415,19 +1424,7 @@ def compile_problem(
             )
 
     # FFD order: constrained classes first, then descending size
-    def class_key(cm: ClassMeta) -> Tuple:
-        constrained = (
-            cm.max_per_node < BIG
-            or bool(cm.zone_pin)
-            or cm.rep_override is not None
-        )
-        r = cm.requests
-        return (
-            not constrained,
-            -(r.cpu + r.memory / (4 * 2**30)),
-        )
-
-    classes.sort(key=class_key)
+    classes.sort(key=ffd_class_key)
     G = len(classes)
 
     # --------------------------------------------------------- feasibility
@@ -1474,33 +1471,9 @@ def compile_problem(
         row = row_memo.get(mkey)
         if row is not None:
             return row
-        # the OPENABLE prefix of the row depends only on the signature
-        # shape and this catalog snapshot — never on the live nodes — so
-        # it memoizes for the CATALOG's lifetime ("catalog epoch": a new
-        # inventory snapshot builds a new Catalog with a fresh memo).  A
-        # warm re-compile of a recurring pending set assembles its rows
-        # from these cached prefixes and only re-checks the live columns.
-        ckey = ("row",) + mkey
-        open_row = catalog.feas_memo.get(ckey)
-        if open_row is None:
-            open_row = np.zeros(first_existing, dtype=bool)
-            for pname, pr in catalog.pool_rows.items():
-                if pool_allow is not None and pname not in pool_allow:
-                    continue  # only the domain's pools DEFINE the spread key
-                ent = _pool_feas(
-                    catalog, rep, sig, pname, pools_by_name, term, keep
-                )
-                if ent is None:
-                    continue
-                type_ok, zone_ok, ct_ok = ent
-                if zone_pin:
-                    zone_ok = zone_ok & np.fromiter(
-                        (z == zone_pin for z in pr.zones), bool, len(pr.zones)
-                    )
-                open_row[pr.rows] = (
-                    type_ok[pr.t_of] & zone_ok[pr.z_of] & ct_ok[pr.ct_of]
-                )
-            _memo_put(catalog, ckey, open_row)
+        open_row = open_config_row(
+            catalog, rep, sig, pools_by_name, zone_pin, term, keep, pool_allow
+        )
         row = np.zeros(C, dtype=bool)
         row[:first_existing] = open_row
         if live:
@@ -1587,18 +1560,9 @@ def compile_problem(
     # freely fills any open node regardless of pool), so restricting to a
     # single pool within a tier would fragment the pack.
     if len(pools) > 1:
-        pool_of = np.full(C, -1, np.int32)
-        pool_of[:first_existing] = catalog.pool_rank_of
-        # rank -> weight tier index (pools are weight-desc ordered)
-        tier_of_rank = np.zeros(len(pools), np.int32)
-        tier = 0
-        for r in range(1, len(pools)):
-            if pools[r].weight != pools[r - 1].weight:
-                tier += 1
-            tier_of_rank[r] = tier
-        tier_of = np.full(C, -1, np.int32)
-        tier_of[:first_existing] = tier_of_rank[catalog.pool_rank_of]
-        n_tiers = tier + 1
+        cat_tiers, n_tiers = catalog_tiers(catalog)
+        tier_of = np.full(C, -1, np.int32)  # live columns carry no tier
+        tier_of[:first_existing] = cat_tiers
         for g in range(G):
             fits = (req_mat[g][None, :] <= alloc + 1e-6).all(axis=1)
             for t in range(n_tiers):
@@ -1658,6 +1622,116 @@ def _memo_put(catalog: Catalog, key, value):
         catalog.feas_memo.clear()
     catalog.feas_memo[key] = value
     return value
+
+
+def ffd_class_key(cm: ClassMeta) -> Tuple:
+    """The compile's FFD class sort key: constrained classes first, then
+    descending size; ties keep list order (stable sort), which is the
+    classes' first-occurrence order over the batch.  Shared with the
+    resident delta planner (ops/resident.py), which must insert arriving
+    classes at exactly the position a from-scratch compile would sort
+    them to."""
+    constrained = (
+        cm.max_per_node < BIG
+        or bool(cm.zone_pin)
+        or cm.rep_override is not None
+    )
+    r = cm.requests
+    return (
+        not constrained,
+        -(r.cpu + r.memory / (4 * 2**30)),
+    )
+
+
+def open_config_row(
+    catalog: Catalog,
+    rep: Pod,
+    sig: Tuple,
+    pools_by_name: Dict[str, NodePool],
+    zone_pin: str = "",
+    term: int = 0,
+    keep: Optional[int] = None,
+    pool_allow: Optional[frozenset] = None,
+) -> np.ndarray:
+    """The OPENABLE prefix of one class's feasibility row.
+
+    Depends only on the signature shape and this catalog snapshot — never
+    on the live nodes — so it memoizes for the CATALOG's lifetime
+    ("catalog epoch": a new inventory snapshot builds a new Catalog with
+    a fresh memo).  A warm re-compile of a recurring pending set
+    assembles its rows from these cached prefixes and only re-checks the
+    live columns.  THE single assembly path for openable rows: both
+    `compile_problem` and the resident delta planner (ops/resident.py)
+    call it, so an incrementally-scattered row is bit-identical to a
+    from-scratch compile's by construction."""
+    ckey = ("row", sig, zone_pin, term, keep, pool_allow)
+    open_row = catalog.feas_memo.get(ckey)
+    if open_row is None:
+        open_row = np.zeros(len(catalog.configs), dtype=bool)
+        for pname, pr in catalog.pool_rows.items():
+            if pool_allow is not None and pname not in pool_allow:
+                continue  # only the domain's pools DEFINE the spread key
+            ent = _pool_feas(
+                catalog, rep, sig, pname, pools_by_name, term, keep
+            )
+            if ent is None:
+                continue
+            type_ok, zone_ok, ct_ok = ent
+            if zone_pin:
+                zone_ok = zone_ok & np.fromiter(
+                    (z == zone_pin for z in pr.zones), bool, len(pr.zones)
+                )
+            open_row[pr.rows] = (
+                type_ok[pr.t_of] & zone_ok[pr.z_of] & ct_ok[pr.ct_of]
+            )
+        _memo_put(catalog, ckey, open_row)
+    return open_row
+
+
+def catalog_tiers(catalog: Catalog) -> Tuple[np.ndarray, int]:
+    """(tier index per catalog config row, tier count) for the pool-weight
+    priority restriction — pools are weight-desc ordered, equal weights
+    share a tier.  Memoized per catalog; both `compile_problem`'s
+    per-class loop and the resident path's `restrict_open_tier` read it,
+    so the tier rule has exactly one definition (live columns carry tier
+    -1 in the compile and never participate in tier CHOICE, which is why
+    the per-class restriction below can run on the openable prefix
+    alone)."""
+    ent = catalog.feas_memo.get("tiers")
+    if ent is None:
+        pools = catalog.pools
+        tier_of_rank = np.zeros(max(len(pools), 1), np.int32)
+        tier = 0
+        for r in range(1, len(pools)):
+            if pools[r].weight != pools[r - 1].weight:
+                tier += 1
+            tier_of_rank[r] = tier
+        tier_of = (
+            tier_of_rank[catalog.pool_rank_of]
+            if len(catalog.pool_rank_of)
+            else np.zeros(0, np.int32)
+        )
+        ent = _memo_put(catalog, "tiers", (tier_of, tier + 1))
+    return ent
+
+
+def restrict_open_tier(
+    catalog: Catalog, open_row: np.ndarray, req_vec: np.ndarray
+) -> np.ndarray:
+    """Per-class pool-weight tier restriction on the OPENABLE prefix —
+    the single-class equivalent of `compile_problem`'s pool-priority
+    loop.  Sound to run without the live columns: in the compile, live
+    columns carry tier -1, so they never influence which tier is chosen
+    and are never masked by the restriction.  The delta-correctness fuzz
+    suite (tests/test_resident_fuzz.py) pins the equivalence."""
+    if len(catalog.pools) <= 1:
+        return open_row
+    tier_of, n_tiers = catalog_tiers(catalog)
+    fits = (req_vec[None, :] <= catalog.alloc + 1e-6).all(axis=1)
+    for t in range(n_tiers):
+        if ((tier_of == t) & open_row & fits).any():
+            return open_row & (tier_of == t)
+    return open_row
 
 
 def _pool_zone_domains(pools: Sequence[NodePool], catalog: Catalog) -> set:

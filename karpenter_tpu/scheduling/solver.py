@@ -25,6 +25,7 @@ from karpenter_tpu.api.objects import selector_matches
 from karpenter_tpu.api.requirements import Op
 from karpenter_tpu.api.resources import Resources
 from karpenter_tpu.ops.pallas_packer import auto_pack
+from karpenter_tpu.ops.resident import ResidentCache, resident_capable
 from karpenter_tpu.ops.tensorize import (
     CompiledProblem,
     ConfigMeta,
@@ -197,6 +198,19 @@ class TensorScheduler:
         # the prior compile across descent levels AND across reconciles)
         self._removal_cache: dict = {}
         self.last_removal_batch = 0  # elements in the last batched dispatch
+        # device-resident incremental tensors (ops/resident.py): warm
+        # ticks skip re-tensorize AND the host->device upload — the
+        # compiled problem lives on device and cluster deltas apply as
+        # donated scatter updates.  `resident_hits` counts solves served
+        # from the resident buffers (delta or no-change), `resident_
+        # rebuilds` counts full tensorizes while the resident layer was
+        # eligible to serve (catalog roll, bucket overflow, constraint
+        # carriers, first solve).
+        self._resident = ResidentCache()
+        self.resident_hits = 0
+        self.resident_rebuilds = 0
+        self.last_resident = False  # this solve packed from resident buffers
+        self.last_delta_rows = -1  # scattered rows+cols on a delta tick
         # per-solve observability: wall-time breakdown by phase (seconds,
         # disjoint, summing to the solve's wall time) and which
         # continuation handled the oracle half ("join" = overlapped
@@ -262,44 +276,94 @@ class TensorScheduler:
     def _solve(self, pods: List[Pod]) -> SchedulingResult:
         self.last_compile_relaxed = 0  # per-solve; oracle paths leave it 0
         self.last_continuation = ""
+        self.last_resident = False
+        self.last_delta_rows = -1
+        resident = None
         cached = self._cache_lookup(pods)
         if cached is None:
             self.compile_cache_misses += 1
-            with phase("partition"), TRACER.span("solver.partition"):
-                sup_groups, unsupported, _reason = partition_groups(
-                    pods, existing=self.existing, pools=self.pools
-                )
-            if sup_groups:
-                # live-member co-location closures must JOIN specific live
-                # nodes; the tensor half would otherwise fill those nodes
-                # with plain pods first (existing capacity is free) and
-                # strand the groups — compile against SHADOW nodes with
-                # the groups' totals reserved.  The per-pod anchor
-                # assignments double as the overlapped join plan's input.
-                with phase("partition"):
-                    shadow, join_assign = self._reserve_live_capacity(
-                        unsupported
-                    )
-                prob = self._compile_tensor(
-                    [p for _, members in sup_groups for p in members],
-                    sup_groups,
-                    existing=shadow,
+            # the resident delta path: an already-seeded device problem
+            # absorbs this tick's diff (pod arrivals/deletions, node
+            # add/remove, in-place mutations) as scatter updates — no
+            # host compile, no tensor upload
+            with phase("delta"), TRACER.span("solver.delta"):
+                resident = self._resident.refresh(self, pods)
+            if resident is not None:
+                self.resident_hits += 1
+                self.last_delta_rows = resident.last_delta_rows
+                prob = resident.problem()
+                sup_groups = resident.groups()
+                unsupported, join_assign = [], ()
+                # compact_ok=True is PROVEN, not assumed: resident
+                # eligibility keeps every batch pod free of spread/
+                # affinity selectors (_plain_pod) and refuses carriers on
+                # ANY existing node, live or not (_carrier_free) — both
+                # _compact_guard clauses are vacuous here, and skipping
+                # the guard saves its O(batch) scan on every warm tick
+                compact_ok = True
+                self._cache_store(
+                    pods, sup_groups, [], prob, (), compact_ok
                 )
             else:
-                prob, join_assign = None, ()
-            compact_ok = self._compact_guard(pods)
-            self._cache_store(
-                pods, sup_groups, unsupported, prob, join_assign, compact_ok
-            )
+                with phase("partition"), TRACER.span("solver.partition"):
+                    sup_groups, unsupported, _reason = partition_groups(
+                        pods, existing=self.existing, pools=self.pools
+                    )
+                if sup_groups:
+                    # live-member co-location closures must JOIN specific
+                    # live nodes; the tensor half would otherwise fill
+                    # those nodes with plain pods first (existing capacity
+                    # is free) and strand the groups — compile against
+                    # SHADOW nodes with the groups' totals reserved.  The
+                    # per-pod anchor assignments double as the overlapped
+                    # join plan's input.
+                    with phase("partition"):
+                        shadow, join_assign = self._reserve_live_capacity(
+                            unsupported
+                        )
+                    prob = self._compile_tensor(
+                        [p for _, members in sup_groups for p in members],
+                        sup_groups,
+                        existing=shadow,
+                    )
+                else:
+                    prob, join_assign = None, ()
+                compact_ok = self._compact_guard(pods)
+                self._cache_store(
+                    pods, sup_groups, unsupported, prob, join_assign,
+                    compact_ok,
+                )
+                # full tensorize happened: seed/replace a resident state
+                # so the NEXT delta applies on device (ineligible shapes
+                # leave the layer empty and simply recompile next time)
+                if prob is not None and prob.supported and not unsupported:
+                    if self.pack_fn is None:
+                        self.pack_fn = default_pack_fn()
+                    resident = self._resident.rebuild(
+                        self, pods, prob, self._catalog
+                    )
+                    if resident is not None:
+                        self.resident_rebuilds += 1
         else:
             self.compile_cache_hits += 1
             sup_groups, unsupported, prob, join_assign, compact_ok = cached
+            # a cache hit re-serving the resident snapshot packs straight
+            # from the device buffers (zero upload), no delta needed
+            resident = (
+                self._resident.match(prob, self.pack_fn)
+                if prob is not None
+                else None
+            )
+            if resident is not None:
+                self.resident_hits += 1
+                self.last_delta_rows = 0
         if prob is None or not prob.supported:
             # nothing compiled (all-oracle batch or a compile bail):
             # solve everything through the oracle
             with phase("oracle"), TRACER.span("solver.oracle", pods=len(pods)):
                 return self._oracle(pods)
         self.last_path = "tensor"
+        self.last_resident = resident is not None
         self.last_compile_relaxed = prob.compile_relaxed
 
         # oracle/device overlap: the pack dispatch below only ENQUEUES
@@ -316,7 +380,9 @@ class TensorScheduler:
                 join_plan = self._plan_live_join(unsupported, join_assign)
 
         result = self._pack_decode(
-            prob, overlap=overlap if unsupported else None
+            prob,
+            overlap=overlap if unsupported else None,
+            pack_fn=resident.pack if resident is not None else None,
         )
         if unsupported:
             self.last_path = "hybrid"
@@ -647,14 +713,20 @@ class TensorScheduler:
                 groups=groups,
             )
 
-    def _pack_decode(self, prob: CompiledProblem, overlap=None):
+    def _pack_decode(self, prob: CompiledProblem, overlap=None, pack_fn=None):
         """Dispatch the device pack, run `overlap` host work while the
         device executes (JAX dispatch is asynchronous — only the fetch
-        blocks), then fetch, retry on slot overflow, and decode."""
+        blocks), then fetch, retry on slot overflow, and decode.
+
+        ``pack_fn`` overrides the scheduler's backend for this one solve
+        — the resident path passes its zero-upload device-buffer pack
+        (ops/resident.py), whose overflow retry transparently falls back
+        to the ordinary upload path."""
         import jax
 
         if self.pack_fn is None:
             self.pack_fn = default_pack_fn()
+        eff_pack = pack_fn if pack_fn is not None else self.pack_fn
         # the XLA timeline must stay open through fetch: pack_fn only
         # ENQUEUES device work (async dispatch), the fetch's read is what
         # forces execution — closing the profiler before it would capture
@@ -662,14 +734,14 @@ class TensorScheduler:
         xla_trace = device_trace(TRACER)
         xla_trace.__enter__()
         with phase("dispatch"), TRACER.span("solver.pack"):
-            result = self.pack_fn(prob, objective=self.objective)
+            result = eff_pack(prob, objective=self.objective)
         from karpenter_tpu.ops import pallas_packer
         from karpenter_tpu.ops.packer import fetch_bundled
 
         self.last_kernel = (
             pallas_packer.LAST_KERNEL
-            if self.pack_fn is auto_pack
-            else getattr(self.pack_fn, "kernel_name", "custom")
+            if eff_pack is auto_pack
+            else getattr(eff_pack, "kernel_name", "custom")
         )
         if overlap is not None:
             overlap()
@@ -696,7 +768,7 @@ class TensorScheduler:
             while self._overflowed(prob, leftover) and k < max_k:
                 k *= 2
                 with phase("dispatch"), TRACER.span("solver.pack", retry_k=k):
-                    result = self.pack_fn(
+                    result = eff_pack(
                         prob, k_slots=k, objective=self.objective
                     )
                 with phase("device_block"), TRACER.span(
@@ -996,28 +1068,45 @@ class TensorScheduler:
                 # sequential compile drops it with the node, the base
                 # compile would keep it — feasibility could differ
                 return _RemovalBase(reason="live-carrier-on-candidate")
-        sup_groups, unsupported, _why = partition_groups(
-            pods, existing=self.existing, pools=self.pools
-        )
-        if unsupported:
-            return _RemovalBase(reason="oracle-pods")
-        prob = self._compile_tensor(
-            [p for _, members in sup_groups for p in members], sup_groups
-        )
-        if not prob.supported:
-            return _RemovalBase(reason="compile-unsupported")
-        if prob.compile_relaxed:
-            return _RemovalBase(reason="compile-relaxed")
-        for cm in prob.classes:
-            if (
-                cm.group_size
-                or cm.zone_pin
-                or cm.rep_override is not None
-                or cm.pool_allow is not None
-            ):
-                return _RemovalBase(reason="macro-class")
-        if len(prob.cnt) and (prob.maxper < BIG).any():
-            return _RemovalBase(reason="tracked-signature")
+        # the base's guards are deliberately a superset of the resident
+        # layer's eligibility (ops/resident.py), so a resident hit below
+        # serves tensors the base could have compiled itself — bit-equal
+        # by the delta-correctness contract — and a warm consolidation
+        # pass stops paying the universe re-tensorize
+        with phase("delta"), TRACER.span("solver.delta"):
+            resident = self._resident.refresh(self, pods)
+        if resident is not None:
+            self.resident_hits += 1
+            prob = resident.problem()
+        else:
+            sup_groups, unsupported, _why = partition_groups(
+                pods, existing=self.existing, pools=self.pools
+            )
+            if unsupported:
+                return _RemovalBase(reason="oracle-pods")
+            prob = self._compile_tensor(
+                [p for _, members in sup_groups for p in members], sup_groups
+            )
+            if not prob.supported:
+                return _RemovalBase(reason="compile-unsupported")
+            if prob.compile_relaxed:
+                return _RemovalBase(reason="compile-relaxed")
+            for cm in prob.classes:
+                if (
+                    cm.group_size
+                    or cm.zone_pin
+                    or cm.rep_override is not None
+                    or cm.pool_allow is not None
+                ):
+                    return _RemovalBase(reason="macro-class")
+            if len(prob.cnt) and (prob.maxper < BIG).any():
+                return _RemovalBase(reason="tracked-signature")
+            if self.pack_fn is None:
+                self.pack_fn = default_pack_fn()
+            if self._resident.rebuild(
+                self, pods, prob, self._catalog
+            ) is not None:
+                self.resident_rebuilds += 1
         base = _RemovalBase()
         base.prob = prob
         base.n_live = len(prob.used0)
@@ -1026,6 +1115,17 @@ class TensorScheduler:
         # the whole pass and slot overflow is impossible
         base.args, base.k_slots = pad_problem(
             prob, k_slots=base.n_live + max(prob.total_pods(), 1)
+        )
+        # pin the padded tensors on device ONCE per base: the descent's
+        # repeated dispatches — and warm passes across reconciles, via the
+        # removal cache — stop re-uploading the class/config tensors on
+        # every verdict batch (each jit call transfers host arrays anew;
+        # device-resident args transfer nothing)
+        import jax
+
+        base.args = tuple(
+            jax.device_put(a) if isinstance(a, np.ndarray) and a.ndim else a
+            for a in base.args
         )
         base.gp = base.args[0].shape[0]
         cp = base.args[5].shape[0]

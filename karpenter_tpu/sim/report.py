@@ -52,6 +52,40 @@ def _consolidation_section(registry) -> dict:
     }
 
 
+def _resident_section(registry) -> dict:
+    """Resident-tensor warm-path accounting: hit/rebuild counts (summed
+    over the provisioner and disruption consumers) and the scatter-delta
+    row distribution of the warm ticks."""
+    hist = registry.histograms.get(
+        "karpenter_solver_resident_delta_rows", {}
+    ).get(())
+    return {
+        "hits": int(
+            sum(
+                _counter_family(
+                    registry, "karpenter_solver_resident_hits_total"
+                ).values()
+            )
+        ),
+        "rebuilds": int(
+            sum(
+                _counter_family(
+                    registry, "karpenter_solver_resident_rebuilds_total"
+                ).values()
+            )
+        ),
+        "delta_rows": {
+            "ticks": hist.count if hist is not None else 0,
+            # quantile, not percentile(histogram(...)): the latter
+            # degrades to the last-window tail past 1024 solves
+            "p50": registry.quantile(
+                "karpenter_solver_resident_delta_rows", 0.5
+            ),
+            "max": hist.vmax if hist is not None else 0.0,
+        },
+    }
+
+
 def build_report(runner) -> dict:
     env = runner.env
     registry = env.registry
@@ -132,6 +166,11 @@ def build_report(runner) -> dict:
         },
         "solver": {
             "paths": dict(sorted(paths.items())),
+            # device-resident tensor layer (ops/resident.py): warm-tick
+            # hits vs full-tensorize rebuilds, plus the scatter-delta
+            # size distribution — deterministic for equal seeds, so a
+            # replay reproduces the section byte-for-byte
+            "resident": _resident_section(registry),
             # deterministic in a sim run: the id/epoch fingerprints hit
             # and miss on the same reconciles for equal seeds
             "compile_cache": {

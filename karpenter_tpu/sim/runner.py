@@ -560,6 +560,42 @@ def _diurnal_interruption(ticks: int) -> Scenario:
 
 
 @scenario(
+    "resident-churn",
+    "steady pod churn + node add/remove + one mid-run catalog roll: the "
+    "device-resident delta path's acceptance scenario — warm ticks must "
+    "apply as scatter deltas (solver.resident hits), the roll must force "
+    "exactly the rebuild fallback, and record/replay must stay "
+    "byte-identical with the resident path on",
+)
+def _resident_churn(ticks: int) -> Scenario:
+    mid = max(5, ticks // 2)
+    return Scenario(
+        "resident-churn",
+        workloads=[
+            # enough arrivals that most ticks carry a pod delta, enough
+            # deletions that classes empty out and compact, and enough
+            # out-of-band kills that live-node columns come and go
+            Steady(rate=0.9),
+            Churn(rate=0.35),
+            InstanceKiller(rate=0.06),
+            Script(
+                {
+                    mid: [
+                        # catalog roll: the image provider invalidates,
+                        # the instance-type lists are new objects, and
+                        # the resident catalog key misses — the one
+                        # sanctioned full-tensorize fallback mid-run
+                        ("image_roll", {"id": "image-standard-amd64-v2",
+                                        "family": "standard",
+                                        "arch": "amd64"}),
+                    ],
+                }
+            ),
+        ],
+    )
+
+
+@scenario(
     "slo-burn",
     "a short blackout opens circuit breakers: deterministic SLO "
     "burn-rate breach, then recovery — the diagnosis layer's acceptance "

@@ -19,7 +19,7 @@ import bench
 REQUIRED_FIELDS = {"metric", "value", "unit", "vs_baseline", "path", "kernel", "nodes"}
 PHASE_NAMES = {
     "partition", "compile", "pad", "dispatch", "device_block",
-    "oracle", "decode", "other", "harness",
+    "oracle", "decode", "other", "harness", "delta",
 }
 
 
@@ -62,6 +62,34 @@ class TestBenchSmoke:
 
     def test_flagship_prints_last(self, bench_lines):
         assert bench_lines[-1]["metric"] == "schedule_10k_pods_500_types_p50"
+
+    def test_scheduler_lines_carry_cold_and_warm(self, bench_lines):
+        """Every solve-style line reports the cold (first solve: full
+        tensorize + upload) vs resident-warm split, so the resident win
+        is visible in the artifact and --compare can gate warm_ms."""
+        for line in bench_lines:
+            if not line["metric"].startswith(
+                ("schedule_", "consolidation_")
+            ):
+                continue
+            assert line.get("cold_ms", 0) > 0, line["metric"]
+            assert line.get("warm_ms", 0) > 0, line["metric"]
+            # warm == the reported p50 by construction
+            assert line["warm_ms"] == pytest.approx(line["value"])
+
+    def test_resident_100k_line(self, bench_lines):
+        """The sharded-resident scale line: served from the resident
+        buffers (hits > 0) with the one cold rebuild."""
+        line = next(
+            l
+            for l in bench_lines
+            if l["metric"] == "schedule_100k_pods_1k_nodes_resident_p50"
+        )
+        assert line["path"] == "tensor"
+        assert line["resident_hits"] > 0
+        assert line["resident_rebuilds"] >= 1
+        # the warm tick skips pad/upload entirely: no pad phase
+        assert "pad" not in line["phases"], line["phases"]
 
     def test_consolidation_sweep_line(self, bench_lines):
         """The batched-vs-sequential sweep line carries both measurements
@@ -121,7 +149,17 @@ class TestCompare:
         prior = tmp_path / "prior.jsonl"
         prior.write_text(
             "\n".join(
-                json.dumps({**l, "value": l["value"] * 100.0})
+                json.dumps(
+                    {
+                        **l,
+                        "value": l["value"] * 100.0,
+                        **(
+                            {"warm_ms": l["warm_ms"] * 100.0}
+                            if "warm_ms" in l
+                            else {}
+                        ),
+                    }
+                )
                 for l in bench_lines
             )
             + "\n"
@@ -182,3 +220,50 @@ class TestMarginalEstimate:
         with pytest.raises(ValueError, match="negative device_ms"):
             bench._emit("m", 10.0, "tensor", "scan", 1, device_ms=-1.4)
         assert capsys.readouterr().out == ""
+
+    def test_emit_refuses_negative_zero_device_ms(self, capsys):
+        """The site the original clamp missed: a tiny negative estimate
+        rounds to -0.0, which compares == 0 and slipped the `v < 0`
+        guard — it must refuse like any other negative."""
+        with pytest.raises(ValueError, match="negative device_ms"):
+            bench._emit(
+                "m", 10.0, "tensor", "scan", 1, device_ms=round(-0.004, 2)
+            )
+        with pytest.raises(ValueError, match="negative device_ms"):
+            bench._emit(
+                "m", 10.0, "tensor", "scan", 1,
+                device_ms=0.5, device_ms_floor=-0.0,
+            )
+        assert capsys.readouterr().out == ""
+
+    def test_compare_flags_malformed_prior_without_gating(self):
+        """The r05 regression pinned on the ingest side: a prior artifact
+        carrying device_ms -1.4 is flagged malformed in the verdict and
+        the rendered table, but does NOT fail the comparison (history is
+        immutable; BENCH_r05.json must stay comparable)."""
+        old = [{"metric": "pallas_p50", "value": 100.0, "device_ms": -1.4}]
+        new = [{"metric": "pallas_p50", "value": 90.0, "device_ms": 0.7}]
+        verdict = bench.compare_verdict(new, old)
+        assert verdict["ok"] is True
+        assert verdict["malformed"] == {"new": [], "prior": ["pallas_p50"]}
+        text = "\n".join(bench.render_verdict(verdict))
+        assert "MALFORMED prior line" in text
+
+    def test_compare_flags_malformed_new_lines(self):
+        new = [{"metric": "x_p50", "value": 10.0, "device_ms": -0.0}]
+        verdict = bench.compare_verdict(new, [])
+        assert verdict["malformed"]["new"] == ["x_p50"]
+
+    def test_warm_regression_gates_like_p50(self):
+        """A line whose p50 held steady but whose resident-warm solve
+        regressed past the threshold fails the comparison; warm fields
+        absent on either side never gate (pre-resident baselines)."""
+        old = [{"metric": "a_p50", "value": 100.0, "warm_ms": 10.0},
+               {"metric": "b_p50", "value": 100.0}]
+        new = [{"metric": "a_p50", "value": 101.0, "warm_ms": 14.0},
+               {"metric": "b_p50", "value": 101.0, "warm_ms": 50.0}]
+        verdict = bench.compare_verdict(new, old)
+        assert verdict["regressed"] == ["a_p50"]
+        by = {l["metric"]: l for l in verdict["lines"]}
+        assert by["a_p50"]["warm_delta_pct"] == pytest.approx(40.0)
+        assert "warm_delta_pct" not in by["b_p50"]

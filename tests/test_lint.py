@@ -22,6 +22,12 @@ baked into the image, so this enforces the checks that catch real rot:
    `EventLedger.emit(...)` appears in docs/designs/observability.md —
    the same teeth for the decision-event taxonomy: SLOBreach,
    AnomalyDetected, and whatever comes next cannot ship undocumented.
+7. no full-tensorize call (`compile_problem(...)` or
+   `*._compile_tensor(...)`) outside the sanctioned cold-build and
+   rebuild-fallback sites — the warm path's contract is that cluster
+   deltas apply to the device-resident tensors (ops/resident.py) as
+   scatter updates; a new call site re-tensorizing per tick silently
+   reverts the resident win and must be consciously allowlisted.
 """
 
 import ast
@@ -368,6 +374,130 @@ def test_event_doc_lint_has_teeth():
     hits = event_doc_offenders(src, "karpenter_tpu/x.py", documented)
     assert len(hits) == 2, hits
     assert "RogueEvent" in hits[0] and "AnotherRogue" in hits[1], hits
+
+
+# rule 7: the sanctioned full-tensorize call sites.  The warm path's
+# contract is that cluster deltas reach the device tensors as scatter
+# updates (ops/resident.py); a full `compile_problem` / `_compile_tensor`
+# belongs only at the cold build and the rebuild fallbacks the delta
+# planner deliberately takes (catalog roll, shape change, churn past the
+# midpoint).  Any NEW call site — especially in controllers/ or a solver
+# warm path — must be consciously added here.
+_FULL_TENSORIZE_ALLOWLIST = {
+    # the wrapper itself: catalog bookkeeping + the one compile_problem
+    ("karpenter_tpu/scheduling/solver.py",
+     "TensorScheduler._compile_tensor"),
+    # cold build / resident-miss rebuild in the solve path
+    ("karpenter_tpu/scheduling/solver.py", "TensorScheduler._solve"),
+    # direct compile+pack+decode kept for tests and custom callers
+    ("karpenter_tpu/scheduling/solver.py",
+     "TensorScheduler._solve_tensor"),
+    # consolidation base: rebuild fallback when the resident layer misses
+    ("karpenter_tpu/scheduling/solver.py",
+     "TensorScheduler._build_removal_base"),
+}
+
+_FULL_TENSORIZE_NAMES = frozenset({"compile_problem", "_compile_tensor"})
+
+
+def full_tensorize_offenders(source: str, rel: str, allowlist):
+    """AST scan for full-tensorize calls: `compile_problem(...)` (bare or
+    attribute) and `<anything>._compile_tensor(...)`.  Every call site
+    must be allowlisted by (file, qualified name); hits lexically inside
+    a for/while loop — the per-candidate re-tensorize antipattern — are
+    called out."""
+    tree = ast.parse(source)
+    offenders = []
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self):
+            self.scope = []
+            self.loops = 0
+
+        def _scoped(self, node, push):
+            self.scope.append(push)
+            self.generic_visit(node)
+            self.scope.pop()
+
+        def visit_ClassDef(self, node):
+            self._scoped(node, node.name)
+
+        def visit_FunctionDef(self, node):
+            self._scoped(node, node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def _loop(self, node):
+            self.loops += 1
+            self.generic_visit(node)
+            self.loops -= 1
+
+        visit_For = visit_While = visit_AsyncFor = _loop
+
+        def visit_Call(self, node):
+            f = node.func
+            name = (
+                f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute)
+                else None
+            )
+            if name in _FULL_TENSORIZE_NAMES:
+                qual = ".".join(self.scope)
+                if (rel, qual) not in allowlist:
+                    where = "INSIDE A LOOP" if self.loops else "call"
+                    offenders.append(
+                        f"{rel}:{node.lineno}: {qual or '<module>'}: "
+                        f"{name}(...) [{where}]"
+                    )
+            self.generic_visit(node)
+
+    Visitor().visit(tree)
+    return offenders
+
+
+def test_no_full_tensorize_outside_sanctioned_sites():
+    """Resident-tensor guard: a full tensorize in controllers/ or
+    scheduling/ only at the sanctioned cold-build/rebuild-fallback sites
+    — warm ticks must flow through the delta path (ops/resident.py,
+    docs/designs/resident-tensors.md)."""
+    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
+    offenders = []
+    for sub in ("controllers", "scheduling"):
+        for path in sorted((pkg_root / sub).glob("*.py")):
+            rel = path.relative_to(pkg_root.parent).as_posix()
+            offenders += full_tensorize_offenders(
+                path.read_text(), rel, _FULL_TENSORIZE_ALLOWLIST
+            )
+    assert not offenders, (
+        "unsanctioned full-tensorize call (route warm updates through "
+        "the resident delta path, or consciously allowlist a "
+        "cold-build/rebuild site):\n" + "\n".join(offenders)
+    )
+
+
+def test_full_tensorize_lint_has_teeth():
+    """The checker fires on bare and attribute call forms (tagging
+    in-loop hits), and stays quiet on allowlisted sites."""
+    bad = (
+        "class S:\n"
+        "    def warm(self, pods):\n"
+        "        for batch in pods:\n"
+        "            p = self._compile_tensor(batch, [])\n"
+        "    def cold(self, pods):\n"
+        "        return compile_problem(pods, [], {})\n"
+    )
+    hits = full_tensorize_offenders(
+        bad, "karpenter_tpu/scheduling/x.py", _FULL_TENSORIZE_ALLOWLIST
+    )
+    assert len(hits) == 2, hits
+    assert "INSIDE A LOOP" in hits[0] and "S.warm" in hits[0], hits
+    assert "S.cold" in hits[1], hits
+    ok = full_tensorize_offenders(
+        bad, "karpenter_tpu/scheduling/x.py",
+        {("karpenter_tpu/scheduling/x.py", "S.warm"),
+         ("karpenter_tpu/scheduling/x.py", "S.cold")},
+    )
+    assert not ok, ok
 
 
 def test_scheduler_update_lint_has_teeth():

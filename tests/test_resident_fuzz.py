@@ -1,0 +1,440 @@
+"""Delta-correctness fuzz for the device-resident cluster tensors.
+
+The resident layer (ops/resident.py) may change HOW the solve tensors are
+built — scatter deltas on donated device buffers instead of a per-tick
+re-tensorize — but never WHAT they contain.  This suite drives seeded
+random mutation streams (pod arrive / delete / in-place mutate, node
+add / remove / label flip / usage change, catalog roll) through the real
+`TensorScheduler.solve` path and, after EVERY step, asserts the resident
+state's tensors are bit-equal to a from-scratch `compile_problem` over
+the same cluster — on the single-device backend AND the mesh-sharded one
+(conftest pins an 8-device virtual CPU platform).
+
+Three layers of equality per step:
+  1. snapshot vs scratch: the `CompiledProblem` the solver consumed is
+     bit-identical (every tensor, the class membership, the config list)
+     to a fresh compile by an independent scheduler;
+  2. device vs host: the device buffers mirror the host mirrors exactly
+     (the donated jit replayed every host edit faithfully);
+  3. pad hygiene: the padded regions still hold canonical pad values
+     (price inf, feasibility False, cfg -1) — a scratch-slot leak would
+     poison some LATER delta's gather, not this step's, so it must be
+     pinned directly.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import PodAffinityTerm
+from karpenter_tpu.ops.tensorize import partition_groups
+from karpenter_tpu.parallel.mesh import make_mesh, mesh_pack_fn
+from karpenter_tpu.scheduling.solver import TensorScheduler
+from karpenter_tpu.state.cluster import StateNode
+from karpenter_tpu.state.kube import Node
+from karpenter_tpu.testing import Environment
+
+ZONES = ("zone-a", "zone-b", "zone-c")
+
+# plain resident-expressible pod shapes: distinct requests -> distinct
+# classes, so arrivals/mutations move class boundaries around
+SIZES = (
+    Resources(cpu=0.25, memory="256Mi"),
+    Resources(cpu=0.5, memory="512Mi"),
+    Resources(cpu=1, memory="1Gi"),
+    Resources(cpu=2, memory="2Gi"),
+    Resources(cpu=2, memory="8Gi"),
+    Resources(cpu=4, memory="4Gi"),
+)
+
+TENSORS = (
+    "req", "cnt", "maxper", "slot", "feas", "alloc", "price",
+    "openable", "used0", "cfg0", "npods0",
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    env = Environment()
+    pool = env.default_node_pool()
+    nc = env.default_node_class()
+    # a trimmed inventory keeps the catalog (and every jit bucket) small
+    # enough that the per-step scratch compiles dominate the runtime, not
+    # XLA compilation of one-off shapes
+    types = env.instance_types.list(pool, nc)[:24]
+    return pool, types
+
+
+class _Fuzz:
+    """One seeded mutation stream over one long-lived scheduler."""
+
+    def __init__(self, pool, types, seed: int, pack_fn=None):
+        self.pool = pool
+        self.types = list(types)
+        self.inventory = {pool.name: self.types}
+        self.rng = random.Random(seed)
+        self.pods: list = []
+        self.live: list = []
+        self.n_node = 0
+        kw = {} if pack_fn is None else {"pack_fn": pack_fn}
+        self.ts = TensorScheduler([pool], self.inventory, **kw)
+        self.checked = 0
+        self.skipped = 0
+
+    # ------------------------------------------------------------- ops
+    def _new_pod(self) -> Pod:
+        p = Pod(requests=self.rng.choice(SIZES))
+        if self.rng.random() < 0.25:
+            # a single-term selector: a different constraint signature
+            # (and feasibility row) without leaving the plain shape
+            p.node_selector = {L.LABEL_ZONE: self.rng.choice(ZONES)}
+        return p
+
+    def op_arrive(self):
+        for _ in range(self.rng.randint(1, 5)):
+            self.pods.append(self._new_pod())
+
+    def op_delete(self):
+        for _ in range(min(self.rng.randint(1, 3), len(self.pods))):
+            self.pods.pop(self.rng.randrange(len(self.pods)))
+
+    def op_mutate_pod(self):
+        if not self.pods:
+            return
+        p = self.rng.choice(self.pods)
+        # in-place requests change: bumps _mut, moves p to another class
+        p.requests = self.rng.choice(SIZES)
+
+    def op_add_node(self):
+        self.n_node += 1
+        self.live.append(
+            StateNode(
+                name=f"fz-{self.n_node}",
+                provider_id=f"fake://fz-{self.n_node}",
+                labels={
+                    L.LABEL_ZONE: self.rng.choice(ZONES),
+                    L.LABEL_NODEPOOL: self.pool.name,
+                },
+                taints=[],
+                allocatable=Resources(cpu=16, memory="64Gi", pods=110),
+                pods=[],
+                used=Resources(),
+            )
+        )
+
+    def op_remove_node(self):
+        if self.live:
+            self.live.pop(self.rng.randrange(len(self.live)))
+
+    def op_mutate_node_labels(self):
+        if not self.live:
+            return
+        sn = self.rng.choice(self.live)
+        # flip the zone label in place: the scheduling fingerprint
+        # changes, so the node's feasibility column must re-scatter
+        sn.labels[L.LABEL_ZONE] = self.rng.choice(ZONES)
+
+    def op_mutate_node_usage(self):
+        if not self.live:
+            return
+        sn = self.rng.choice(self.live)
+        if sn.pods and self.rng.random() < 0.4:
+            bp = sn.pods.pop()
+            sn.used = (sn.used - bp.requests).clamp_nonnegative()
+        else:
+            bp = Pod(requests=self.rng.choice(SIZES))
+            sn.pods.append(bp)
+            sn.used = sn.used + bp.requests
+
+    def op_catalog_roll(self):
+        # new instance-type list object == a provider refresh: every
+        # identity-keyed cache (catalog, compile cache, resident state)
+        # must miss and rebuild
+        self.types = list(self.types)
+        self.inventory = {self.pool.name: self.types}
+
+    OPS = (
+        ("arrive", 5),
+        ("delete", 2),
+        ("mutate_pod", 2),
+        ("add_node", 3),
+        ("remove_node", 1),
+        ("mutate_node_labels", 1),
+        ("mutate_node_usage", 2),
+    )
+
+    def step(self, roll: bool = False):
+        if roll:
+            self.op_catalog_roll()
+        else:
+            names = [n for n, w in self.OPS for _ in range(w)]
+            for _ in range(self.rng.randint(1, 3)):
+                getattr(self, f"op_{self.rng.choice(names)}")()
+        # keep the batch inside one padded-bucket neighborhood so the
+        # run exercises deltas, not only bucket-overflow rebuilds
+        while len(self.pods) > 48:
+            self.pods.pop(self.rng.randrange(len(self.pods)))
+        if not self.pods:
+            self.op_arrive()
+        self.ts.update(
+            [self.pool], self.inventory, existing=list(self.live)
+        )
+        self.ts.solve(list(self.pods))
+        self.check()
+
+    # ---------------------------------------------------------- checks
+    def _state(self):
+        """The state that served THIS solve: refresh moves the absorbing
+        state to the MRU slot and rebuild appends, so it is always the
+        last one (a stale LRU sibling may coincidentally hold the same
+        pod-id set — never trust membership alone)."""
+        if not self.ts.last_resident:
+            return None
+        st = self.ts._resident.states[-1]
+        assert set(st.pod_entry) == set(map(id, self.pods))
+        return st
+
+    def _scratch(self):
+        ref = TensorScheduler([self.pool], dict(self.inventory))
+        ref.update(
+            [self.pool], self.inventory, existing=list(self.live)
+        )
+        sup, unsup, _why = partition_groups(
+            self.pods, existing=ref.existing, pools=ref.pools
+        )
+        assert not unsup, "fuzz batches must stay resident-expressible"
+        return ref._compile_tensor(
+            [p for _, members in sup for p in members], sup
+        )
+
+    def check(self):
+        st = self._state()
+        if st is None:
+            # a compile-cache hit can re-serve a prior snapshot whose
+            # state has since moved on (`match` finds nothing): the
+            # tensors it served were scratch-checked when stored — count
+            # it, the stream-level floor below keeps it rare
+            self.skipped += 1
+            return
+        self.checked += 1
+        ref = self._scratch()
+        prob = st.problem()
+        # 1) snapshot vs scratch: every tensor bit-equal
+        assert prob.axes == ref.axes
+        for name in TENSORS:
+            np.testing.assert_array_equal(
+                getattr(prob, name), getattr(ref, name), err_msg=name
+            )
+        # identical class membership (same pod OBJECTS, same order)
+        assert [
+            sorted(map(id, cm.pods)) for cm in prob.classes
+        ] == [sorted(map(id, cm.pods)) for cm in ref.classes]
+        # identical config identity row-for-row
+        assert [
+            (c.zone, c.capacity_type, c.price,
+             c.existing.name if c.existing is not None else None)
+            for c in prob.configs
+        ] == [
+            (c.zone, c.capacity_type, c.price,
+             c.existing.name if c.existing is not None else None)
+            for c in ref.configs
+        ]
+        # 2) device buffers mirror the host mirrors exactly
+        for d, h in (
+            (st.d_req, st.h_req), (st.d_cnt, st.h_cnt),
+            (st.d_feas, st.h_feas), (st.d_alloc, st.h_alloc),
+            (st.d_price, st.h_price), (st.d_used0, st.h_used0),
+            (st.d_npods0, st.h_npods0),
+        ):
+            np.testing.assert_array_equal(np.asarray(d), h)
+        E = len(st.live)
+        cfg0 = np.asarray(st.d_cfg0)
+        assert (cfg0[:E] == np.arange(st.fe, st.fe + E)).all()
+        assert (cfg0[E:] == -1).all()
+        # 3) pad hygiene: the scratch slots and padded tails still hold
+        # canonical pad values (a leak would poison a LATER gather)
+        G, C = len(st.cls), st.fe + E
+        assert not st.h_feas[G:, :].any() and not st.h_feas[:, C:].any()
+        assert (st.h_req[G:] == 0).all() and (st.h_cnt[G:] == 0).all()
+        assert np.isinf(st.h_price[C:]).all()
+        assert (st.h_alloc[C:] == 0).all()
+        assert (st.h_used0[E:] == 0).all() and (st.h_npods0[E:] == 0).all()
+
+
+def _run(pool, types, seed, pack_fn=None, steps=25, rolls=(9, 17)):
+    fz = _Fuzz(pool, types, seed, pack_fn=pack_fn)
+    for i in range(steps):
+        fz.step(roll=i in rolls)
+    ts = fz.ts
+    # the stream must have actually exercised the warm path AND the
+    # rebuild fallback (cold start + the catalog rolls at minimum)
+    assert fz.checked >= steps - max(2, steps // 8), (
+        fz.checked, fz.skipped,
+    )
+    assert ts.resident_rebuilds >= 1 + len(rolls), (
+        ts.resident_hits, ts.resident_rebuilds,
+    )
+    assert ts.resident_hits > ts.resident_rebuilds, (
+        ts.resident_hits, ts.resident_rebuilds,
+    )
+    return ts
+
+
+def _fresh_solve(pool, inventory, existing, pods):
+    ref = TensorScheduler([pool], dict(inventory))
+    ref.update([pool], inventory, existing=list(existing))
+    return ref.solve(list(pods))
+
+
+def _placement_key(result):
+    return (
+        sorted(
+            (len(n.pods), n.feasible_types[0].name) for n in result.new_nodes
+        ),
+        sorted(result.unschedulable),
+    )
+
+
+def _carrier_node(pool, name="anti-1"):
+    """A CORDONED node whose bound pod carries an everything-matching
+    anti-affinity term: invisible to the live filter, but partition_
+    groups still repels every batch class it selects."""
+    carrier = Pod(
+        labels={"app": "repel"},
+        requests=Resources(cpu=0.5),
+        pod_affinity=[
+            PodAffinityTerm(
+                topology_key=L.LABEL_ZONE, label_selector=(), anti=True
+            )
+        ],
+    )
+    return StateNode(
+        name=name,
+        provider_id=f"fake://{name}",
+        labels={L.LABEL_ZONE: ZONES[0], L.LABEL_NODEPOOL: pool.name},
+        taints=[],
+        allocatable=Resources(cpu=16, memory="64Gi", pods=110),
+        pods=[carrier],
+        used=Resources(cpu=0.5),
+        node=Node(name=name, cordoned=True),
+    )
+
+
+class TestResidentCoherence:
+    """Pinned regressions for state the resident layer shares with the
+    solver's compile cache and for non-live carriers the live filter
+    hides."""
+
+    def _ts(self, pool, types, existing=()):
+        inventory = {pool.name: list(types)}
+        ts = TensorScheduler([pool], inventory)
+        ts.update([pool], inventory, existing=list(existing))
+        return ts, inventory
+
+    def test_cache_hit_after_delta_decodes_original_membership(self, setup):
+        """Reverting to an earlier batch re-serves the earlier cache
+        entry, which must be UNCHANGED: tick 2's delta may not mutate
+        the ClassMeta objects tick 1's cached problem shares, or the
+        cached cnt desyncs from its membership and decode conjures a
+        pod that is not in the batch."""
+        pool, types = setup
+        ts, _ = self._ts(pool, types)
+        a = Pod(requests=SIZES[2])
+        b = Pod(requests=SIZES[2])  # same class as a
+        ts.solve([a])
+        assert ts.last_path == "tensor"
+        ts.solve([a, b])
+        assert ts.last_resident  # the arrival rode the delta path
+        res = ts.solve([a])  # revert: same object+epoch -> cache hit
+        assert ts.compile_cache_hits >= 1
+        assert not res.unschedulable  # no phantom 'b' from the entry
+        assert [id(p) for n in res.new_nodes for p in n.pods] == [id(a)]
+
+    def test_equal_count_swap_refreshes_snapshot(self, setup):
+        """b -> c inside one class keeps every tensor bit-identical
+        (zero delta rows) but decode must assign the CURRENT pod
+        objects — the snapshot refreshes on membership change alone."""
+        pool, types = setup
+        ts, _ = self._ts(pool, types)
+        a, b, c = (Pod(requests=SIZES[1]) for _ in range(3))
+        ts.solve([a, b])
+        res = ts.solve([a, c])
+        assert ts.last_resident
+        assert not res.unschedulable
+        assert {id(p) for n in res.new_nodes for p in n.pods} == {
+            id(a), id(c),
+        }
+
+    def test_cordoned_carrier_blocks_resident_seed(self, setup):
+        """With an anti-affinity carrier on a cordoned node, the batch is
+        oracle-routed (symmetric repel) and the resident layer must stay
+        out entirely."""
+        pool, types = setup
+        ts, inventory = self._ts(pool, types, existing=[_carrier_node(pool)])
+        pods = [Pod(requests=SIZES[0]) for _ in range(6)]
+        res1 = ts.solve(list(pods))
+        res2 = ts.solve(list(pods))
+        assert ts.resident_hits == 0 and not ts.last_resident
+        ref = _fresh_solve(pool, inventory, [_carrier_node(pool)], pods)
+        assert _placement_key(res1) == _placement_key(ref)
+        assert _placement_key(res2) == _placement_key(ref)
+
+    def test_carrier_arrival_falls_back_to_full_compile(self, setup):
+        """A carrier appearing on a NON-live node mid-stream (cordon of a
+        carrier node, here: a cordoned arrival) must force the warm path
+        back to the full compile — partition_groups repels batch pods
+        the delta planner has no way to see."""
+        pool, types = setup
+        ts, inventory = self._ts(pool, types)
+        pods = [Pod(requests=SIZES[0]) for _ in range(6)]
+        ts.solve(list(pods))
+        pods.append(Pod(requests=SIZES[0]))
+        ts.solve(list(pods))
+        assert ts.last_resident  # warm path proven before the carrier
+        cordoned = _carrier_node(pool)
+        ts.update([pool], inventory, existing=[cordoned])
+        pods.append(Pod(requests=SIZES[0]))
+        res = ts.solve(list(pods))
+        assert not ts.last_resident
+        ref = _fresh_solve(pool, inventory, [cordoned], pods)
+        assert _placement_key(res) == _placement_key(ref)
+
+
+class TestResidentFuzz:
+    @pytest.mark.parametrize("seed", [7, 23])
+    def test_single_device_stream(self, setup, seed):
+        pool, types = setup
+        _run(pool, types, seed)
+
+    def test_mesh_stream(self, setup):
+        """The same contract with the buffers sharded over the 8-device
+        mesh (class/config axes on "model", node slots on "data") — the
+        resident path is the same code single-device and multi-chip."""
+        pool, types = setup
+        _run(
+            pool, types, 101,
+            pack_fn=mesh_pack_fn(make_mesh(8)), steps=16, rolls=(7,),
+        )
+
+    def test_decode_parity_with_scratch_solver(self, setup):
+        """End-to-end: a churned resident scheduler and a fresh scheduler
+        solving the same cluster must place identically (node counts and
+        per-node pod-count/type multisets)."""
+        pool, types = setup
+        fz = _Fuzz(pool, types, 77)
+        for i in range(10):
+            fz.step(roll=(i == 5))
+        res = fz.ts.solve(list(fz.pods))
+        fresh = TensorScheduler([pool], fz.inventory)
+        fresh.update([pool], fz.inventory, existing=list(fz.live))
+        ref = fresh.solve(list(fz.pods))
+        assert fz.ts.last_resident
+        assert len(res.new_nodes) == len(ref.new_nodes)
+        key = lambda n: (len(n.pods), n.feasible_types[0].name)
+        assert sorted(map(key, res.new_nodes)) == sorted(
+            map(key, ref.new_nodes)
+        )
+        assert res.unschedulable == ref.unschedulable
