@@ -21,6 +21,11 @@ Configs (BASELINE.md "Benchmark configs to reproduce"):
    verdict dispatch, ``TensorScheduler.evaluate_removals``) measured
    against the sequential per-candidate path on the same snapshot; the
    line carries ``sequential_ms`` and ``speedup_vs_sequential``.
+4c. consolidation search: the multi-node population search — one pass
+   proposes 500+ removal-mask subsets and scores each round in ONE
+   vmapped dispatch (``TensorScheduler.evaluate_population``), vs the
+   sequential descent scoring the SAME subsets; carries ``rounds`` /
+   ``population`` / ``sequential_ms`` / ``speedup_vs_sequential``.
 5. multi-pool weighted priority + spot price-aware selection.
 6. (extra) hybrid split cost: 9.5k tensor pods + 500 oracle-only pods
    (LIVE-MEMBER co-location: groups that must JOIN nodes their members
@@ -847,6 +852,104 @@ def run_consolidation_sweep() -> None:
 
 
 # ---------------------------------------------------------------------------
+# config 4c: the multi-node population search — one pass scores 500+
+# candidate subsets in `search_rounds` vmapped dispatches
+# ---------------------------------------------------------------------------
+
+
+def run_consolidation_search() -> None:
+    """The population-annealing multi-node search
+    (docs/designs/consolidation-search.md): one pass proposes hundreds
+    of removal masks (structured seeds + seeded random + annealed
+    mutations) and scores each round in ONE vmapped device dispatch
+    (`TensorScheduler.evaluate_population`), measured against the
+    SEQUENTIAL per-subset descent scoring the SAME subsets through
+    `DisruptionController._simulate` — identical coverage, so the
+    speedup is the search-promotion win and nothing else.  The line
+    carries ``rounds``/``population`` (subsets actually scored) next to
+    ``sequential_ms``/``speedup_vs_sequential``."""
+    from karpenter_tpu.api import Disruption, Pod, Resources
+    from karpenter_tpu.cloud.fake.backend import generate_catalog
+    from karpenter_tpu.controllers.disruption import _RemovalEvaluator
+    from karpenter_tpu.testing import Environment
+
+    # small shapes so ~60 nodes come up — the same fleet as the sweep
+    # line, but searched over ALL multi-node subsets, not scanned singly
+    shapes = generate_catalog(generations=(1, 2), cpus=(4, 8))
+    env = Environment(shapes=shapes)
+    env.default_node_class()
+    env.default_node_pool(
+        disruption=Disruption(consolidation_policy="WhenUnderutilized")
+    )
+    sizes = [
+        Resources(cpu=0.5, memory="1Gi"),
+        Resources(cpu=1, memory="2Gi"),
+    ]
+    pods = [Pod(requests=sizes[i % len(sizes)]) for i in range(_n(560))]
+    for p in pods:
+        env.kube.put_pod(p)
+    env.settle(max_rounds=80)
+    assert not env.kube.pending_pods(), len(env.kube.pending_pods())
+
+    dc = env.operator.disruption
+    dc._budgets = dc._remaining_budgets()
+    candidates = sorted(
+        (c for c in dc._candidates() if dc._consolidatable(c)),
+        key=lambda c: c.disruption_cost(),
+    )
+    n_cands = len(candidates)
+    inv = dc._pool_inventory()
+    sched = dc._scheduler
+    # sized so a full-scale pass scores 500+ distinct subsets (the
+    # acceptance floor); tiny universes cap at their own subset count
+    dc.search_rounds = 2
+    dc.search_population = max(320, _n(320))
+    stats = {"population": 0, "rounds": 0}
+
+    def population_pass():
+        # pin the pass seed: every timed iteration AND the sequential
+        # side below score the IDENTICAL mask schedule, so the reported
+        # speedup compares the same workload — not cross-seed noise
+        dc._search_seq = 0
+        ev = _RemovalEvaluator(dc, candidates, inv)
+        plan = dc._search_multi(candidates, ev)
+        stats["population"] = len(plan.seen)
+        stats["rounds"] = plan.round_no
+        return plan
+
+    cold_ms = _cold_run_ms(population_pass)
+    p50, noise, phases = _measure(
+        population_pass, phases_fn=lambda: sched.last_phases
+    )
+    batched_ran = sched.last_removal_batch > 0
+
+    # the sequential descent given the SAME candidate coverage: one
+    # fixed plan's masks, each through the per-subset solver round-trip.
+    # Few samples — at full scale this side is hundreds of host solves
+    # per iteration, which is exactly the point being measured.
+    seq_plan = population_pass()
+    seq_subsets = [
+        [candidates[i] for i in key] for key in sorted(seq_plan.seen)
+    ]
+
+    def sequential_pass():
+        for s in seq_subsets:
+            dc._simulate(s, inv)
+
+    seq_p50, _, _ = _measure(sequential_pass, warmup=1, iters=3)
+    _emit(
+        "consolidation_search_500_candidates_p50", p50,
+        "batched" if batched_ran else "sequential", "scan", n_cands,
+        noise_ms=noise, phases=phases,
+        cold_ms=cold_ms, warm_ms=round(p50, 2),
+        rounds=stats["rounds"],
+        population=stats["population"],
+        sequential_ms=round(seq_p50, 2),
+        speedup_vs_sequential=round(seq_p50 / p50, 2) if p50 else None,
+    )
+
+
+# ---------------------------------------------------------------------------
 
 
 def _device_ms(
@@ -1212,6 +1315,7 @@ def _run_all() -> None:
 
     run_consolidation_repack()
     run_consolidation_sweep()
+    run_consolidation_search()
 
     pools, inventory, pods = build_multipool_spot()
     _run_scheduler_config(

@@ -41,6 +41,17 @@ class Settings:
     # requeued with exponential backoff while the rest of the tick proceeds
     controller_backoff_base: float = 1.0
     controller_backoff_max: float = 300.0
+    # multi-node consolidation's population search (controllers/
+    # disruption.py + scheduling/popsearch.py): rounds of
+    # propose→score→select per pass, and the population of removal masks
+    # scored per round — one vmapped device dispatch each.  These REPLACE
+    # the deprecated MULTI_NODE_SIM_BUDGET knob (it counted batch
+    # elements, which a population round either trivially exhausts or
+    # ignores); the old constant now caps only the legacy drop-one
+    # descent (use_population_search=False), and the mapping is
+    # budget ≈ search_rounds × population_size.
+    consolidation_search_rounds: int = 2
+    consolidation_population_size: int = 128
     # SLO rule engine (obs/slo.py): per-rule overrides merged over the
     # default rule set — {"rule-name": {"threshold": ..., "budget": ...,
     # "fast_window_s": ..., "slow_window_s": ..., "enabled": ...}}; a
@@ -123,6 +134,10 @@ class Settings:
             raise ValueError(
                 "controller_backoff_max must be >= controller_backoff_base > 0"
             )
+        if self.consolidation_search_rounds < 1:
+            raise ValueError("consolidation_search_rounds must be >= 1")
+        if self.consolidation_population_size < 4:
+            raise ValueError("consolidation_population_size must be >= 4")
         if not isinstance(self.slo_rules, dict) or any(
             not isinstance(v, dict) for v in self.slo_rules.values()
         ):

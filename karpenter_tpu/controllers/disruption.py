@@ -35,7 +35,10 @@ import copy
 import logging
 import math
 from dataclasses import dataclass
+from time import perf_counter
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
 
 from karpenter_tpu.api import NodeClaim, NodePool, Pod
 from karpenter_tpu.api import labels as L
@@ -47,6 +50,7 @@ from karpenter_tpu.metrics.registry import (
     export_compile_cache_counters,
     export_resident_counters,
 )
+from karpenter_tpu.scheduling.popsearch import SearchPlan
 from karpenter_tpu.scheduling.solver import RemovalCandidate, TensorScheduler
 from karpenter_tpu.state.cluster import Cluster, StateNode
 from karpenter_tpu.state.kube import KubeStore
@@ -54,13 +58,28 @@ from karpenter_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)
 
-# how many top-ranked candidates multi-node consolidation considers per
-# pass (the reference bounds its subset search the same way)
+# how many top-ranked candidates the LEGACY drop-one descent considers
+# per pass (the population search replaces this with SEARCH_UNIVERSE_CAP)
 MULTI_NODE_CANDIDATES = 10
 
-# scheduling-simulation budget per multi-node pass: the drop-one
-# refinement evaluates at most this many candidate subsets
+# DEPRECATED alias: the pre-population sequential simulation budget.
+# Since PR 5 it counted batch ELEMENTS, which the population search would
+# either trivially exhaust (one round is a whole population) or ignore —
+# so the search is sized by Settings.consolidation_search_rounds ×
+# consolidation_population_size instead (budget ≈ rounds × population is
+# the mapping), and this constant caps only the legacy descent kept
+# behind ``use_population_search = False``.
 MULTI_NODE_SIM_BUDGET = 24
+
+# population-search defaults; Settings.consolidation_search_rounds /
+# consolidation_population_size override them through the operator
+SEARCH_ROUNDS = 2
+POPULATION_SIZE = 128
+
+# removal masks are dense over the candidate universe axis; cap it so the
+# [population, universe] tensors stay bounded (rank order means the cap
+# drops only the least-attractive candidates)
+SEARCH_UNIVERSE_CAP = 128
 
 # how long a consolidation replacement may take to register+initialize
 # before the action is rolled back (the reference's machine liveness bound
@@ -233,6 +252,72 @@ class _RemovalEvaluator:
                 by=answered,
             )
 
+    def evaluate_masks(
+        self, cands: Sequence[Candidate], keys: Sequence[tuple]
+    ) -> List[Tuple[bool, float]]:
+        """Score one population round: ``keys`` are sorted index tuples
+        into ``cands`` (a rank-order prefix of the pass's universe).  On
+        the batched path every not-yet-memoized mask is scored in ONE
+        vmapped device dispatch (`TensorScheduler.evaluate_population` —
+        counts, removed slots, and class order derived on device from the
+        mask); elements the kernel cannot answer bit-identically — and
+        everything, when ``use_batched_consolidation`` is off — resolve
+        through the sequential `result`.  The (fits, price) pairs are
+        therefore IDENTICAL whichever backend answered, which is what
+        lets the two modes take the same actions tick for tick."""
+        subsets = [[cands[i] for i in key] for key in keys]
+        dc = self.dc
+        if dc.use_batched_consolidation:
+            fresh = [
+                i
+                for i, s in enumerate(subsets)
+                if self._key(s) not in self._memo
+            ]
+            sched = dc._scheduler
+            if len(fresh) >= sched.MIN_REMOVAL_BATCH:
+                self._sync_scheduler()
+                # the base compiles over the CAPPED search universe —
+                # the same scope the controller's pre-check guarded — so
+                # the mask width, the population tensors, and the slot
+                # bound are all sized by the cap, and a constraint
+                # carrier BEYOND the cap can neither refuse the base nor
+                # widen the device work (the full-universe base remains
+                # the single scan's, via evaluate_removals)
+                universe = self._universe[: len(cands)]
+                masks = np.zeros((len(fresh), len(universe)), bool)
+                for r, i in enumerate(fresh):
+                    masks[r, list(keys[i])] = True
+                verdicts = sched.evaluate_population(masks, universe)
+                reg = dc.registry
+                if sched.last_removal_batch:
+                    reg.observe(
+                        "karpenter_consolidation_eval_batch_size",
+                        sched.last_removal_batch,
+                    )
+                    for phase_name, seconds in sched.last_phases.items():
+                        reg.observe(
+                            "karpenter_consolidation_search_phase_seconds",
+                            seconds,
+                            {"phase": phase_name},
+                        )
+                answered = 0
+                for r, i in zip(range(len(fresh)), fresh):
+                    v = verdicts[r]
+                    if v.needs_host:
+                        continue
+                    self._memo[self._key(subsets[i])] = (
+                        v.fits, v.replacement_price, None, False,
+                    )
+                    self.sims += 1
+                    answered += 1
+                if answered:
+                    reg.inc(
+                        "karpenter_consolidation_evals_total",
+                        {"path": "batched"},
+                        by=answered,
+                    )
+        return [self.result(s) for s in subsets]
+
     def result(self, subset: Sequence[Candidate]) -> Tuple[bool, float]:
         """(fits, replacement_price) for one subset — memoized; evaluates
         sequentially when the batch did not answer it."""
@@ -292,10 +377,17 @@ class _RemovalEvaluator:
 
 class DisruptionController:
     # batched what-if evaluation for consolidation (one compile + one
-    # vmapped device dispatch per candidate batch); False forces every
-    # simulation down the sequential per-subset path.  Decisions are
-    # bit-identical either way (tests/test_consolidation_batch.py).
+    # vmapped device dispatch per candidate batch / population round);
+    # False forces every simulation down the sequential per-subset path.
+    # Decisions are bit-identical either way
+    # (tests/test_consolidation_batch.py, tests/test_consolidation_search
+    # .py) — the flag switches the VERDICT backend, never the search.
     use_batched_consolidation = True
+    # population-annealing subset search over removal masks
+    # (scheduling/popsearch.py + TensorScheduler.evaluate_population);
+    # False reverts to the legacy budget-capped drop-one descent
+    # (_consolidate_multi_descent)
+    use_population_search = True
 
     def __init__(
         self,
@@ -306,6 +398,8 @@ class DisruptionController:
         clock: Clock,
         feature_gate_drift: bool = True,
         registry: Registry = REGISTRY,
+        search_rounds: int = SEARCH_ROUNDS,
+        population_size: int = POPULATION_SIZE,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -314,6 +408,13 @@ class DisruptionController:
         self.clock = clock
         self.feature_gate_drift = feature_gate_drift
         self.registry = registry
+        # population-search sizing (Settings.consolidation_search_rounds /
+        # consolidation_population_size) and the per-pass seed sequence:
+        # seeds derive from a pass COUNTER, not the clock, so twin runs
+        # and record/replay propose identical mask schedules
+        self.search_rounds = max(int(search_rounds), 1)
+        self.search_population = max(int(population_size), 4)
+        self._search_seq = 0
         self._last_non_empty: Dict[str, float] = {}  # claim -> last busy ts
         self._budgets: Dict[str, int] = {}  # per-pool allowance, per pass
         # long-lived simulation scheduler (catalog cache shared across
@@ -781,10 +882,123 @@ class DisruptionController:
         ranked: Sequence[Candidate],
         ev: Optional[_RemovalEvaluator] = None,
     ) -> bool:
-        """Bounded SUBSET search over the top cost-ranked candidates: a
-        whole candidate set whose pods fit on the remaining nodes plus at
-        most one cheaper replacement (designs/consolidation.md
-        mechanisms:5-21).
+        """Multi-node consolidation: a population-annealing SEARCH over
+        removal masks (docs/designs/consolidation-search.md).
+
+        Each pass seeds a population of candidate subsets — structured
+        masks covering everything the legacy descent could have visited
+        (singletons, prefixes, drop-ones, the full set) plus seeded
+        random diversity — and runs ``search_rounds`` rounds of
+        propose → score → select: every round's masks are scored through
+        the shared verdict kernel in ONE vmapped device dispatch
+        (`_RemovalEvaluator.evaluate_masks`), survivors breed mutated
+        children (grow / shrink / swap), and the best ACCEPTABLE subset
+        across all rounds — max savings, spot delete-only, replacement
+        strictly cheaper — wins.  A whole pass is therefore
+        ``search_rounds`` dispatches (2 by default) over hundreds of
+        subsets, instead of the old budget-capped host walk.
+
+        The search only RANKS; it never acts on its own verdicts.  Every
+        action still re-derives through the sequential oracle
+        (`_act_multi` → ``vnode_for``), with disagreements counted in
+        ``karpenter_consolidation_verdict_mismatch_total`` — and the
+        proposal/selection schedule is a pure function of (pass seed,
+        universe, verdicts), so forcing ``use_batched_consolidation``
+        off changes which backend scores the masks, never which masks
+        are proposed or which action is taken."""
+        if ev is None:
+            ev = _RemovalEvaluator(self, list(ranked), self._pool_inventory())
+        if not self.use_population_search:
+            return self._consolidate_multi_descent(ranked, ev)
+        cands = list(ranked[:SEARCH_UNIVERSE_CAP])
+        if len(cands) < 2:
+            return False
+        # the population-vs-descent choice must be HOST-decidable and
+        # identical whichever verdict backend is active (the twin-run
+        # contract): constraint shapes the mask encoding cannot replay
+        # send the pass to the legacy descent up front, instead of
+        # proposing a population the base would refuse and grinding
+        # every mask through the sequential fallback
+        if TensorScheduler.removal_search_guard(
+            ev._universe[: len(cands)],
+            self._remaining_snapshot(frozenset()),
+        ):
+            return self._consolidate_multi_descent(ranked, ev)
+        plan = self._search_multi(cands, ev)
+        reg = self.registry
+        best = plan.best()
+        if best is None:
+            reg.inc(
+                "karpenter_consolidation_search_winners_total",
+                {"action": "none"},
+            )
+            return False
+        subset = [cands[i] for i in best.indices]
+        acted = self._act_multi(subset, best.price, ev)
+        action = "none"
+        if acted:
+            action = "replace" if best.price > 0.0 else "delete"
+        reg.inc(
+            "karpenter_consolidation_search_winners_total",
+            {"action": action},
+        )
+        return acted
+
+    def _search_multi(
+        self, cands: List[Candidate], ev: _RemovalEvaluator
+    ) -> SearchPlan:
+        """The pure SEARCH half of a multi-node pass (no action taken):
+        seed a plan, run propose → score → select rounds, record the
+        search metrics, return the plan holding every verdict.  Split
+        from `_consolidate_multi` so bench.py can measure the search
+        without mutating the cluster."""
+        self._search_seq += 1
+        plan = SearchPlan(
+            n=len(cands),
+            prices=[c.price for c in cands],
+            spot=[
+                c.claim.capacity_type == L.CAPACITY_TYPE_SPOT for c in cands
+            ],
+            population=self.search_population,
+            rounds=self.search_rounds,
+            seed=self._search_seq,
+        )
+        reg = self.registry
+        rounds_run = 0
+        while True:
+            t0 = perf_counter()
+            keys = plan.propose()
+            reg.observe(
+                "karpenter_consolidation_search_phase_seconds",
+                perf_counter() - t0,
+                {"phase": "propose"},
+            )
+            if not keys:
+                break
+            results = ev.evaluate_masks(cands, keys)
+            t0 = perf_counter()
+            plan.observe(keys, results)
+            reg.observe(
+                "karpenter_consolidation_search_phase_seconds",
+                perf_counter() - t0,
+                {"phase": "select"},
+            )
+            rounds_run += 1
+        reg.observe("karpenter_consolidation_search_rounds", float(rounds_run))
+        reg.observe(
+            "karpenter_consolidation_population_size", float(len(plan.seen))
+        )
+        return plan
+
+    def _consolidate_multi_descent(
+        self,
+        ranked: Sequence[Candidate],
+        ev: _RemovalEvaluator,
+    ) -> bool:
+        """LEGACY bounded subset search (pre-population): drop-one
+        refinement over the top cost-ranked candidates, kept reachable
+        behind ``use_population_search = False`` and as the arithmetic
+        the population path's structured seeds are a superset of.
 
         A pure prefix scan misses sets that are non-contiguous in cost
         order (one stubborn middle-ranked node — pinned pods, a full node
@@ -793,17 +1007,16 @@ class DisruptionController:
         every child obtained by removing one member; take the feasible
         child with the largest savings, else trim the costliest member
         and repeat.  The descent is memoized and capped at
-        MULTI_NODE_SIM_BUDGET simulations; the prefix-scan floor below
-        may add up to MULTI_NODE_CANDIDATES-1 more on cache misses, so a
-        pass is bounded by the sum of the two, not the budget alone.
+        MULTI_NODE_SIM_BUDGET simulations (the deprecated pre-population
+        knob); the prefix-scan floor below may add up to
+        MULTI_NODE_CANDIDATES-1 more on cache misses, so a pass is
+        bounded by the sum of the two, not the budget alone.
 
         Each descent level — the current set plus all its drop-one
         children — evaluates as ONE batch (the budget counts batch
         ELEMENTS, and memoized subsets never re-enter a batch), but the
         results are consumed in the sequential order above, so the chosen
         action is identical to the per-subset loop's."""
-        if ev is None:
-            ev = _RemovalEvaluator(self, list(ranked), self._pool_inventory())
         current = list(ranked[:MULTI_NODE_CANDIDATES])
         if len(current) < 2:
             return False

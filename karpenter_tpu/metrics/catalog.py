@@ -156,6 +156,39 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "'sequential' elements ran the per-subset solver round-trip "
         "(fallback conditions: docs/designs/consolidation-batching.md)",
     ),
+    "karpenter_consolidation_search_rounds": (
+        "histogram",
+        "",
+        "propose→score→select rounds executed by one multi-node "
+        "consolidation pass's population search "
+        "(controllers/disruption.py + scheduling/popsearch.py); fewer "
+        "than consolidation_search_rounds means the universe ran out of "
+        "fresh subsets early",
+    ),
+    "karpenter_consolidation_population_size": (
+        "histogram",
+        "",
+        "distinct candidate subsets (removal masks) a pass's population "
+        "search scored across all of its rounds — structured seeds plus "
+        "random diversity plus annealed mutations, each round one "
+        "vmapped device dispatch",
+    ),
+    "karpenter_consolidation_search_phase_seconds": (
+        "histogram",
+        "phase",
+        "per-round wall time of one population-search phase (propose / "
+        "pad / dispatch / device_block / decode / select / other) — the "
+        "search analogue of karpenter_consolidation_phase_seconds, kept "
+        "separate so population rounds don't skew the per-subset batch "
+        "distribution",
+    ),
+    "karpenter_consolidation_search_winners_total": (
+        "counter",
+        "action",
+        "how population-search passes concluded: a multi-node 'delete' "
+        "or 'replace' action was taken, or 'none' (no acceptable subset, "
+        "or the sequential re-derivation declined the winner)",
+    ),
     "karpenter_consolidation_verdict_mismatch_total": (
         "counter",
         "",

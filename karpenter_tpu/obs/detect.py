@@ -51,6 +51,7 @@ from karpenter_tpu.utils.clock import Clock
 WATCHED_FAMILIES = (
     "karpenter_solver_phase_seconds",
     "karpenter_consolidation_phase_seconds",
+    "karpenter_consolidation_search_phase_seconds",
     "karpenter_reconcile_tick_duration_seconds",
 )
 

@@ -47,6 +47,7 @@ _SERIES_RE = re.compile(r"^(?P<name>[a-z0-9_]+)(?:\{(?P<labels>.*)\})?$")
 _FAMILY_SHORT = {
     "karpenter_solver_phase_seconds": "solver",
     "karpenter_consolidation_phase_seconds": "consolidation",
+    "karpenter_consolidation_search_phase_seconds": "search",
     "karpenter_reconcile_tick_duration_seconds": "tick",
     "karpenter_provisioner_scheduling_duration_seconds": "scheduling",
 }

@@ -44,6 +44,7 @@ DEFAULT_TICKS = 64
 DELTA_HISTOGRAMS = (
     "karpenter_solver_phase_seconds",
     "karpenter_consolidation_phase_seconds",
+    "karpenter_consolidation_search_phase_seconds",
     "karpenter_reconcile_tick_duration_seconds",
     "karpenter_provisioner_scheduling_duration_seconds",
 )

@@ -232,6 +232,8 @@ class Operator:
             kube, self.cluster, self.cloud_provider, self.termination,
             self.clock, feature_gate_drift=self.settings.feature_gate_drift,
             registry=registry,
+            search_rounds=self.settings.consolidation_search_rounds,
+            population_size=self.settings.consolidation_population_size,
         )
         self.interruption: Optional[InterruptionController] = None
         if self.settings.interruption_queue_name:

@@ -471,6 +471,95 @@ RV_MERGE = 5  # 1.0 when decode compaction might merge >=2 new nodes to 1
 RV_WIDTH = 6
 
 
+def _verdict_row(
+    cnt_p, rm, perm,
+    req, maxper, slot, feas, alloc, price, openable,
+    used0, cfg0, npods0, next_slot0, sig0,
+    pool_id, zone_id, ct_id, compactable,
+    *, k_slots, objective,
+):
+    """One what-if subset's verdict row ([RV_WIDTH] float32) — the SINGLE
+    definition of the batched verdict math, vmapped by BOTH the
+    per-subset kernel (host-built counts/permutations) and the population
+    kernel (device-built from removal masks), so the two entry points can
+    never drift apart arithmetically.
+
+    Inputs per element: ``cnt_p`` per-class counts in PERMUTED positions,
+    ``rm`` the removed-slot mask, ``perm`` the class order the subset's
+    own compile would have produced."""
+    idx = jnp.arange(k_slots, dtype=jnp.int32)
+    feas_p = feas[perm]
+    res = _pack_core(
+        req[perm], cnt_p, maxper[perm], slot[perm], feas_p,
+        alloc, price, openable,
+        used0, jnp.where(rm, -1, cfg0), npods0, next_slot0, sig0,
+        k_slots=k_slots, objective=objective,
+    )
+    leftover_units = res.leftover.sum()
+    newmask = (idx >= next_slot0) & (res.node_pods > 0)
+    new_count = newmask.sum()
+    # single-new-node replacement price, widen-equivalent: min config
+    # price over { committed } ∪ { openable configs feasible for every
+    # class on the node, holding its final usage, sharing the
+    # committed pool/zone/capacity-type } — exactly the alternate set
+    # _add_alternate_types widens to, whose min VirtualNode.
+    # cheapest_price() reports on the sequential path
+    k_star = jnp.argmax(newmask)
+    c_star = jnp.maximum(res.node_cfg[k_star], 0)
+    on_new = res.take[:, k_star] > 0
+    class_feas = jnp.where(on_new[:, None], feas_p, True).all(axis=0)
+    fits_used = (
+        res.node_used[k_star][None, :] <= alloc + 1e-6
+    ).all(axis=1)
+    same = (
+        (pool_id == pool_id[c_star])
+        & (zone_id == zone_id[c_star])
+        & (ct_id == ct_id[c_star])
+    )
+    m = openable & class_feas & fits_used & same
+    masked = jnp.where(m, price, jnp.inf)
+    c_min = jnp.argmin(masked).astype(jnp.int32)
+    min_price = masked[c_min]
+    # decode-compaction escape hatch: a >=2-new-node result flips to
+    # "fits with one replacement" only if _compact_small_nodes can
+    # merge the new nodes down to ONE.  Necessary conditions, checked
+    # here so conclusive not-fits verdicts skip the host fallback: all
+    # but at most one new node is a donor (<= 8 placement units, every
+    # class on it movable), and SOME openable config feasible for
+    # every new-node class holds the union of all new-node load (the
+    # try_add probe can re-type a node through the widen machinery, so
+    # the absorber is not limited to its committed config).  The test
+    # is deliberately a superset of what compaction can really do —
+    # a spurious positive costs one host fallback, never a wrong
+    # verdict.
+    bad_k = ((res.take > 0) & (~compactable[perm])[:, None]).any(axis=0)
+    donor_k = newmask & (res.node_pods <= 8) & ~bad_k
+    n_nondonor = (newmask & ~donor_k).sum()
+    new_load = jnp.where(newmask[:, None], res.node_used, 0.0).sum(
+        axis=0
+    )
+    on_any_new = ((res.take > 0) & newmask[None, :]).any(axis=1)
+    all_new_feas = jnp.where(on_any_new[:, None], feas_p, True).all(
+        axis=0
+    )
+    hold = (
+        (new_load[None, :] <= alloc + 1e-6).all(axis=1)
+        & openable
+        & all_new_feas
+    ).any()
+    merge = (new_count >= 2) & (n_nondonor <= 1) & hold
+    return jnp.stack(
+        [
+            leftover_units.astype(jnp.float32),
+            new_count.astype(jnp.float32),
+            c_min.astype(jnp.float32),
+            min_price,
+            c_star.astype(jnp.float32),
+            merge.astype(jnp.float32),
+        ]
+    )
+
+
 @partial(jax.jit, static_argnames=("k_slots", "objective"))
 def removal_verdict_kernel(
     req: jax.Array,  # [G, R] float32 — base class requests
@@ -519,81 +608,140 @@ def removal_verdict_kernel(
     decode divergence (small-node compaction) the caller must resolve
     host-side.  The full decode runs host-side only for the winner.
     """
-    idx = jnp.arange(k_slots, dtype=jnp.int32)
 
     def one(cnt_p, rm, perm):
-        feas_p = feas[perm]
-        res = _pack_core(
-            req[perm], cnt_p, maxper[perm], slot[perm], feas_p,
-            alloc, price, openable,
-            used0, jnp.where(rm, -1, cfg0), npods0, next_slot0, sig0,
+        return _verdict_row(
+            cnt_p, rm, perm,
+            req, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, next_slot0, sig0,
+            pool_id, zone_id, ct_id, compactable,
             k_slots=k_slots, objective=objective,
-        )
-        leftover_units = res.leftover.sum()
-        newmask = (idx >= next_slot0) & (res.node_pods > 0)
-        new_count = newmask.sum()
-        # single-new-node replacement price, widen-equivalent: min config
-        # price over { committed } ∪ { openable configs feasible for every
-        # class on the node, holding its final usage, sharing the
-        # committed pool/zone/capacity-type } — exactly the alternate set
-        # _add_alternate_types widens to, whose min VirtualNode.
-        # cheapest_price() reports on the sequential path
-        k_star = jnp.argmax(newmask)
-        c_star = jnp.maximum(res.node_cfg[k_star], 0)
-        on_new = res.take[:, k_star] > 0
-        class_feas = jnp.where(on_new[:, None], feas_p, True).all(axis=0)
-        fits_used = (
-            res.node_used[k_star][None, :] <= alloc + 1e-6
-        ).all(axis=1)
-        same = (
-            (pool_id == pool_id[c_star])
-            & (zone_id == zone_id[c_star])
-            & (ct_id == ct_id[c_star])
-        )
-        m = openable & class_feas & fits_used & same
-        masked = jnp.where(m, price, jnp.inf)
-        c_min = jnp.argmin(masked).astype(jnp.int32)
-        min_price = masked[c_min]
-        # decode-compaction escape hatch: a >=2-new-node result flips to
-        # "fits with one replacement" only if _compact_small_nodes can
-        # merge the new nodes down to ONE.  Necessary conditions, checked
-        # here so conclusive not-fits verdicts skip the host fallback: all
-        # but at most one new node is a donor (<= 8 placement units, every
-        # class on it movable), and SOME openable config feasible for
-        # every new-node class holds the union of all new-node load (the
-        # try_add probe can re-type a node through the widen machinery, so
-        # the absorber is not limited to its committed config).  The test
-        # is deliberately a superset of what compaction can really do —
-        # a spurious positive costs one host fallback, never a wrong
-        # verdict.
-        bad_k = ((res.take > 0) & (~compactable[perm])[:, None]).any(axis=0)
-        donor_k = newmask & (res.node_pods <= 8) & ~bad_k
-        n_nondonor = (newmask & ~donor_k).sum()
-        new_load = jnp.where(newmask[:, None], res.node_used, 0.0).sum(
-            axis=0
-        )
-        on_any_new = ((res.take > 0) & newmask[None, :]).any(axis=1)
-        all_new_feas = jnp.where(on_any_new[:, None], feas_p, True).all(
-            axis=0
-        )
-        hold = (
-            (new_load[None, :] <= alloc + 1e-6).all(axis=1)
-            & openable
-            & all_new_feas
-        ).any()
-        merge = (new_count >= 2) & (n_nondonor <= 1) & hold
-        return jnp.stack(
-            [
-                leftover_units.astype(jnp.float32),
-                new_count.astype(jnp.float32),
-                c_min.astype(jnp.float32),
-                min_price,
-                c_star.astype(jnp.float32),
-                merge.astype(jnp.float32),
-            ]
         )
 
     return jax.vmap(one)(cnt_b, rm_b, perm_b)
+
+
+# population search over removal masks (docs/designs/consolidation-search.md)
+# — sentinels for the device-side class-order computation.  A class with a
+# zero count sorts AFTER every present class (the host path appends absent
+# classes in index order; jnp.argsort is stable, so one shared key gives
+# the identical order); the composite first-occurrence keys are
+# host-guarded to stay below the sentinel (solver._build_removal_base).
+POP_KEY_ABSENT = 2**30  # argsort key for classes outside the subset
+POP_OCC_ABSENT = 2**29  # occ fill for (candidate, class) pairs w/o pods
+
+
+@partial(jax.jit, static_argnames=("k_slots", "objective"))
+def population_verdict_kernel(
+    req: jax.Array,  # [G, R] float32 — base class requests
+    maxper: jax.Array,  # [G] int32
+    slot: jax.Array,  # [G] int32
+    feas: jax.Array,  # [G, C] bool
+    alloc: jax.Array,  # [C, R] float32
+    price: jax.Array,  # [C] float32
+    openable: jax.Array,  # [C] bool
+    used0: jax.Array,  # [K, R] float32 — FULL remaining-cluster prefill
+    cfg0: jax.Array,  # [K] int32
+    npods0: jax.Array,  # [K] int32
+    next_slot0: jax.Array,  # int32 — first free slot (== live-node count)
+    sig0: jax.Array,  # [S, K] int32
+    pool_id: jax.Array,  # [C] int32
+    zone_id: jax.Array,  # [C] int32
+    ct_id: jax.Array,  # [C] int32
+    compactable: jax.Array,  # [G] bool
+    cand_cnt: jax.Array,  # [U, G] int32 — per-candidate per-class counts
+    cand_slot: jax.Array,  # [U] int32 — live column (k_slots = not live)
+    cand_occ: jax.Array,  # [U, G] int32 — first-occurrence composite
+    sort_rank: jax.Array,  # [G] int32 — dense rank of the FFD sort key
+    occ_span: jax.Array,  # int32 — strict upper bound on cand_occ values
+    masks: jax.Array,  # [P, U] bool — the population of removal masks
+    *,
+    k_slots: int,
+    objective: str = "nodes",
+) -> jax.Array:
+    """The population search's scoring dispatch: P candidate SUBSETS,
+    encoded as removal masks over the universe axis, scored through the
+    shared verdict math in ONE vmapped call — with the per-subset count
+    vector, removed-slot mask, and FFD class order all derived ON DEVICE
+    from the mask, so the host never loops over the population.
+
+    Per member (see docs/designs/consolidation-search.md §mask encoding):
+
+    - counts: ``cnt = Σ_{j∈mask} cand_cnt[j]`` — the subset's
+      reschedulable pods as per-class placement counts;
+    - removed slots: scatter of ``cand_slot`` over the selected rows
+      (candidates absent from the live columns scatter out of range and
+      drop — both paths compiled them away already);
+    - class order: the subset's own compile sorts classes by the FFD key
+      with ties in first-occurrence order over its pod list.  Candidates
+      concatenate in universe rank order, so the first occurrence of
+      class g is ``min_j(cand_occ[j, g])`` over selected j, where
+      ``cand_occ[j, g] = j * max_pods + pos``; the composite argsort key
+      ``sort_rank * occ_span + occ`` reproduces the host sort exactly
+      (dense ranks make float-key ties explicit; jnp.argsort is stable,
+      so absent classes keep index order behind the sentinel).
+
+    Returns the [P, RV_WIDTH] verdict matrix — identical rows to
+    ``removal_verdict_kernel`` for identical subsets, which is what the
+    parity fuzz (tests/test_consolidation_search.py) pins."""
+
+    def one(sel):
+        cnt_g = jnp.where(sel[:, None], cand_cnt, 0).sum(axis=0)
+        cnt_g = cnt_g.astype(jnp.int32)
+        rm = (
+            jnp.zeros(k_slots, jnp.int32)
+            .at[cand_slot]
+            .max(sel.astype(jnp.int32), mode="drop")
+        ) > 0
+        occ = jnp.where(sel[:, None], cand_occ, POP_OCC_ABSENT).min(axis=0)
+        key = jnp.where(
+            cnt_g > 0, sort_rank * occ_span + occ, POP_KEY_ABSENT
+        )
+        perm = jnp.argsort(key).astype(jnp.int32)
+        return _verdict_row(
+            cnt_g[perm], rm, perm,
+            req, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, next_slot0, sig0,
+            pool_id, zone_id, ct_id, compactable,
+            k_slots=k_slots, objective=objective,
+        )
+
+    return jax.vmap(one)(masks)
+
+
+def run_population_verdicts(
+    padded_args: tuple,
+    k_slots: int,
+    pool_id: np.ndarray,
+    zone_id: np.ndarray,
+    ct_id: np.ndarray,
+    compactable: np.ndarray,
+    cand_cnt: np.ndarray,
+    cand_slot: np.ndarray,
+    cand_occ: np.ndarray,
+    sort_rank: np.ndarray,
+    occ_span: int,
+    masks: np.ndarray,
+    objective: str = "nodes",
+) -> np.ndarray:
+    """Dispatch the population scoring kernel over pre-padded base args
+    (`pad_problem` output, device-resident via the removal base) and
+    fetch the [P, RV_WIDTH] verdict matrix — ONE device read for the
+    whole population.  The caller pads the population and universe axes
+    to power-of-two buckets so XLA compiles once per shape."""
+    (req, _cnt, maxper, slot, feas, alloc, price, openable,
+     used0, cfg0, npods0, e0, sig0) = padded_args
+    with phase("dispatch"):
+        out = population_verdict_kernel(
+            req, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, e0, sig0,
+            pool_id, zone_id, ct_id, compactable,
+            cand_cnt, cand_slot, cand_occ, sort_rank,
+            jnp.int32(occ_span), masks,
+            k_slots=k_slots, objective=objective,
+        )
+    with phase("device_block"):
+        return np.asarray(out)
 
 
 def run_removal_verdicts(

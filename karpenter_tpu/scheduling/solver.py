@@ -102,6 +102,11 @@ class _RemovalBase:
         "reason", "empty", "prob", "args", "k_slots", "n_live",
         "slot_of", "class_of", "pool_id", "zone_id", "ct_id",
         "compactable", "compact_ok", "price_py", "gp", "kp", "sort_key",
+        # population-search extras (docs/designs/consolidation-search.md):
+        # per-candidate tensors the mask-scoring kernel derives each
+        # subset's counts / removed slots / class order from ON DEVICE
+        "pop_reason", "n_universe", "cand_cnt", "cand_slot", "cand_occ",
+        "sort_rank", "occ_span",
     )
 
     def __init__(self, reason: str = "", empty: bool = False):
@@ -122,6 +127,13 @@ class _RemovalBase:
         self.gp = 0
         self.kp = 0
         self.sort_key: Dict[int, float] = {}
+        self.pop_reason = ""
+        self.n_universe = 0
+        self.cand_cnt = None
+        self.cand_slot = None
+        self.cand_occ = None
+        self.sort_rank = None
+        self.occ_span = 0
 
 
 class TensorScheduler:
@@ -906,16 +918,7 @@ class TensorScheduler:
     def _evaluate_removals(
         self, subsets: List[List[RemovalCandidate]], universe: tuple
     ) -> List[RemovalVerdict]:
-        from karpenter_tpu.ops.packer import (
-            RV_C_MIN,
-            RV_C_STAR,
-            RV_LEFTOVER,
-            RV_MERGE,
-            RV_NEW_COUNT,
-            RV_MIN_PRICE,
-            _bucket,
-            run_removal_verdicts,
-        )
+        from karpenter_tpu.ops.packer import _bucket, run_removal_verdicts
 
         self.last_removal_batch = 0  # only a real dispatch sets it
         base = self._removal_base(universe)
@@ -982,36 +985,108 @@ class TensorScheduler:
                 if i in bad:
                     out.append(RemovalVerdict(False, 0.0, True, bad[i]))
                     continue
-                row = verd[i]
-                if row[RV_LEFTOVER] > 0:
-                    # unschedulable — exact: the base guards exclude every
-                    # relax-eligible constraint shape, so the sequential
-                    # path's relax-and-retry could not have rescued it
-                    out.append(RemovalVerdict(False, 0.0))
-                    continue
-                new_count = int(row[RV_NEW_COUNT])
-                if new_count == 0:
-                    out.append(RemovalVerdict(True, 0.0))
-                    continue
-                if new_count == 1:
-                    # widen-equivalent price: committed config, improved by
-                    # the cheapest alternate — read back as PYTHON floats
-                    # so the price equals the sequential decode's
-                    price = base.price_py[int(row[RV_C_STAR])]
-                    if np.isfinite(row[RV_MIN_PRICE]):
-                        price = min(
-                            price, base.price_py[int(row[RV_C_MIN])]
-                        )
-                    out.append(RemovalVerdict(True, float(price)))
-                    continue
-                if row[RV_MERGE] > 0 and base.compact_ok:
-                    # >= 2 new nodes that decode compaction might merge to
-                    # one — the only decode step the verdict cannot replay
-                    out.append(
-                        RemovalVerdict(False, 0.0, True, "compaction")
-                    )
-                    continue
-                out.append(RemovalVerdict(False, 0.0))
+                out.append(self._verdict_from_row(verd[i], base))
+        return out
+
+    @staticmethod
+    def _verdict_from_row(row: np.ndarray, base: _RemovalBase) -> RemovalVerdict:
+        """Decode ONE verdict row (RV_* layout) — shared by the
+        per-subset batch and the population search, so a mask scored
+        either way decodes to the identical RemovalVerdict."""
+        from karpenter_tpu.ops.packer import (
+            RV_C_MIN,
+            RV_C_STAR,
+            RV_LEFTOVER,
+            RV_MERGE,
+            RV_MIN_PRICE,
+            RV_NEW_COUNT,
+        )
+
+        if row[RV_LEFTOVER] > 0:
+            # unschedulable — exact: the base guards exclude every
+            # relax-eligible constraint shape, so the sequential
+            # path's relax-and-retry could not have rescued it
+            return RemovalVerdict(False, 0.0)
+        new_count = int(row[RV_NEW_COUNT])
+        if new_count == 0:
+            return RemovalVerdict(True, 0.0)
+        if new_count == 1:
+            # widen-equivalent price: committed config, improved by
+            # the cheapest alternate — read back as PYTHON floats
+            # so the price equals the sequential decode's
+            price = base.price_py[int(row[RV_C_STAR])]
+            if np.isfinite(row[RV_MIN_PRICE]):
+                price = min(price, base.price_py[int(row[RV_C_MIN])])
+            return RemovalVerdict(True, float(price))
+        if row[RV_MERGE] > 0 and base.compact_ok:
+            # >= 2 new nodes that decode compaction might merge to
+            # one — the only decode step the verdict cannot replay
+            return RemovalVerdict(False, 0.0, True, "compaction")
+        return RemovalVerdict(False, 0.0)
+
+    def evaluate_population(
+        self,
+        masks: np.ndarray,
+        universe: Sequence[RemovalCandidate],
+    ) -> List[RemovalVerdict]:
+        """Score a POPULATION of removal masks in one vmapped dispatch.
+
+        ``masks`` is a [P, U'] bool matrix over a rank-order PREFIX of
+        ``universe`` (column j selects universe[j]); unlike
+        :meth:`evaluate_removals`, the per-subset count vectors, removed-
+        slot masks, and FFD class permutations are derived ON DEVICE from
+        the mask (ops/packer.py `population_verdict_kernel`), so the host
+        cost per round is one mask upload — no O(P·G) permutation loop.
+        The base problem, its padded device tensors, and the per-candidate
+        population tensors all come from the SAME cached removal base the
+        subset batch uses (resident-tensor reuse included), and each row
+        decodes through the same `_verdict_from_row`, so a mask scored
+        here is bit-identical to the same subset scored per-element — and,
+        transitively, to the sequential `_simulate`.  Elements the kernel
+        cannot answer bit-identically come back ``needs_host`` exactly
+        like the per-subset path."""
+        self.last_phases = phases = {}
+        with phase_collect(phases), phase("other"):
+            return self._evaluate_population(
+                np.asarray(masks, bool), tuple(universe)
+            )
+
+    def _evaluate_population(
+        self, masks: np.ndarray, universe: tuple
+    ) -> List[RemovalVerdict]:
+        from karpenter_tpu.ops.packer import _bucket, run_population_verdicts
+
+        self.last_removal_batch = 0
+        base = self._removal_base(universe)
+        P = int(masks.shape[0])
+        if base.reason:
+            return [
+                RemovalVerdict(False, 0.0, True, base.reason)
+                for _ in range(P)
+            ]
+        if base.empty:
+            return [RemovalVerdict(True, 0.0) for _ in range(P)]
+        if base.pop_reason:
+            return [
+                RemovalVerdict(False, 0.0, True, base.pop_reason)
+                for _ in range(P)
+            ]
+        with phase("pad"):
+            up = int(base.cand_slot.shape[0])
+            pp = _bucket(max(P, 1), floor=self.MIN_REMOVAL_BATCH)
+            mb = np.zeros((pp, up), bool)
+            mb[:P, : masks.shape[1]] = masks
+        verd = run_population_verdicts(
+            base.args, base.k_slots,
+            base.pool_id, base.zone_id, base.ct_id, base.compactable,
+            base.cand_cnt, base.cand_slot, base.cand_occ, base.sort_rank,
+            base.occ_span, mb, objective=self.objective,
+        )
+        self.last_removal_batch = P
+        out: List[RemovalVerdict] = []
+        with phase("decode"):
+            for i in range(P):
+                out.append(self._verdict_from_row(verd[i], base))
         return out
 
     def _removal_base(self, universe: tuple) -> _RemovalBase:
@@ -1038,6 +1113,45 @@ class TensorScheduler:
             self._removal_cache[fp] = (base, pins)
         return base
 
+    @staticmethod
+    def removal_search_guard(
+        universe: Sequence[RemovalCandidate],
+        existing: Sequence[StateNode],
+    ) -> str:
+        """The HOST-ONLY pre-compile guards of the removal base: the
+        constraint shapes whose per-subset behavior the mask batch cannot
+        replay bit-identically — pod-level topology coupling (order- and
+        set-dependent compile decisions), preference/OR-term carriers
+        (the sequential path may relax them), volume claims (the
+        sequential path re-resolves zone pins per simulation), and live
+        (anti-)affinity carriers ON a candidate node (the sequential
+        compile drops the carrier with the node, the base compile would
+        keep it — feasibility could differ).
+
+        A pure function of (universe, remaining nodes) — no compile, no
+        device — so the consolidation controller can make its
+        population-vs-descent choice from it IDENTICALLY whichever
+        verdict backend is active (the twin-run contract), instead of
+        grinding a whole population through the sequential fallback when
+        the base would have refused anyway.  Returns the fallback reason,
+        or "" when the mask encoding is sound."""
+        for cand in universe:
+            for p in cand.pods:
+                if (
+                    p.pod_affinity
+                    or p.topology_spread
+                    or p.preferred_affinity
+                    or len(p.node_affinity_terms()) > 1
+                ):
+                    return "constraint-shape"
+                if p.volume_claims:
+                    return "volume-claims"
+        names = {cand.node_name for cand in universe}
+        for sn in existing:
+            if sn.name in names and any(bp.pod_affinity for bp in sn.pods):
+                return "live-carrier-on-candidate"
+        return ""
+
     def _build_removal_base(
         self, universe: tuple, pods: List[Pod]
     ) -> _RemovalBase:
@@ -1046,28 +1160,9 @@ class TensorScheduler:
 
         if not pods:
             return _RemovalBase(empty=True)
-        # constraint shapes whose per-subset behavior the mask batch cannot
-        # replay bit-identically: pod-level topology coupling (order- and
-        # set-dependent compile decisions), preference/OR-term carriers
-        # (the sequential path may relax them), and volume claims (the
-        # sequential path re-resolves zone pins per simulation)
-        for p in pods:
-            if (
-                p.pod_affinity
-                or p.topology_spread
-                or p.preferred_affinity
-                or len(p.node_affinity_terms()) > 1
-            ):
-                return _RemovalBase(reason="constraint-shape")
-            if p.volume_claims:
-                return _RemovalBase(reason="volume-claims")
-        names = {cand.node_name for cand in universe}
-        for sn in self.existing:
-            if sn.name in names and any(bp.pod_affinity for bp in sn.pods):
-                # a live (anti-)affinity carrier ON a candidate node: the
-                # sequential compile drops it with the node, the base
-                # compile would keep it — feasibility could differ
-                return _RemovalBase(reason="live-carrier-on-candidate")
+        why = self.removal_search_guard(universe, self.existing)
+        if why:
+            return _RemovalBase(reason=why)
         # the base's guards are deliberately a superset of the resident
         # layer's eligibility (ops/resident.py), so a resident hit below
         # serves tensors the base could have compiled itself — bit-equal
@@ -1168,7 +1263,70 @@ class TensorScheduler:
         base.price_py = [
             float(cfg.price) for cfg in prob.configs
         ]
+        self._build_population_tensors(base, universe)
         return base
+
+    @staticmethod
+    def _build_population_tensors(base: _RemovalBase, universe: tuple) -> None:
+        """Per-candidate tensors for the population scoring kernel: counts
+        per class, live-column index, and the first-occurrence composite
+        that lets the device replay each subset's FFD class order.
+
+        The composite for class g in candidate j is ``j * max_pods +
+        first_pos`` — candidates concatenate in universe rank order, so
+        the min over a mask's selected rows IS the subset's first
+        occurrence; argsorting ``sort_rank * occ_span + occ`` reproduces
+        the host's ``(sort_key, first_idx)`` sort exactly (dense ranks
+        make float-key ties explicit, composites are collision-free
+        because (j, pos) pairs are).  Everything is int32: the host guard
+        below refuses (``pop_reason``) if the composite key space could
+        touch the kernel's sentinels, sending the pass to the per-subset
+        batch instead of risking a wrapped sort key."""
+        from karpenter_tpu.ops.packer import (
+            POP_KEY_ABSENT,
+            POP_OCC_ABSENT,
+            _bucket,
+        )
+
+        u = len(universe)
+        base.n_universe = u
+        if u == 0:
+            base.pop_reason = "empty-universe"
+            return
+        maxp = max((len(cand.pods) for cand in universe), default=0) + 1
+        occ_span = u * maxp + 1
+        ranks = {
+            v: i for i, v in enumerate(sorted(set(base.sort_key.values())))
+        }
+        max_rank = max(ranks.values(), default=0)
+        if (max_rank + 2) * occ_span >= min(POP_KEY_ABSENT, 2 * POP_OCC_ABSENT):
+            base.pop_reason = "occ-composite-overflow"
+            return
+        up = _bucket(max(u, 1))
+        cand_cnt = np.zeros((up, base.gp), np.int32)
+        cand_slot = np.full(up, base.k_slots, np.int32)
+        cand_occ = np.full((up, base.gp), POP_OCC_ABSENT, np.int32)
+        for j, cand in enumerate(universe):
+            s = base.slot_of.get(cand.node_name)
+            if s is not None:
+                cand_slot[j] = s
+            for pos, p in enumerate(cand.pods):
+                g = base.class_of[id(p)]
+                cand_cnt[j, g] += 1
+                if cand_occ[j, g] == POP_OCC_ABSENT:
+                    cand_occ[j, g] = j * maxp + pos
+        sort_rank = np.zeros(base.gp, np.int32)
+        for g, v in base.sort_key.items():
+            sort_rank[g] = ranks[v]
+        import jax
+
+        # device-resident like base.args: the population round re-uploads
+        # only its masks, never the candidate tensors
+        base.cand_cnt = jax.device_put(cand_cnt)
+        base.cand_slot = jax.device_put(cand_slot)
+        base.cand_occ = jax.device_put(cand_occ)
+        base.sort_rank = jax.device_put(sort_rank)
+        base.occ_span = occ_span
 
     def _plan_live_join(self, unsupported: List[Pod], assignments):
         """Validated placement plan for the oracle-only half when EVERY

@@ -49,6 +49,43 @@ def _consolidation_section(registry) -> dict:
         "evals": dict(sorted(evals.items())),
         "batches": hist.count if hist is not None else 0,
         "batch_size_p50": percentile(sizes, 0.5),
+        "search": _search_section(registry),
+    }
+
+
+def _search_section(registry) -> dict:
+    """Population-search accounting (controllers/disruption.py +
+    scheduling/popsearch.py): passes run, rounds and population-size
+    distributions, and how each pass concluded (winners by action type).
+    Deterministic — rounds, population, and winners are functions of the
+    seeded mask schedule and the verdicts, never of wall time — so a
+    replay reproduces the section byte-for-byte."""
+    rounds_hist = registry.histograms.get(
+        "karpenter_consolidation_search_rounds", {}
+    ).get(())
+    pop_hist = registry.histograms.get(
+        "karpenter_consolidation_population_size", {}
+    ).get(())
+    winners = {
+        (labels[0][1] if labels else ""): int(v)
+        for labels, v in registry.counters.get(
+            "karpenter_consolidation_search_winners_total", {}
+        ).items()
+    }
+    return {
+        "passes": rounds_hist.count if rounds_hist is not None else 0,
+        # quantile, not percentile(histogram(...)): exact below the
+        # sample window, bucket-estimated past it (same contract as the
+        # resident section's delta_rows)
+        "rounds_p50": registry.quantile(
+            "karpenter_consolidation_search_rounds", 0.5
+        ),
+        "rounds_max": rounds_hist.vmax if rounds_hist is not None else 0.0,
+        "population_p50": registry.quantile(
+            "karpenter_consolidation_population_size", 0.5
+        ),
+        "population_max": pop_hist.vmax if pop_hist is not None else 0.0,
+        "winners": dict(sorted(winners.items())),
     }
 
 

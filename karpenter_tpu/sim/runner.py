@@ -37,6 +37,7 @@ from karpenter_tpu.cloud.fake.backend import (
     CloudAPIError,
     FakeImage,
     MachineShape,
+    generate_catalog,
 )
 from karpenter_tpu.obs.slo import SLORule
 from karpenter_tpu.sim.invariants import InvariantChecker
@@ -49,9 +50,11 @@ from karpenter_tpu.sim.workload import (
     FlashCrowd,
     InstanceKiller,
     InterruptionStorm,
+    ScaleDown,
     Script,
     SimEvent,
     SoakChurn,
+    SpotInterrupter,
     Steady,
     Workload,
 )
@@ -591,6 +594,42 @@ def _resident_churn(ticks: int) -> Scenario:
                     ],
                 }
             ),
+        ],
+    )
+
+
+@scenario(
+    "consolidation-storm",
+    "over-provisioned fleet on small shapes + a deep diurnal trough + "
+    "background spot interruptions: flash crowds spin up many small "
+    "nodes, heavy churn then empties them out, and the trough leaves a "
+    "fleet the population search must consolidate hard — the "
+    "device-resident consolidation-search acceptance scenario "
+    "(record/replay byte-identical with the seeded search on, "
+    "consolidation.search report section populated, verdict mismatches "
+    "zero)",
+)
+def _consolidation_storm(ticks: int) -> Scenario:
+    period = max(40, (2 * ticks) // 3)
+    return Scenario(
+        "consolidation-storm",
+        # small shapes so the fleet is many small nodes — the candidate
+        # universes the removal-mask population actually searches over
+        shapes=generate_catalog(generations=(1, 2), cpus=(4, 8)),
+        workloads=[
+            # over-provision: bursts open nodes the trough won't need
+            FlashCrowd(prob=0.18, min_size=12, max_size=20),
+            # day/night curve with a deep trough (rate clamps to ~0)
+            Diurnal(mean=1.0, amplitude=0.95, period_ticks=period),
+            # mass scale-downs: an INSTANT drop strands several nodes at
+            # once — the multi-node subsets the population search is for
+            # (gradual churn never outruns the single-node scan)
+            ScaleDown(
+                ticks=(ticks // 4, ticks // 2, (3 * ticks) // 4),
+                fraction=0.7,
+            ),
+            Churn(rate=0.4),
+            SpotInterrupter(rate=0.04),
         ],
     )
 

@@ -175,6 +175,30 @@ class Churn(Workload):
 
 
 @dataclass
+class ScaleDown(Workload):
+    """Mass scale-down: at each listed tick, a `fraction` of the live sim
+    pods is deleted AT ONCE — a deployment rollback, a batch job
+    completing, a tenant leaving.  The instantaneous drop is what leaves
+    several nodes simultaneously reclaimable, i.e. the workload shape
+    multi-node consolidation (the removal-mask population search) exists
+    for; gradual `Churn` never outruns the one-action-per-pass single
+    scan."""
+
+    ticks: Sequence[int] = ()
+    fraction: float = 0.6
+
+    def events(self, tick, rng, view):
+        if tick not in self.ticks:
+            return []
+        live = view.live_pod_keys()
+        n = min(len(live), int(len(live) * self.fraction))
+        return [
+            SimEvent("pod_delete", {"key": key})
+            for key in (rng.sample(live, n) if n else [])
+        ]
+
+
+@dataclass
 class InstanceKiller(Workload):
     """Out-of-band instance terminations (hardware failure / operator
     fat-finger): the controller only finds out by observing the cloud."""

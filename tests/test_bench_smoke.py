@@ -105,6 +105,22 @@ class TestBenchSmoke:
         assert line["batch"] >= 0
         assert line["speedup_vs_sequential"] > 0
 
+    def test_consolidation_search_line(self, bench_lines):
+        """The population-search line carries its search shape (rounds /
+        population = distinct subsets scored) next to the sequential
+        measurement over the SAME coverage — the 10x acceptance floor is
+        asserted on the full-scale artifact, measured here."""
+        line = next(
+            l
+            for l in bench_lines
+            if l["metric"] == "consolidation_search_500_candidates_p50"
+        )
+        assert line["path"] in ("batched", "sequential")
+        assert line["rounds"] >= 1
+        assert line["population"] >= 2
+        assert line["sequential_ms"] > 0
+        assert line["speedup_vs_sequential"] > 0
+
     def test_scale_restored_after_tiny_run(self, bench_lines):
         assert bench.SCALE == 1.0 and bench.ITERS == 21
 

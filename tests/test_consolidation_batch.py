@@ -150,6 +150,12 @@ def test_forced_fallback_identical_decisions():
         env = _build_env(5, 110)
         dc = env.operator.disruption
         dc.use_batched_consolidation = batched
+        # a small population keeps the sequential twin's per-mask
+        # `_simulate` walk inside the suite budget; BOTH twins share the
+        # shape (the knobs size the search plan, never the backend), and
+        # tests/test_consolidation_search.py covers the search itself
+        dc.search_rounds = 2
+        dc.search_population = 12
         rng = random.Random(99)
         keys = sorted(env.kube.pods.keys())
         for key in rng.sample(keys, len(keys) // 2):

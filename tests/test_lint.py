@@ -28,6 +28,13 @@ baked into the image, so this enforces the checks that catch real rot:
    deltas apply to the device-resident tensors (ops/resident.py) as
    scatter updates; a new call site re-tensorizing per tick silently
    reverts the resident win and must be consciously allowlisted.
+8. no call into the sequential consolidation descent (`*._simulate(...)`
+   or `*._consolidate_multi*(...)`) outside the sanctioned sites — the
+   search's contract is that what-ifs flow through the batched
+   population/verdict kernels, with the sequential path reserved for
+   per-element fallbacks and the authoritative re-derivation of winning
+   actions; a new call site quietly walking subsets host-side reverts
+   the search promotion and must be consciously allowlisted.
 """
 
 import ast
@@ -496,6 +503,135 @@ def test_full_tensorize_lint_has_teeth():
         bad, "karpenter_tpu/scheduling/x.py",
         {("karpenter_tpu/scheduling/x.py", "S.warm"),
          ("karpenter_tpu/scheduling/x.py", "S.cold")},
+    )
+    assert not ok, ok
+
+
+# rule 8: the sanctioned sequential-descent call sites.  `_simulate` is
+# the per-subset solver round-trip the batched kernels replaced; the
+# population search may reach it only through the evaluator's lazy
+# per-element fallback (`result`) and the winner's authoritative
+# re-derivation (`vnode_for`), and the legacy drop-one descent
+# (`_consolidate_multi_descent`) stays reachable only behind
+# `use_population_search = False` via `_consolidate_multi`.  Any NEW
+# call site — especially a loop of per-subset simulations — bypasses the
+# batched search and must be consciously added here.
+_SEQUENTIAL_DESCENT_ALLOWLIST = {
+    # lazy per-element fallback: the one sanctioned batched->sequential seam
+    ("karpenter_tpu/controllers/disruption.py", "_RemovalEvaluator.result"),
+    # authoritative re-derivation of every winning action
+    ("karpenter_tpu/controllers/disruption.py",
+     "_RemovalEvaluator.vnode_for"),
+    # the consolidation pass entry points (multi -> descent fallback)
+    ("karpenter_tpu/controllers/disruption.py",
+     "DisruptionController._consolidate"),
+    ("karpenter_tpu/controllers/disruption.py",
+     "DisruptionController._consolidate_multi"),
+}
+
+_SEQUENTIAL_DESCENT_NAMES = frozenset(
+    {"_simulate", "_consolidate_multi", "_consolidate_multi_descent"}
+)
+
+
+def sequential_descent_offenders(source: str, rel: str, allowlist):
+    """AST scan for sequential-descent calls: `<anything>._simulate(...)`
+    and `<anything>._consolidate_multi[_descent](...)`.  Every call site
+    must be allowlisted by (file, qualified name); hits lexically inside
+    a for/while loop — the per-subset serial-walk antipattern — are
+    called out."""
+    tree = ast.parse(source)
+    offenders = []
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self):
+            self.scope = []
+            self.loops = 0
+
+        def _scoped(self, node, push):
+            self.scope.append(push)
+            self.generic_visit(node)
+            self.scope.pop()
+
+        def visit_ClassDef(self, node):
+            self._scoped(node, node.name)
+
+        def visit_FunctionDef(self, node):
+            self._scoped(node, node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def _loop(self, node):
+            self.loops += 1
+            self.generic_visit(node)
+            self.loops -= 1
+
+        visit_For = visit_While = visit_AsyncFor = _loop
+
+        def visit_Call(self, node):
+            f = node.func
+            name = (
+                f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute)
+                else None
+            )
+            if name in _SEQUENTIAL_DESCENT_NAMES:
+                qual = ".".join(self.scope)
+                if (rel, qual) not in allowlist:
+                    where = "INSIDE A LOOP" if self.loops else "call"
+                    offenders.append(
+                        f"{rel}:{node.lineno}: {qual or '<module>'}: "
+                        f"{name}(...) [{where}]"
+                    )
+            self.generic_visit(node)
+
+    Visitor().visit(tree)
+    return offenders
+
+
+def test_no_sequential_descent_outside_sanctioned_sites():
+    """Batched-search guard: the sequential `_simulate` / descent is
+    reachable only from the allowlisted fallback and re-derivation sites
+    — what-if evaluations must flow through the population/verdict
+    kernels (docs/designs/consolidation-search.md fallback conditions),
+    so a future code path cannot quietly walk subsets host-side."""
+    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(pkg_root.parent).as_posix()
+        offenders += sequential_descent_offenders(
+            path.read_text(), rel, _SEQUENTIAL_DESCENT_ALLOWLIST
+        )
+    assert not offenders, (
+        "unsanctioned sequential-descent call (batch the what-ifs "
+        "through evaluate_population/evaluate_removals, or consciously "
+        "allowlist a fallback/re-derivation site):\n" + "\n".join(offenders)
+    )
+
+
+def test_sequential_descent_lint_has_teeth():
+    """The checker fires on `_simulate` and descent calls (tagging
+    in-loop hits), and stays quiet on allowlisted sites."""
+    bad = (
+        "class C:\n"
+        "    def scan(self, cands):\n"
+        "        for c in cands:\n"
+        "            fits, price, vn = self._simulate([c])\n"
+        "    def multi(self, ranked):\n"
+        "        return self._consolidate_multi_descent(ranked, None)\n"
+    )
+    hits = sequential_descent_offenders(
+        bad, "karpenter_tpu/controllers/x.py", _SEQUENTIAL_DESCENT_ALLOWLIST
+    )
+    assert len(hits) == 2, hits
+    assert "INSIDE A LOOP" in hits[0] and "C.scan" in hits[0], hits
+    assert "_consolidate_multi_descent" in hits[1] and "C.multi" in hits[1]
+    ok = sequential_descent_offenders(
+        bad, "karpenter_tpu/controllers/x.py",
+        {("karpenter_tpu/controllers/x.py", "C.scan"),
+         ("karpenter_tpu/controllers/x.py", "C.multi")},
     )
     assert not ok, ok
 

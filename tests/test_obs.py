@@ -587,7 +587,21 @@ class TestStorePropagation:
             assert srv.registry.counter(
                 "karpenter_store_requests_total", {"method": "put"}
             ) >= 1
-            # the client's background watch is counted and spanned too
+            # the client's background watch is counted and spanned too —
+            # it registers ASYNCHRONOUSLY on its own thread, so under a
+            # loaded CPU the RPC may land after the put: poll briefly
+            # instead of racing it
+            import time as _time
+
+            deadline = _time.monotonic() + 10.0
+            while (
+                srv.registry.counter(
+                    "karpenter_store_requests_total", {"method": "watch"}
+                )
+                < 1
+                and _time.monotonic() < deadline
+            ):
+                _time.sleep(0.02)
             assert srv.registry.counter(
                 "karpenter_store_requests_total", {"method": "watch"}
             ) >= 1
