@@ -35,7 +35,13 @@ Configs (BASELINE.md "Benchmark configs to reproduce"):
    distributed-backend boundary's overhead (SURVEY.md §5).
 
 Each line: {"metric", "value", "unit", "vs_baseline", "path", "kernel",
-"nodes", "phases"}.  ``phases`` is the per-phase wall-time breakdown (ms)
+"nodes", "phases", "compile_count_cold/warm", "transfer_bytes_cold/
+warm"}.  The compile/transfer counters come from the device observatory
+(obs/device.py): the cold numbers cover the first solve + warmups, the
+warm numbers the measured window — a healthy warm window compiles
+NOTHING and uploads only what its cluster delta justifies, and
+``--compare`` fails a line whose warm compile count went 0 → nonzero.
+``phases`` is the per-phase wall-time breakdown (ms)
 of the median sample — the solver's disjoint self-time spans (partition /
 compile / pad / dispatch / device_block / oracle / decode / other, see
 README "solve latency anatomy") plus a "harness" residual, summing to ≈
@@ -160,6 +166,47 @@ def _cold_run_ms(fn) -> float:
     return round((time.perf_counter() - t0) * 1000.0, 2)
 
 
+class _DeviceWindow:
+    """Device-observatory accounting for one bench line (obs/device.py):
+    a scope is opened for the line, everything before the measured
+    window (the cold run + warmups) lands in the ``cold`` numbers, and
+    the measured iterations land in ``warm``.  ``compile_count_*`` is
+    ACTUAL jit-cache growth — the warm window of a healthy line compiles
+    NOTHING, and `--compare` fails a line whose warm count went
+    0 → nonzero even when its p50 got lucky (a silent recompile is a
+    regression).  ``transfer_bytes_warm`` is per-solve (total over the
+    window divided by the iteration count)."""
+
+    def __init__(self):
+        from karpenter_tpu.obs.device import OBSERVATORY
+
+        self._obs = OBSERVATORY
+        self._scope = OBSERVATORY.begin_scope()
+        self._mark = (0, 0)
+
+    def _totals(self):
+        sc = self._scope
+        return (
+            sum(sc.compiles.values()),
+            sum(sc.transfer_bytes.values()),
+        )
+
+    def mark_warm(self) -> None:
+        """Everything recorded so far was cold (first solve + warmups)."""
+        self._mark = self._totals()
+
+    def finish(self, iters: int) -> Dict[str, int]:
+        compiles, nbytes = self._totals()
+        self._obs.end_scope(self._scope)
+        c0, b0 = self._mark
+        return {
+            "compile_count_cold": c0,
+            "transfer_bytes_cold": b0,
+            "compile_count_warm": compiles - c0,
+            "transfer_bytes_warm": int(round((nbytes - b0) / max(iters, 1))),
+        }
+
+
 def _measure(
     solve, warmup: Optional[int] = None, iters: Optional[int] = None,
     phases_fn=None,
@@ -236,12 +283,21 @@ def _run_scheduler_config(
     # the full tensorize + upload (plus any jit variants its bucket
     # shapes still need); the measured p50 below is the warm path —
     # compile-cache-served and, on resident-capable backends, packed
-    # straight from the device-resident tensors
+    # straight from the device-resident tensors.  The device window
+    # splits the observatory counters at the same boundary: warmups are
+    # cold, the measured iterations are warm (and must compile nothing).
+    n_warm = WARMUP if warmup is None else warmup
+    n_iters = ITERS if iters is None else iters
+    dev = _DeviceWindow()
     cold_ms = _cold_run_ms(solve_once)
+    for _ in range(n_warm):
+        solve_once()
+    dev.mark_warm()
     p50, noise, phases = _measure(
-        solve_once, warmup=warmup, iters=iters,
+        solve_once, warmup=0, iters=n_iters,
         phases_fn=lambda: ts.last_phases,
     )
+    device_counts = dev.finish(n_iters)
     if expect_resident:
         assert ts.last_resident and ts.resident_hits > 0, (
             metric, ts.resident_hits, ts.resident_rebuilds,
@@ -259,7 +315,7 @@ def _run_scheduler_config(
     _emit(
         metric, p50, ts.last_path, ts.last_kernel, nodes_out[0],
         noise_ms=noise, phases=phases,
-        cold_ms=cold_ms, warm_ms=round(p50, 2), **extra,
+        cold_ms=cold_ms, warm_ms=round(p50, 2), **device_counts, **extra,
     )
 
 
@@ -763,9 +819,13 @@ def run_consolidation_repack() -> None:
         dc._simulate(candidates)
 
     sched = dc._scheduler
+    dev = _DeviceWindow()
     cold_ms = _cold_run_ms(simulate_once)
+    for _ in range(WARMUP):
+        simulate_once()
+    dev.mark_warm()
     p50, noise, phases = _measure(
-        simulate_once, phases_fn=lambda: sched.last_phases
+        simulate_once, warmup=0, phases_fn=lambda: sched.last_phases
     )
     _emit(
         "consolidation_repack_5k_pods_p50", p50, sched.last_path,
@@ -773,6 +833,7 @@ def run_consolidation_repack() -> None:
         cold_ms=cold_ms, warm_ms=round(p50, 2),
         resident_hits=sched.resident_hits,
         resident_rebuilds=sched.resident_rebuilds,
+        **dev.finish(ITERS),
     )
 
 
@@ -832,10 +893,15 @@ def run_consolidation_sweep() -> None:
         for s in singles:
             dc._simulate(list(s), inv)
 
+    dev = _DeviceWindow()
     cold_ms = _cold_run_ms(batched_sweep)
+    for _ in range(WARMUP):
+        batched_sweep()
+    dev.mark_warm()
     p50, noise, phases = _measure(
-        batched_sweep, phases_fn=lambda: sched.last_phases
+        batched_sweep, warmup=0, phases_fn=lambda: sched.last_phases
     )
+    device_counts = dev.finish(ITERS)
     # the label reports what actually ran: a whole-pass fallback (or a
     # too-small candidate set) leaves last_removal_batch at 0
     batched_ran = sched.last_removal_batch > 0
@@ -848,6 +914,7 @@ def run_consolidation_sweep() -> None:
         batch=sched.last_removal_batch,
         sequential_ms=round(seq_p50, 2),
         speedup_vs_sequential=round(seq_p50 / p50, 2) if p50 else None,
+        **device_counts,
     )
 
 
@@ -917,10 +984,15 @@ def run_consolidation_search() -> None:
         stats["rounds"] = plan.round_no
         return plan
 
+    dev = _DeviceWindow()
     cold_ms = _cold_run_ms(population_pass)
+    for _ in range(WARMUP):
+        population_pass()
+    dev.mark_warm()
     p50, noise, phases = _measure(
-        population_pass, phases_fn=lambda: sched.last_phases
+        population_pass, warmup=0, phases_fn=lambda: sched.last_phases
     )
+    device_counts = dev.finish(ITERS)
     batched_ran = sched.last_removal_batch > 0
 
     # the sequential descent given the SAME candidate coverage: one
@@ -946,6 +1018,7 @@ def run_consolidation_search() -> None:
         population=stats["population"],
         sequential_ms=round(seq_p50, 2),
         speedup_vs_sequential=round(seq_p50 / p50, 2) if p50 else None,
+        **device_counts,
     )
 
 
@@ -1092,7 +1165,11 @@ def compare_verdict(
     exceeds the old by more than ``threshold`` (25% by default — well
     past the per-line ``noise_ms`` IQR on every config); when BOTH sides
     carry ``warm_ms`` (the resident-warm solve), a warm regression gates
-    exactly like a p50 regression.  Metrics present on only one side are
+    exactly like a p50 regression; when both sides carry
+    ``compile_count_warm`` (the device observatory's actual-recompile
+    count over the measured window), a warm count going 0 → nonzero
+    gates too — a silent recompile is a regression even when the p50
+    got lucky.  Metrics present on only one side are
     reported, never failed — a new bench line must not break comparisons
     against older artifacts.  ``malformed`` lists lines carrying a
     negative device_ms (the r05 ``-1.4`` class of artifact): a malformed
@@ -1131,6 +1208,20 @@ def compare_verdict(
                 ((nw - pw) / pw * 100.0) if pw else 0.0, 2
             )
             if pw and nw > pw * (1 + threshold):
+                row["regressed"] = is_reg = True
+        # silent-recompile gate: a budgeted line whose warm window went
+        # from compiling nothing to compiling SOMETHING regressed, even
+        # when its p50 got lucky — the compile cost will land on
+        # whichever production tick hits the fresh shape (only when both
+        # artifacts carry the counter, so pre-observatory baselines stay
+        # comparable)
+        pc, nc = (
+            prior.get("compile_count_warm"), line.get("compile_count_warm")
+        )
+        if pc is not None and nc is not None:
+            row["prior_compile_count_warm"] = pc
+            row["new_compile_count_warm"] = nc
+            if pc == 0 and nc > 0:
                 row["regressed"] = is_reg = True
         if is_reg:
             regressed.append(metric)
@@ -1176,6 +1267,14 @@ def render_verdict(verdict: dict) -> List[str]:
                     f" [warm {line['prior_warm_ms']:.2f} -> "
                     f"{line['new_warm_ms']:.2f}ms "
                     f"{line['warm_delta_pct']:+.1f}%]"
+                )
+            if (
+                line.get("prior_compile_count_warm") == 0
+                and line.get("new_compile_count_warm", 0) > 0
+            ):
+                warm += (
+                    f" [warm recompiles 0 -> "
+                    f"{line['new_compile_count_warm']}]"
                 )
             rows.append(
                 f"{metric:55s} {line['prior_ms']:9.2f} -> "

@@ -79,7 +79,8 @@ def main(argv=None) -> int:
         "ledger, ?since_seq=N&limit=M cursor), /trace (the span ring, "
         "renderable via `python -m karpenter_tpu obs`), /debug/flight "
         "(the flight recorder ring, diagnosable via `python -m "
-        "karpenter_tpu doctor`)",
+        "karpenter_tpu doctor`), /debug/device (the device "
+        "observatory's live compile/transfer/resident snapshot)",
     )
     parser.add_argument(
         "--events-log",
@@ -115,6 +116,17 @@ def main(argv=None) -> int:
         "without it the bundled simulation backend's store is in-process, "
         "so simulator replicas are independent clusters and each leads "
         "its own",
+    )
+    parser.add_argument(
+        "--demo-pods",
+        type=int,
+        default=0,
+        metavar="N",
+        help="seed the bundled simulation backend with a default "
+        "NodeClass/NodePool and N small pending pods at boot — a "
+        "self-contained demo/smoke workload so a freshly booted process "
+        "actually provisions (the entrypoint e2e scrapes /debug/device "
+        "on this basis); no effect on a real cloud backend deployment",
     )
     parser.add_argument(
         "--dump-settings", action="store_true",
@@ -178,8 +190,30 @@ def main(argv=None) -> int:
         operator.ledger.set_sink(args.events_log)
         log.info("event ledger sink at %s", args.events_log)
 
+    if args.demo_pods:
+        from karpenter_tpu.api import NodeClass, NodePool, Pod, Resources
+        from karpenter_tpu.api.objects import SelectorTerm
+
+        kube.put_node_class(
+            NodeClass(
+                name="default",
+                subnet_selector_terms=[SelectorTerm.of(Name="*")],
+                security_group_selector_terms=[SelectorTerm.of(Name="*")],
+            )
+        )
+        kube.put_node_pool(NodePool(name="default", node_class_ref="default"))
+        for i in range(args.demo_pods):
+            kube.put_pod(
+                Pod(
+                    name=f"demo-{i}",
+                    requests=Resources(cpu=0.25, memory=512 * 2**20),
+                )
+            )
+        log.info("seeded demo workload: %d pending pods", args.demo_pods)
+
     server = None
     if args.metrics_port:
+        from karpenter_tpu.obs.device import OBSERVATORY
         from karpenter_tpu.obs.http import start_telemetry
 
         server = start_telemetry(
@@ -188,6 +222,7 @@ def main(argv=None) -> int:
             tracer=operator.tracer,
             ledger=operator.ledger,
             flight=operator.flight,
+            device=OBSERVATORY,
         )
         log.info("metrics on :%d/metrics", args.metrics_port)
 

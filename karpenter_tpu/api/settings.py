@@ -66,6 +66,12 @@ class Settings:
     # only — still served at /debug/flight and dumpable via SIGUSR1)
     flight_ticks: int = 64
     flight_dir: str = ""
+    # device observatory (obs/device.py): compile/transfer/resident
+    # accounting behind the dispatch boundary — the karpenter_device_*
+    # families, the flight `device` section, /debug/device.  Counting
+    # only; off turns every seam into a passthrough (the twin-run test
+    # proves on/off changes zero scheduling actions)
+    enable_device_observatory: bool = True
 
     @classmethod
     def from_file(cls, path: str) -> "Settings":

@@ -273,4 +273,68 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "controller_crash / sigusr1 / http / manual); the dump itself is "
         "a JSONL ring of the last flight_ticks ticks' full context",
     ),
+    # ---- device observatory (obs/device.py, docs/designs/observability.md)
+    "karpenter_device_compiles_total": (
+        "counter",
+        "fn",
+        "XLA compilations per jit entry point (pack_kernel / "
+        "pack_kernel_buffered / removal_verdict_kernel / "
+        "population_verdict_kernel / resident_delta / mesh_pack / "
+        "pallas_pack), detected as jit-cache growth at the counted "
+        "dispatch seam; a warm steady cluster should see this flat — "
+        "movement after the first ticks is a recompile storm in the "
+        "making",
+    ),
+    "karpenter_device_compile_seconds": (
+        "histogram",
+        "fn",
+        "wall time of one XLA compilation (the jit call's duration when "
+        "the cache grew — trace+compile dominates; execution stays "
+        "async); watched by the anomaly detector and baselined by "
+        "doctor like a solver phase",
+    ),
+    "karpenter_device_warm_recompiles_total": (
+        "counter",
+        "fn",
+        "compilations of a jit entry point that already had dispatches "
+        "in an EARLIER reconcile tick — a fresh padded bucket, an axis "
+        "change, a donation falling through; each also emits a "
+        "DeviceRecompile ledger event (outside the simulator) and is "
+        "the doctor's recompile-storm signal",
+    ),
+    "karpenter_device_dispatches_total": (
+        "counter",
+        "fn",
+        "device dispatches per jit entry point through the counted seam "
+        "(obs/device.py) — the denominator that turns transfer bytes "
+        "and compile counts into per-dispatch attributions",
+    ),
+    "karpenter_device_transfer_bytes_total": (
+        "counter",
+        "site",
+        "host->device bytes crossing the counted seam, per site: jit "
+        "argument uploads attribute to their entry point (a numpy "
+        "argument IS a transfer; device-resident args count zero), "
+        "explicit uploads to their put site (pack_constants / "
+        "mesh_constants / pallas_constants / resident_seed / "
+        "removal_base / population_tensors); lint rule 9 fences raw "
+        "device_put call sites so this family stays complete",
+    ),
+    "karpenter_device_resident_bytes": (
+        "gauge",
+        "consumer",
+        "live device-buffer footprint of the resident cluster tensors "
+        "(ops/resident.py), per consumer ('solve' = the pending-batch "
+        "state, 'removal' = the consolidation base universe); reported "
+        "after every seed/evict so it is the CURRENT truth, not a "
+        "high-water mark — a monotonically growing value is a leak",
+    ),
+    "karpenter_device_resident_updates_total": (
+        "counter",
+        "kind",
+        "resident-tensor updates by kind: 'donated' (warm scatter delta "
+        "reusing donated buffers — allocates nothing), 'seed' (fresh "
+        "full-tensor upload), 'noop' (refresh hit with no tensor "
+        "change); warm steady state should be donated/noop-dominated",
+    ),
 }

@@ -53,6 +53,9 @@ WATCHED_FAMILIES = (
     "karpenter_consolidation_phase_seconds",
     "karpenter_consolidation_search_phase_seconds",
     "karpenter_reconcile_tick_duration_seconds",
+    # device observatory: a compile-time blowup (recompile storm, a jit
+    # suddenly retracing every tick) judges exactly like a phase blowup
+    "karpenter_device_compile_seconds",
 )
 
 _MAD_SCALE = 1.4826  # MAD -> stddev-equivalent under normality

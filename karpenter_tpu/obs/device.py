@@ -1,0 +1,470 @@
+"""Device observatory: compile, transfer, and resident-memory telemetry
+for the on-device hot path (docs/designs/observability.md §device).
+
+PRs 8–9 moved the tick's time and memory past the dispatch boundary —
+resident cluster tensors on donated buffers, the consolidation search in
+two vmapped dispatches — and the host-side observability plane (traces,
+ledger, SLO engine, flight recorder) was blind to everything behind it:
+a recompile storm, a transfer-byte spike, or a resident-footprint leak
+showed up only as an unexplained ``device_block`` phase regression.
+This module is the missing layer.  It owns exactly three seams:
+
+- :meth:`DeviceObservatory.dispatch` — EVERY jit entry point (the pack
+  kernels, the verdict/population kernels, the resident delta step, the
+  mesh/pallas variants) is invoked through this seam.  It counts the
+  dispatch, attributes the host-array bytes handed across the device
+  boundary (implicit uploads: a numpy argument to a jit call IS a
+  transfer), derives a shape/static signature for deterministic
+  would-compile accounting, detects actual recompiles via the jit cache
+  size, times them, and records a trace-ID-stamped ``device.<fn>`` span
+  so device dispatches appear on the tick timeline next to host phases.
+- :meth:`DeviceObservatory.put` — every EXPLICIT ``jax.device_put``
+  (catalog constants, the resident seed upload, the removal-base pin)
+  goes through this counted put; lint rule 9 (tests/test_lint.py)
+  fences raw ``device_put`` call sites so transfer accounting cannot
+  silently rot.
+- the resident hooks (:meth:`set_resident_footprint`,
+  :meth:`count_resident_update`) — ``ops/resident.py`` reports its live
+  device-buffer footprint per consumer and whether an update reused
+  donated buffers (``donated``), re-seeded from scratch (``seed``), or
+  was a pure no-change hit (``noop``).
+
+Two accounting planes, deliberately distinct:
+
+- **Process totals** feed the operator's diagnosis tail: the per-tick
+  delta is exported into the registry as the ``karpenter_device_*``
+  families (:func:`export_device_metrics`), snapshotted into the flight
+  recorder's ``device`` section, served live at ``/debug/device``, and
+  warm-tick recompiles — a compile of a function that already had
+  dispatches in an EARLIER tick — surface as ``DeviceRecompile`` ledger
+  events the doctor correlates.  Compile DURATIONS here are wall clock
+  (the jit call returns only after trace+compile; execution itself stays
+  async), which is exactly what an operator debugging a slow tick wants.
+- **Scopes** (:meth:`begin_scope`) feed the simulator and the bench:
+  per-run counters with *deterministic* compile accounting — a scope
+  counts DISTINCT DISPATCH SIGNATURES (shape/dtype/static-arg tuples),
+  i.e. how many compilations a cold process would need for the run,
+  because actual jit-cache growth depends on what earlier runs in the
+  same process already compiled and may never enter a byte-compared
+  report.  Scope sections carry counts and bytes only — never seconds.
+
+The observatory is process-global (like TRACER): ops-layer code holds no
+registry, and emission into a registry happens only at the export seam.
+With ``enabled = False`` every seam degrades to a passthrough — the
+twin-run test proves observatory on/off changes zero scheduling actions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.utils.trace import TRACER
+
+
+def _sig_part(v) -> tuple:
+    """One argument's contribution to a dispatch signature: arrays by
+    (shape, dtype) — values are data, not trace constants — everything
+    else (static kwargs like k_slots/objective) by value or type name."""
+    shape = getattr(v, "shape", None)
+    if shape is not None:
+        return ("a", tuple(shape), str(getattr(v, "dtype", "")))
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return ("s", v)
+    return ("t", type(v).__name__)
+
+
+def dispatch_signature(args: tuple, kwargs: dict) -> tuple:
+    return tuple(_sig_part(a) for a in args) + tuple(
+        (k, _sig_part(kwargs[k])) for k in sorted(kwargs)
+    )
+
+
+def _transfer_nbytes(args: tuple, kwargs: dict) -> int:
+    """Host-array bytes a dispatch hands across the device boundary.
+    Device-resident (jax) arrays count zero — that is the whole point of
+    the resident layer — and scalars are noise, not payload."""
+    n = 0
+    for a in args:
+        if isinstance(a, np.ndarray):
+            n += int(a.nbytes)
+    for v in kwargs.values():
+        if isinstance(v, np.ndarray):
+            n += int(v.nbytes)
+    return n
+
+
+def _leaf_nbytes(value) -> int:
+    """nbytes over the simple pytrees the put seam sees (an array, or a
+    tuple/list of arrays)."""
+    if isinstance(value, (tuple, list)):
+        return sum(_leaf_nbytes(v) for v in value)
+    nbytes = getattr(value, "nbytes", None)
+    return int(nbytes) if nbytes is not None else 0
+
+
+def _jit_cache_size(fn) -> Optional[int]:
+    """Compiled-variant count of a jitted callable, None when the
+    attribute is unavailable (custom callables, older jax)."""
+    try:
+        return fn._cache_size()
+    except Exception:
+        return None
+
+
+class DeviceScope:
+    """One accounting window: the process totals, a sim run, or a bench
+    measurement window.  All fields are counts/bytes except
+    ``compile_s`` (wall seconds, excluded from deterministic sections)."""
+
+    __slots__ = (
+        "dispatches", "compiles", "compile_s", "warm_recompiles",
+        "shapes", "transfer_bytes", "resident_updates", "resident_bytes",
+    )
+
+    def __init__(self):
+        self.dispatches: Dict[str, int] = {}
+        self.compiles: Dict[str, int] = {}  # actual jit-cache growth
+        self.compile_s: Dict[str, float] = {}  # wall seconds (totals only)
+        self.warm_recompiles: Dict[str, int] = {}
+        self.shapes: Dict[str, set] = {}  # fn -> distinct dispatch sigs
+        self.transfer_bytes: Dict[str, int] = {}  # site -> bytes
+        self.resident_updates: Dict[str, int] = {}  # donated/seed/noop
+        self.resident_bytes: Dict[str, int] = {}  # consumer -> live bytes
+
+    def unique_shapes(self) -> Dict[str, int]:
+        return {fn: len(s) for fn, s in sorted(self.shapes.items())}
+
+    def device_section(self, resident: Optional[Dict[str, int]] = None) -> dict:
+        """The DETERMINISTIC per-scope summary (sim report contract):
+        compile/transfer/resident counts and bytes only — no wall clock.
+        ``compiles`` is the would-compile count: distinct dispatch
+        signatures seen by this scope, i.e. the compilations a cold
+        process would need for exactly this run — actual jit-cache
+        growth depends on process history and may not enter a
+        byte-compared report.  ``resident`` is the caller's footprint
+        mapping: the sim passes its OWN environment's cache footprint,
+        because the observatory's process-wide view merges every live
+        cache (a previous run's not-yet-collected Environment would
+        leak into a byte-compared report); without it the section
+        carries whatever the caller stored on the scope (empty by
+        default)."""
+        if resident is None:
+            resident = self.resident_bytes
+        return {
+            "compiles": self.unique_shapes(),
+            "dispatches": dict(sorted(self.dispatches.items())),
+            "transfer_bytes": dict(sorted(self.transfer_bytes.items())),
+            "resident": {
+                "bytes": dict(sorted(resident.items())),
+                "updates": dict(sorted(self.resident_updates.items())),
+            },
+        }
+
+
+class DeviceObservatory:
+    def __init__(self):
+        self.enabled = True
+        self._lock = threading.Lock()
+        self.total = DeviceScope()
+        self._scopes: List[DeviceScope] = []
+        # warm-tick bookkeeping: the operator bumps the tick; a compile
+        # of a function whose FIRST dispatch happened in an earlier tick
+        # is a warm recompile (a fresh padded bucket, a donation falling
+        # through, an axis change) — the signal behind DeviceRecompile
+        self._tick = 0
+        self._first_tick: Dict[str, int] = {}
+        # compile events not yet drained by export: (fn, seconds, warm)
+        self._pending_compiles: List[Tuple[str, float, bool]] = []
+        # per-owner resident footprints (one ResidentCache per scheduler:
+        # the provisioner's and the deprovisioner's both report; the
+        # consumer-level view sums across owners).  Weak keys: a cache
+        # dying with its Environment must not pin it — or leave a stale
+        # footprint — forever.
+        self._resident_sources: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
+        # totals snapshot at the top of the current tick (flight section)
+        self._tick_base: dict = self._base_snapshot()
+
+    # ------------------------------------------------------------- scopes
+    def begin_scope(self) -> DeviceScope:
+        scope = DeviceScope()
+        with self._lock:
+            self._scopes.append(scope)
+        return scope
+
+    def end_scope(self, scope: DeviceScope) -> DeviceScope:
+        with self._lock:
+            if scope in self._scopes:
+                self._scopes.remove(scope)
+        return scope
+
+    def _all_scopes(self) -> List[DeviceScope]:
+        return [self.total] + self._scopes
+
+    # ------------------------------------------------------------- seams
+    def dispatch(self, name: str, fn, *args, **kwargs):
+        """Invoke a jit entry point through the counted seam (see module
+        docstring).  Returns whatever ``fn`` returns; with the
+        observatory disabled this is a bare passthrough."""
+        if not self.enabled:
+            return fn(*args, **kwargs)
+        nbytes = _transfer_nbytes(args, kwargs)
+        sig = dispatch_signature(args, kwargs)
+        before = _jit_cache_size(fn)
+        t0 = time.perf_counter()
+        with TRACER.span(f"device.{name}", bytes=nbytes):
+            out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            fresh_sig = sig not in self.total.shapes.get(name, ())
+            after = _jit_cache_size(fn)
+            if before is not None and after is not None:
+                compiled = max(0, after - before)
+            else:
+                # no cache introspection: a never-seen signature is the
+                # best available compile proxy
+                compiled = 1 if fresh_sig else 0
+            warm = bool(compiled) and (
+                self._first_tick.get(name, self._tick) < self._tick
+            )
+            self._first_tick.setdefault(name, self._tick)
+            for sc in self._all_scopes():
+                sc.dispatches[name] = sc.dispatches.get(name, 0) + 1
+                sc.shapes.setdefault(name, set()).add(sig)
+                if nbytes:
+                    sc.transfer_bytes[name] = (
+                        sc.transfer_bytes.get(name, 0) + nbytes
+                    )
+                if compiled:
+                    sc.compiles[name] = sc.compiles.get(name, 0) + compiled
+                    sc.compile_s[name] = sc.compile_s.get(name, 0.0) + dt
+                    if warm:
+                        sc.warm_recompiles[name] = (
+                            sc.warm_recompiles.get(name, 0) + 1
+                        )
+            if compiled:
+                self._pending_compiles.append((name, dt, warm))
+        return out
+
+    def put(self, site: str, value, sharding=None):
+        """The ONE counted ``jax.device_put``: every explicit upload
+        routes through here (lint rule 9 fences the raw call sites), so
+        ``karpenter_device_transfer_bytes_total{site}`` covers the whole
+        host->device surface, not just jit-argument uploads."""
+        import jax
+
+        dev = (
+            jax.device_put(value, sharding)
+            if sharding is not None
+            else jax.device_put(value)
+        )
+        if self.enabled:
+            self.count_transfer(site, _leaf_nbytes(value))
+        return dev
+
+    def count_transfer(self, site: str, nbytes: int) -> None:
+        if not self.enabled or nbytes <= 0:
+            return
+        with self._lock:
+            for sc in self._all_scopes():
+                sc.transfer_bytes[site] = (
+                    sc.transfer_bytes.get(site, 0) + nbytes
+                )
+
+    # ----------------------------------------------------------- resident
+    def set_resident_footprint(
+        self, owner, footprint: Dict[str, int]
+    ) -> None:
+        """Replace ONE owner's live device-buffer footprint (consumer ->
+        bytes) — each ResidentCache reports after every seed/evict.
+        Owners are weak-referenced and the merge is computed at READ
+        time (:meth:`resident_footprint`), so a cache dying with its
+        scheduler drops out of the reported footprint on its own —
+        recording the merge at write time would leave a collected
+        cache's bytes lingering until some OTHER cache next reported
+        (steady warm clusters never rebuild, so possibly forever)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._resident_sources[owner] = dict(footprint)
+
+    def _merged_resident(self) -> Dict[str, int]:
+        """Consumer -> bytes summed over the LIVE owners (call under the
+        lock; WeakKeyDictionary iteration is GC-safe)."""
+        merged: Dict[str, int] = {}
+        for fp in self._resident_sources.values():
+            for consumer, v in fp.items():
+                merged[consumer] = merged.get(consumer, 0) + v
+        return merged
+
+    def resident_footprint(self) -> Dict[str, int]:
+        with self._lock:
+            return self._merged_resident()
+
+    def count_resident_update(self, kind: str) -> None:
+        """kind: 'donated' (scatter delta reused donated buffers),
+        'seed' (fresh full-tensor upload), 'noop' (refresh hit with no
+        tensor change)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for sc in self._all_scopes():
+                sc.resident_updates[kind] = (
+                    sc.resident_updates.get(kind, 0) + 1
+                )
+
+    # --------------------------------------------------------------- ticks
+    def _base_snapshot(self) -> dict:
+        t = self.total
+        return {
+            "compiles": sum(t.compiles.values()),
+            "warm_recompiles": sum(t.warm_recompiles.values()),
+            "dispatches": sum(t.dispatches.values()),
+            "transfer_bytes": sum(t.transfer_bytes.values()),
+            "resident_bytes": sum(self._merged_resident().values()),
+        }
+
+    def begin_tick(self, seq: int) -> None:
+        """Mark a reconcile-tick boundary (the operator, right after
+        minting the tick's trace ID): compiles from here on are warm for
+        any function already dispatched in an earlier tick, and the
+        flight recorder's ``device`` section deltas against this point."""
+        with self._lock:
+            self._tick = seq
+            self._tick_base = self._base_snapshot()
+
+    def tick_section(self) -> dict:
+        """The flight recorder's per-tick ``device`` section: what the
+        device layer did THIS tick (deltas vs the begin_tick snapshot)
+        plus the current and per-tick-delta resident footprint."""
+        with self._lock:
+            cur = self._base_snapshot()
+            base = self._tick_base
+            return {
+                "compiles": cur["compiles"] - base["compiles"],
+                "warm_recompiles": (
+                    cur["warm_recompiles"] - base["warm_recompiles"]
+                ),
+                "dispatches": cur["dispatches"] - base["dispatches"],
+                "transfer_bytes": (
+                    cur["transfer_bytes"] - base["transfer_bytes"]
+                ),
+                "resident_bytes": cur["resident_bytes"],
+                "resident_delta_bytes": (
+                    cur["resident_bytes"] - base["resident_bytes"]
+                ),
+            }
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self) -> dict:
+        """The full live picture (the /debug/device payload): process
+        totals per function/site, warm-recompile counts, compile wall
+        seconds, and the resident footprint."""
+        with self._lock:
+            t = self.total
+            resident = self._merged_resident()
+            return {
+                "enabled": self.enabled,
+                "tick": self._tick,
+                "compiles": dict(sorted(t.compiles.items())),
+                "compile_seconds": {
+                    fn: round(s, 6)
+                    for fn, s in sorted(t.compile_s.items())
+                },
+                "warm_recompiles": dict(sorted(t.warm_recompiles.items())),
+                "unique_shapes": t.unique_shapes(),
+                "dispatches": dict(sorted(t.dispatches.items())),
+                "transfer_bytes": dict(sorted(t.transfer_bytes.items())),
+                "resident": {
+                    "bytes": dict(sorted(resident.items())),
+                    "bytes_total": sum(resident.values()),
+                    "updates": dict(sorted(t.resident_updates.items())),
+                },
+            }
+
+
+# the process observatory every seam records into (the TRACER pattern:
+# ops-layer code holds no registry; emission happens at the export seam)
+OBSERVATORY = DeviceObservatory()
+
+
+def export_device_metrics(
+    registry, obs: DeviceObservatory, exported: Optional[dict]
+) -> Tuple[dict, List[dict]]:
+    """Mirror the observatory's monotonic totals into the registry's
+    ``karpenter_device_*`` families by DELTA — the same contract as
+    ``export_compile_cache_counters`` (the caller keeps the state it last
+    exported, so the registry series stay well-formed monotonic counters).
+    Drains the pending compile events into the
+    ``karpenter_device_compile_seconds{fn}`` histogram and returns the
+    warm-recompile attributions (fn + compile seconds) for the caller to
+    turn into ``DeviceRecompile`` ledger events — emission stays with the
+    caller because ledger events enter byte-compared sim traces and
+    jit-cache state is process history, not run behavior."""
+    exported = exported or {}
+    with obs._lock:
+        t = obs.total
+        totals = {
+            "compiles": dict(t.compiles),
+            "warm": dict(t.warm_recompiles),
+            "dispatches": dict(t.dispatches),
+            "transfer": dict(t.transfer_bytes),
+            "updates": dict(t.resident_updates),
+        }
+        resident = obs._merged_resident()
+        pending = obs._pending_compiles
+        obs._pending_compiles = []
+
+    def _inc(metric: str, label: str, key: str) -> Dict[str, float]:
+        prev = exported.get(key, {})
+        cur = totals[key]
+        for name, v in cur.items():
+            d = v - prev.get(name, 0)
+            if d > 0:
+                registry.inc(metric, {label: name}, by=d)
+        return dict(cur)
+
+    new = {
+        "compiles": _inc("karpenter_device_compiles_total", "fn", "compiles"),
+        "warm": _inc(
+            "karpenter_device_warm_recompiles_total", "fn", "warm"
+        ),
+        "dispatches": _inc(
+            "karpenter_device_dispatches_total", "fn", "dispatches"
+        ),
+        "transfer": _inc(
+            "karpenter_device_transfer_bytes_total", "site", "transfer"
+        ),
+        "updates": _inc(
+            "karpenter_device_resident_updates_total", "kind", "updates"
+        ),
+    }
+    for fn, dt, _warm in pending:
+        registry.observe(
+            "karpenter_device_compile_seconds", dt, {"fn": fn}
+        )
+    # gauge family: set current consumers, unset vanished ones (an
+    # evicted resident state's bytes must not linger as a stale series)
+    for consumer in exported.get("resident", {}):
+        if consumer not in resident:
+            registry.unset(
+                "karpenter_device_resident_bytes", {"consumer": consumer}
+            )
+    for consumer, v in resident.items():
+        registry.set(
+            "karpenter_device_resident_bytes", float(v),
+            {"consumer": consumer},
+        )
+    new["resident"] = resident
+    warm_events = [
+        {"fn": fn, "compile_s": round(dt, 6)}
+        for fn, dt, warm in pending
+        if warm
+    ]
+    return new, warm_events

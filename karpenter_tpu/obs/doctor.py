@@ -50,7 +50,17 @@ _FAMILY_SHORT = {
     "karpenter_consolidation_search_phase_seconds": "search",
     "karpenter_reconcile_tick_duration_seconds": "tick",
     "karpenter_provisioner_scheduling_duration_seconds": "scheduling",
+    "karpenter_device_compile_seconds": "device_compile",
 }
+
+# device-rule thresholds: a warm tick's upload bytes must not grow past
+# this factor of the baseline median (with an absolute floor so byte
+# jitter on tiny problems never pages) while its resident delta rows
+# stay flat — more bytes than the delta justifies means the warm path
+# is silently re-uploading something it should have kept resident
+_TRANSFER_FACTOR = 2.0
+_TRANSFER_FLOOR_B = 4096
+_ROWS_SLACK = 1.5
 
 
 def _parse_series(key: str) -> Tuple[str, Dict[str, str]]:
@@ -80,10 +90,29 @@ def phase_series(ticks: List[dict]) -> Dict[str, List[float]]:
             short = _FAMILY_SHORT.get(name)
             if short is None:
                 continue
-            phase = labels.get("phase", "")
+            # device compile series label per jit function, not phase
+            phase = labels.get("phase", "") or labels.get("fn", "")
             pkey = f"{short}/{phase}" if phase else short
             series = out.setdefault(pkey, [0.0] * len(ticks))
             series[i] += float(delta.get("sum_s", 0.0))
+    return out
+
+
+def device_sections(ticks: List[dict]) -> List[dict]:
+    """Per-tick ``device`` sections (obs/device.py tick_section; empty
+    dicts for dumps predating the observatory)."""
+    return [tick.get("device") or {} for tick in ticks]
+
+
+def resident_delta_rows(ticks: List[dict]) -> List[float]:
+    """Per-tick resident scatter rows (the delta-size the transfer rule
+    normalizes upload bytes by), from the flight hist deltas."""
+    out = []
+    for tick in ticks:
+        delta = tick.get("hists", {}).get(
+            "karpenter_solver_resident_delta_rows", {}
+        )
+        out.append(float(delta.get("sum_s", 0.0)))
     return out
 
 
@@ -142,10 +171,13 @@ def suspected_causes(
     events: List[Tuple[int, dict]],
     phases: Dict[str, dict],
     bench_verdict: Optional[dict] = None,
+    split: Optional[int] = None,
 ) -> List[str]:
     causes: List[str] = []
     regressing = [k for k, p in phases.items() if p["regressing"]]
     breaches = [(i, ev) for i, ev in events if ev.get("type") == "SLOBreach"]
+    if split is None:
+        split = _split_index(ticks, events)
 
     # catalog roll -> compile-cache miss storm -> compile-phase blowup
     rolls = [(i, ev) for i, ev in events if ev.get("type") == "CatalogRolled"]
@@ -191,6 +223,83 @@ def suspected_causes(
                 f"CircuitOpen on {op_ev.get('attrs', {}).get('api', '?')} "
                 f"(seq {op_ev.get('seq')}) preceded a provisioning stall: "
                 f"pending peaked at {max(pending[i:], default=0)} afterwards"
+            )
+
+    # ---- device observatory rules (obs/device.py tick sections) -------
+    dev = device_sections(ticks)
+    compiles = [int(d.get("compiles", 0) or 0) for d in dev]
+    warm = [int(d.get("warm_recompiles", 0) or 0) for d in dev]
+
+    # device recompile storm: XLA compile activity concentrated AFTER a
+    # catalog roll — the device-layer twin of the compile-cache-miss
+    # rule above (the roll obsoletes the resident tensors and the padded
+    # shapes, so every jit entry point retraces)
+    storm_named = False
+    if rolls and any(compiles):
+        i, roll = rolls[0]
+        before, after = sum(compiles[:i]), sum(compiles[i:])
+        if after > before:
+            storm_named = True
+            msg = (
+                f"device recompile storm after the catalog roll "
+                f"(CatalogRolled seq {roll.get('seq')}, tick "
+                f"{roll.get('trace_id') or i}): {after} device compile(s) "
+                f"in the {len(ticks) - i} tick(s) after vs {before} before"
+            )
+            if sum(warm[i:]):
+                msg += f", {sum(warm[i:])} on warm jit entry points"
+            dc_keys = [
+                k for k in regressing if k.startswith("device_compile")
+            ]
+            if dc_keys:
+                p = phases[dc_keys[0]]
+                msg += (
+                    f"; compile time '{dc_keys[0]}' regressed to "
+                    f"{p['recent_ms']}ms (baseline {p['baseline_ms']}ms)"
+                )
+            causes.append(msg)
+    if sum(warm) and not storm_named:
+        # warm recompiles the storm rule did NOT explain — either no
+        # roll at all, or a roll with no compile spike behind it:
+        # something is retracing on a steady cluster (bucket churn, a
+        # donation falling through)
+        first = next(i for i, w in enumerate(warm) if w)
+        causes.append(
+            f"{sum(warm)} warm-tick device recompile(s) not explained "
+            f"by a catalog roll (first at tick {first}): a jit entry "
+            "point is retracing on a steady cluster — look for padded-"
+            "bucket churn or a failed buffer donation"
+        )
+
+    # transfer regression: warm ticks uploading more than their resident
+    # delta rows justify — the warm path's contract is that a tick ships
+    # only its scatter payloads (docs/designs/observability.md §device)
+    xfer = [int(d.get("transfer_bytes", 0) or 0) for d in dev]
+    if any(xfer):
+        rows = resident_delta_rows(ticks)
+        base_b, rec_b = _median(xfer[:split]), _median(xfer[split:])
+        base_r, rec_r = _median(rows[:split]), _median(rows[split:])
+        if (
+            rec_b > base_b * _TRANSFER_FACTOR
+            and rec_b - base_b > _TRANSFER_FLOOR_B
+            and rec_r <= base_r * _ROWS_SLACK + 1.0
+        ):
+            causes.append(
+                f"warm-tick transfer regression: ticks past the split "
+                f"upload a median {int(rec_b)}B vs {int(base_b)}B "
+                f"baseline while resident delta rows stayed flat "
+                f"({base_r:g} -> {rec_r:g}) — the uploads are not "
+                "justified by the cluster delta"
+            )
+
+    # warm-recompile attributions are causes by construction
+    for i, ev in events:
+        if ev.get("type") == "DeviceRecompile":
+            a = ev.get("attrs", {})
+            causes.append(
+                f"warm recompile of device fn '{a.get('fn', '?')}' at "
+                f"tick {i} ({a.get('compile_s')}s of compile time on the "
+                "hot path)"
             )
 
     # anomaly attributions are causes by construction
@@ -248,9 +357,28 @@ def diagnose(
         "regressing_phases": [
             k for k, p in phases.items() if p["regressing"]
         ],
+        "device": {
+            "compiles": sum(
+                int(d.get("compiles", 0) or 0) for d in device_sections(ticks)
+            ),
+            "warm_recompiles": sum(
+                int(d.get("warm_recompiles", 0) or 0)
+                for d in device_sections(ticks)
+            ),
+            "transfer_bytes": sum(
+                int(d.get("transfer_bytes", 0) or 0)
+                for d in device_sections(ticks)
+            ),
+            "resident_bytes_final": int(
+                (device_sections(ticks)[-1] if ticks else {}).get(
+                    "resident_bytes", 0
+                )
+                or 0
+            ),
+        },
         "timeline": timeline,
         "suspected_causes": suspected_causes(
-            ticks, events, phases, bench_verdict
+            ticks, events, phases, bench_verdict, split=split
         ),
     }
 
@@ -266,6 +394,14 @@ def render_diagnosis(diag: dict) -> str:
         f"SLO breaches: {len(diag['breaches'])}, recoveries: "
         f"{len(diag['recoveries'])}"
     )
+    dev = diag.get("device") or {}
+    if any(dev.values()):
+        out.append(
+            f"device: {dev.get('compiles', 0)} compile(s) "
+            f"({dev.get('warm_recompiles', 0)} warm), "
+            f"{dev.get('transfer_bytes', 0)}B uploaded, "
+            f"{dev.get('resident_bytes_final', 0)}B resident at dump time"
+        )
     out.append("")
     out.append("phases vs rolling baseline (recent = ticks past the "
                f"split at tick {diag['split_tick']}):")

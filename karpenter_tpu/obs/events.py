@@ -30,6 +30,11 @@ Event types (emitted at the existing decision sites):
 - ``AnomalyDetected`` streaming anomaly detection (obs/detect.py): a
                       phase-latency sample blew past its rolling robust
                       baseline, attrs carry the attribution
+- ``DeviceRecompile`` device observatory (obs/device.py): a jit entry
+                      point recompiled on a WARM tick (it already had
+                      dispatches in an earlier tick) — a fresh padded
+                      bucket, an axis change, a donation falling
+                      through; attrs carry fn + compile seconds
 
 Every event stamps the current trace ID (obs/context.py), so the ledger
 joins the span timeline on the same key.  Emission also bumps
@@ -64,6 +69,7 @@ CATALOG_ROLLED = "CatalogRolled"
 SLO_BREACH = "SLOBreach"
 SLO_RECOVERED = "SLORecovered"
 ANOMALY_DETECTED = "AnomalyDetected"
+DEVICE_RECOMPILE = "DeviceRecompile"
 
 EVENT_TYPES = (
     POD_NOMINATED,
@@ -77,6 +83,7 @@ EVENT_TYPES = (
     SLO_BREACH,
     SLO_RECOVERED,
     ANOMALY_DETECTED,
+    DEVICE_RECOMPILE,
 )
 
 # bounded history: several hundred ticks of decisions on a busy cluster

@@ -47,6 +47,10 @@ DELTA_HISTOGRAMS = (
     "karpenter_consolidation_search_phase_seconds",
     "karpenter_reconcile_tick_duration_seconds",
     "karpenter_provisioner_scheduling_duration_seconds",
+    # device observatory (obs/device.py): per-tick compile time and the
+    # resident scatter sizes the doctor's transfer rule normalizes by
+    "karpenter_device_compile_seconds",
+    "karpenter_solver_resident_delta_rows",
 )
 
 
@@ -110,9 +114,14 @@ class FlightRecorder:
         trace_id: str,
         duration_s: float,
         summary: Optional[dict] = None,
+        device: Optional[dict] = None,
     ) -> dict:
         """Capture one tick's context into the ring (the operator calls
-        this at the end of every reconcile tick)."""
+        this at the end of every reconcile tick).  ``device`` is the
+        observatory's per-tick section (obs/device.py tick_section):
+        compiles / warm recompiles / transfer bytes this tick plus the
+        current resident footprint — what the doctor's device rules
+        read."""
         events: List[dict] = []
         dropped = 0
         if self.ledger is not None:
@@ -144,6 +153,7 @@ class FlightRecorder:
             "spans": spans,
             "counters": self._counter_deltas(),
             "hists": self._hist_deltas(),
+            "device": dict(device or {}),
         }
         with self._lock:
             self._ring.append(entry)

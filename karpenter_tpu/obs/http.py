@@ -24,7 +24,12 @@ that surface for the reproduction, mounted on BOTH the operator process
                 Perfetto-loadable timeline;
 - ``/debug/flight``  the flight recorder's ring (obs/flight.py) as
                 JSONL — the same artifact a breach dumps to disk, for
-                ``python -m karpenter_tpu doctor http://host:port``.
+                ``python -m karpenter_tpu doctor http://host:port``;
+- ``/debug/device``  the device observatory's live snapshot
+                (obs/device.py): compiles / warm recompiles / compile
+                seconds per jit entry point, transfer bytes per site,
+                and the resident device-buffer footprint per consumer —
+                "what lives on the device and what crossed the link".
 
 Every request bumps ``karpenter_telemetry_scrapes_total{endpoint}`` so
 the scrape cadence is itself observable (a stalled scraper is an
@@ -117,6 +122,7 @@ def start_telemetry(
     tracer=None,
     ledger=None,
     flight=None,
+    device=None,
     host: str = "",
 ) -> ThreadingHTTPServer:
     """Serve the telemetry surface on (host, port) in a daemon thread;
@@ -127,7 +133,8 @@ def start_telemetry(
         def do_GET(self):  # noqa: N802 (http.server API)
             path, _, query = self.path.partition("?")
             known = (
-                "/metrics", "/healthz", "/events", "/trace", "/debug/flight",
+                "/metrics", "/healthz", "/events", "/trace",
+                "/debug/flight", "/debug/device",
             )
             if path not in known:
                 self.send_response(404)
@@ -168,6 +175,12 @@ def start_telemetry(
                     )
                 body = ("\n".join(lines) + "\n").encode() if lines else b""
                 ctype = "application/x-ndjson"
+            elif path == "/debug/device":
+                payload = (
+                    device.snapshot() if device is not None else {}
+                )
+                body = json.dumps(payload, sort_keys=True).encode()
+                ctype = "application/json"
             else:  # /trace
                 payload = (
                     _trace_payload(tracer) if tracer is not None else {}

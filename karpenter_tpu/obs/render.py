@@ -281,6 +281,26 @@ def chrome_from_flight(flight: dict) -> dict:
                         "args": {counter: tick["summary"][counter]},
                     }
                 )
+        # device observatory section -> counter tracks: the per-tick
+        # upload bytes and compile counts sit on the timeline next to
+        # the tick durations, so a recompile storm or transfer spike is
+        # visible at the same glance as the phase slices
+        dev = tick.get("device") or {}
+        for counter in (
+            "transfer_bytes", "compiles", "warm_recompiles",
+            "resident_bytes",
+        ):
+            if counter in dev:
+                events.append(
+                    {
+                        "name": f"device.{counter}",
+                        "ph": "C",
+                        "ts": ts(tick["ts"]),
+                        "pid": 1,
+                        "tid": 0,
+                        "args": {counter: dev[counter]},
+                    }
+                )
     events += [
         {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
          "args": {"name": "ticks"}},

@@ -39,6 +39,7 @@ from karpenter_tpu.metrics.decorators import MetricsCloudProvider
 from karpenter_tpu.metrics.registry import REGISTRY, Registry
 from karpenter_tpu.obs.context import current_trace_id, mint_trace_id, set_tick
 from karpenter_tpu.obs.detect import AnomalyDetector
+from karpenter_tpu.obs.device import OBSERVATORY, export_device_metrics
 from karpenter_tpu.obs.events import EventLedger
 from karpenter_tpu.obs.flight import FlightRecorder
 from karpenter_tpu.obs.slo import SLOEngine, default_rules
@@ -128,6 +129,14 @@ class Operator:
             self.clock, registry, ledger=self.ledger, tracer=self.tracer,
             capacity=self.settings.flight_ticks,
         )
+        # device observatory (obs/device.py): compile/transfer/resident
+        # telemetry behind the dispatch boundary.  Process-global like
+        # the tracer; the diagnosis tail exports its per-tick deltas into
+        # this registry and snapshots the flight recorder's `device`
+        # section from it.  The enabled flag only gates COUNTING — the
+        # twin-run test proves on/off changes zero scheduling actions.
+        OBSERVATORY.enabled = self.settings.enable_device_observatory
+        self._dev_exported: Optional[dict] = None
         # out-of-band dump requests (SIGUSR1) land here and are honored
         # at the next tick's diagnosis tail: a signal handler must never
         # dump directly — it runs on the main thread and would deadlock
@@ -328,6 +337,10 @@ class Operator:
                 self.elector.identity if self.elector is not None else "",
             )
         )
+        # tick boundary for the device observatory: compiles from here on
+        # count warm for any jit already dispatched in an earlier tick,
+        # and the flight `device` section deltas against this point
+        OBSERVATORY.begin_tick(self._tick_seq)
         # the diagnosis tail runs even when the tick abdicates or a
         # controller layer raises: a minted tick is a recorded tick
         t0 = time.perf_counter()
@@ -397,6 +410,20 @@ class Operator:
         self.registry.observe(
             "karpenter_reconcile_tick_duration_seconds", dur_s
         )
+        # device observatory export BEFORE the SLO/anomaly/flight passes:
+        # the karpenter_device_* counter deltas and the compile-seconds
+        # samples must land in the registry this tick so the detector can
+        # judge them and the flight slice diffs them.  Warm-recompile
+        # ledger events ride the anomaly-detection gate: like wall-clock
+        # anomaly judgments, a recompile depends on process history (what
+        # earlier runs already compiled), which byte-compared sim traces
+        # must not contain.
+        self._dev_exported, warm_recompiles = export_device_metrics(
+            self.registry, OBSERVATORY, self._dev_exported
+        )
+        if self.detector.enabled:
+            for ev in warm_recompiles:
+                self.registry.event("DeviceRecompile", **ev)
         breaches = self.slo.evaluate()
         self.detector.scan()
         summary = {
@@ -410,7 +437,8 @@ class Operator:
                 1 for i in instances.values() if i.state == "running"
             )
         self.flight.record(
-            self._tick_seq, current_trace_id(), dur_s, summary
+            self._tick_seq, current_trace_id(), dur_s, summary,
+            device=OBSERVATORY.tick_section(),
         )
         request = self._flight_request
         if request:

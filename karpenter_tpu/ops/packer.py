@@ -36,8 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from karpenter_tpu.obs.device import OBSERVATORY
 from karpenter_tpu.ops.tensorize import CompiledProblem
-from karpenter_tpu.utils.trace import phase
+from karpenter_tpu.utils.trace import TRACER, phase
 
 
 class PackResult(NamedTuple):
@@ -732,7 +733,8 @@ def run_population_verdicts(
     (req, _cnt, maxper, slot, feas, alloc, price, openable,
      used0, cfg0, npods0, e0, sig0) = padded_args
     with phase("dispatch"):
-        out = population_verdict_kernel(
+        out = OBSERVATORY.dispatch(
+            "population_verdict_kernel", population_verdict_kernel,
             req, maxper, slot, feas, alloc, price, openable,
             used0, cfg0, npods0, e0, sig0,
             pool_id, zone_id, ct_id, compactable,
@@ -740,7 +742,9 @@ def run_population_verdicts(
             jnp.int32(occ_span), masks,
             k_slots=k_slots, objective=objective,
         )
-    with phase("device_block"):
+    with phase("device_block"), TRACER.span(
+        "device.block.population_verdict_kernel"
+    ):
         return np.asarray(out)
 
 
@@ -763,14 +767,17 @@ def run_removal_verdicts(
     (req, _cnt, maxper, slot, feas, alloc, price, openable,
      used0, cfg0, npods0, e0, sig0) = padded_args
     with phase("dispatch"):
-        out = removal_verdict_kernel(
+        out = OBSERVATORY.dispatch(
+            "removal_verdict_kernel", removal_verdict_kernel,
             req, maxper, slot, feas, alloc, price, openable,
             used0, cfg0, npods0, e0, sig0,
             pool_id, zone_id, ct_id, compactable,
             cnt_b, rm_b, perm_b,
             k_slots=k_slots, objective=objective,
         )
-    with phase("device_block"):
+    with phase("device_block"), TRACER.span(
+        "device.block.removal_verdict_kernel"
+    ):
         return np.asarray(out)
 
 
@@ -784,9 +791,10 @@ def run_removal_verdicts(
 _DEVICE_CACHE_CAP = 32
 
 
-def cached_device_put(cache: dict, srcs: tuple, extra_key: tuple, build, shardings=None):
-    import jax as _jax
-
+def cached_device_put(
+    cache: dict, srcs: tuple, extra_key: tuple, build, shardings=None,
+    site: str = "device_constants",
+):
     key = tuple(id(s) for s in srcs) + extra_key
     ent = cache.get(key)
     if ent is not None and all(a is b for a, b in zip(ent[0], srcs)):
@@ -794,7 +802,9 @@ def cached_device_put(cache: dict, srcs: tuple, extra_key: tuple, build, shardin
         cache[key] = ent
         return ent[1]
     built = build()
-    dev = _jax.device_put(built, shardings) if shardings else _jax.device_put(built)
+    # the counted seam (obs/device.py): a cache miss is a real upload,
+    # attributed to the caller's `site`; a hit transfers nothing
+    dev = OBSERVATORY.put(site, built, shardings if shardings else None)
     while len(cache) >= _DEVICE_CACHE_CAP:
         cache.pop(next(iter(cache)))  # evict ONLY the least-recently-used
     cache[key] = (srcs, dev)
@@ -810,6 +820,7 @@ def _device_constants(prob, alloc_p, price_p, openable_p):
         (prob.alloc, prob.price, prob.openable),
         (alloc_p.shape,),
         lambda: (alloc_p, price_p, openable_p),
+        site="pack_constants",
     )
 
 
@@ -840,7 +851,8 @@ def run_pack(
         Cp = alloc_h.shape[0]
         Sp = sig0.shape[0]
         buf = build_input_buffer(args)
-    bundle, res = pack_kernel_buffered(
+    bundle, res = OBSERVATORY.dispatch(
+        "pack_kernel_buffered", pack_kernel_buffered,
         buf, alloc, price, openable,
         Gp=Gp, Cp=Cp, Kp=Kp, R=R, Sp=Sp, objective=objective,
     )

@@ -336,6 +336,7 @@ def _pallas_device_constants(prob: CompiledProblem, cr: int, R: int):
         (prob.alloc, prob.price, prob.openable),
         (cr,),
         build,
+        site="pallas_constants",
     )
 
 
@@ -371,7 +372,12 @@ def dispatch_pack_pallas(
         interpret = jax.devices()[0].platform != "tpu"
     with phase("pad"):
         pos, statics, ctx = _pad_pallas(prob, k_slots)
-    out = _pallas_pack(*pos, objective=objective, interpret=interpret, **statics)
+    from karpenter_tpu.obs.device import OBSERVATORY
+
+    out = OBSERVATORY.dispatch(
+        "pallas_pack", _pallas_pack, *pos,
+        objective=objective, interpret=interpret, **statics,
+    )
     return out, ctx
 
 

@@ -55,6 +55,7 @@ import numpy as np
 
 from karpenter_tpu.api import Pod
 from karpenter_tpu.api import labels as L
+from karpenter_tpu.obs.device import OBSERVATORY
 from karpenter_tpu.ops.tensorize import (
     BIG,
     Catalog,
@@ -275,6 +276,10 @@ class ResidentState:
     """One device-resident padded problem plus the metadata to diff it."""
 
     def __init__(self):
+        # which solver purpose seeded this state ("solve" = the pending
+        # batch, "removal" = the consolidation base universe) — the
+        # consumer label on karpenter_device_resident_bytes
+        self.consumer = "solve"
         # identity / catalog epoch
         self.cat_key: tuple = ()
         self.axes: Tuple[str, ...] = ()
@@ -311,7 +316,10 @@ class ResidentState:
 
     # -------------------------------------------------------------- build
     @classmethod
-    def build(cls, solver, pods: List[Pod], prob: CompiledProblem, catalog):
+    def build(
+        cls, solver, pods: List[Pod], prob: CompiledProblem, catalog,
+        consumer: str = "solve",
+    ):
         """Seed a state from a freshly-compiled problem, or None when the
         problem falls outside the resident-expressible shape."""
         if prob is None or not prob.supported or prob.compile_relaxed:
@@ -337,6 +345,7 @@ class ResidentState:
         if not _carrier_free(solver.existing):
             return None  # carriers (even on non-live nodes) change the partition
         st = cls()
+        st.consumer = consumer
         st.cat_key = _catalog_key(solver)
         st.axes = prob.axes
         st.catalog = catalog
@@ -396,9 +405,11 @@ class ResidentState:
     def _device_seed(self) -> None:
         """Upload the mirrors once (the rebuild's one full transfer) with
         the pack backend's shardings, plus the pack-time constants the
-        plain shape never mutates (maxper=BIG, slot=0, sig0=0)."""
-        import jax
-
+        plain shape never mutates (maxper=BIG, slot=0, sig0=0).  Every
+        upload rides the counted seam (obs/device.py) under the
+        ``resident_seed`` site, and the fresh allocation is what the
+        ``seed`` entry of karpenter_device_resident_updates_total counts
+        — vs ``donated`` warm updates that allocate nothing."""
         E = len(self.live)
         cfg0 = np.full(self.Kp, -1, np.int32)
         cfg0[:E] = np.arange(self.fe, self.fe + E, dtype=np.int32)
@@ -408,11 +419,14 @@ class ResidentState:
         if self.mesh is None:
             names = ("repl", "on_c", "on_c2", "on_gc", "on_k", "on_k2",
                      "on_sk")
-            put = {k: jax.device_put for k in names}
+            put = {
+                k: (lambda a: OBSERVATORY.put("resident_seed", a))
+                for k in names
+            }
         else:
             sh = _mesh_shardings(self.mesh)
             put = {
-                k: (lambda a, s=s: jax.device_put(a, s))
+                k: (lambda a, s=s: OBSERVATORY.put("resident_seed", a, s))
                 for k, s in sh.items()
             }
         self.d_req = put["repl"](self.h_req)
@@ -427,6 +441,20 @@ class ResidentState:
         self.d_maxper = put["repl"](maxper)
         self.d_slot = put["repl"](slot)
         self.d_sig0 = put["on_sk"](sig0)
+        OBSERVATORY.count_resident_update("seed")
+
+    def device_bytes(self) -> int:
+        """Live device-buffer footprint of this state (logical bytes;
+        sharded buffers report their global size)."""
+        total = 0
+        for a in (
+            self.d_req, self.d_cnt, self.d_feas, self.d_alloc,
+            self.d_price, self.d_openable, self.d_used0, self.d_npods0,
+            self.d_cfg0, self.d_maxper, self.d_slot, self.d_sig0,
+        ):
+            if a is not None:
+                total += int(a.nbytes)
+        return total
 
     # ------------------------------------------------------------ refresh
     def try_refresh(
@@ -708,10 +736,15 @@ class ResidentState:
                 warnings.filterwarnings(
                     "ignore", message=".*donated.*", category=UserWarning
                 )
+                # the counted seam attributes the scatter-payload upload
+                # (the permutations + changed rows/cols — the ONLY host
+                # arrays here; the seven buffers are device-resident and
+                # transfer nothing)
                 (
                     self.d_req, self.d_cnt, self.d_feas, self.d_alloc,
                     self.d_price, self.d_used0, self.d_npods0, self.d_cfg0,
-                ) = fn(
+                ) = OBSERVATORY.dispatch(
+                    "resident_delta", fn,
                     self.d_req, self.d_cnt, self.d_feas, self.d_alloc,
                     self.d_price, self.d_used0, self.d_npods0,
                     g_perm, c_perm, k_perm,
@@ -722,6 +755,9 @@ class ResidentState:
                     k_idx, self.h_used0[k_idx], self.h_npods0[k_idx],
                     np.int32(E_new), np.int32(fe),
                 )
+            OBSERVATORY.count_resident_update("donated")
+        else:
+            OBSERVATORY.count_resident_update("noop")
         # ---- bookkeeping -------------------------------------------------
         self.cls = new_cls
         self.slot_of = {c.key: g for g, c in enumerate(new_cls)}
@@ -816,14 +852,16 @@ class ResidentState:
             from karpenter_tpu.parallel.mesh import _sharded_pack
 
             fn = _sharded_pack(self.mesh, self.Kp, objective)
-            return fn(
+            return OBSERVATORY.dispatch(
+                "mesh_pack", fn,
                 self.d_req, self.d_cnt, self.d_maxper, self.d_slot,
                 self.d_feas, self.d_alloc, self.d_price, self.d_openable,
                 self.d_used0, self.d_cfg0, self.d_npods0, E, self.d_sig0,
             )
         from karpenter_tpu.ops.packer import pack_kernel
 
-        return pack_kernel(
+        return OBSERVATORY.dispatch(
+            "pack_kernel", pack_kernel,
             self.d_req, self.d_cnt, self.d_maxper, self.d_slot,
             self.d_feas, self.d_alloc, self.d_price, self.d_openable,
             self.d_used0, self.d_cfg0, self.d_npods0, E, self.d_sig0,
@@ -877,18 +915,33 @@ class ResidentCache:
         return None
 
     def rebuild(
-        self, solver, pods: List[Pod], prob: CompiledProblem, catalog
+        self, solver, pods: List[Pod], prob: CompiledProblem, catalog,
+        consumer: str = "solve",
     ) -> Optional[ResidentState]:
         if catalog is None or not resident_capable(solver.pack_fn):
             return None
         with phase("delta"):
-            st = ResidentState.build(solver, pods, prob, catalog)
+            st = ResidentState.build(
+                solver, pods, prob, catalog, consumer=consumer
+            )
         if st is None:
             return None
         while len(self.states) >= self.CAP:
             self.states.pop(0)
         self.states.append(st)
+        self._report_footprint()
         return st
+
+    def footprint(self) -> Dict[str, int]:
+        """Live device-buffer bytes per consumer across the cache's
+        states — the karpenter_device_resident_bytes{consumer} truth."""
+        out: Dict[str, int] = {}
+        for st in self.states:
+            out[st.consumer] = out.get(st.consumer, 0) + st.device_bytes()
+        return out
+
+    def _report_footprint(self) -> None:
+        OBSERVATORY.set_resident_footprint(self, self.footprint())
 
     def match(self, prob: CompiledProblem, pack_fn=None) -> Optional[ResidentState]:
         """The state whose CURRENT snapshot is exactly `prob` (identity):

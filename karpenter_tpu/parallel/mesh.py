@@ -196,6 +196,8 @@ def mesh_pack_fn(mesh: Optional[Mesh] = None):
     on_c2 = NamedSharding(mesh, P(MODEL_AXIS, None))
 
     def pack(prob, k_slots: int = 0, objective: str = "nodes") -> PackResult:
+        from karpenter_tpu.obs.device import OBSERVATORY
+
         # the "data" axis shards the node-slot bucket; keep it divisible
         if k_slots <= 0:
             k_slots = node_slot_bound(prob)
@@ -210,8 +212,10 @@ def mesh_pack_fn(mesh: Optional[Mesh] = None):
             (alloc.shape, mesh),
             lambda: (alloc, price, openable),
             shardings=(on_c2, on_c, on_c),
+            site="mesh_constants",
         )
-        return _sharded_pack(mesh, kp, objective)(
+        return OBSERVATORY.dispatch(
+            "mesh_pack", _sharded_pack(mesh, kp, objective),
             req, cnt, maxper, slot, feas, alloc, price, openable,
             used0, cfg0, npods0, e0, sig0,
         )

@@ -24,6 +24,7 @@ from karpenter_tpu.api import labels as L
 from karpenter_tpu.api.objects import selector_matches
 from karpenter_tpu.api.requirements import Op
 from karpenter_tpu.api.resources import Resources
+from karpenter_tpu.obs.device import OBSERVATORY
 from karpenter_tpu.ops.pallas_packer import auto_pack
 from karpenter_tpu.ops.resident import ResidentCache, resident_capable
 from karpenter_tpu.ops.tensorize import (
@@ -352,7 +353,7 @@ class TensorScheduler:
                     if self.pack_fn is None:
                         self.pack_fn = default_pack_fn()
                     resident = self._resident.rebuild(
-                        self, pods, prob, self._catalog
+                        self, pods, prob, self._catalog, consumer="solve"
                     )
                     if resident is not None:
                         self.resident_rebuilds += 1
@@ -1199,7 +1200,7 @@ class TensorScheduler:
             if self.pack_fn is None:
                 self.pack_fn = default_pack_fn()
             if self._resident.rebuild(
-                self, pods, prob, self._catalog
+                self, pods, prob, self._catalog, consumer="removal"
             ) is not None:
                 self.resident_rebuilds += 1
         base = _RemovalBase()
@@ -1215,11 +1216,12 @@ class TensorScheduler:
         # repeated dispatches — and warm passes across reconciles, via the
         # removal cache — stop re-uploading the class/config tensors on
         # every verdict batch (each jit call transfers host arrays anew;
-        # device-resident args transfer nothing)
-        import jax
-
+        # device-resident args transfer nothing).  Counted seam: this is
+        # the one full upload a consolidation pass pays per base.
         base.args = tuple(
-            jax.device_put(a) if isinstance(a, np.ndarray) and a.ndim else a
+            OBSERVATORY.put("removal_base", a)
+            if isinstance(a, np.ndarray) and a.ndim
+            else a
             for a in base.args
         )
         base.gp = base.args[0].shape[0]
@@ -1318,14 +1320,12 @@ class TensorScheduler:
         sort_rank = np.zeros(base.gp, np.int32)
         for g, v in base.sort_key.items():
             sort_rank[g] = ranks[v]
-        import jax
-
-        # device-resident like base.args: the population round re-uploads
-        # only its masks, never the candidate tensors
-        base.cand_cnt = jax.device_put(cand_cnt)
-        base.cand_slot = jax.device_put(cand_slot)
-        base.cand_occ = jax.device_put(cand_occ)
-        base.sort_rank = jax.device_put(sort_rank)
+        # device-resident like base.args (counted seam): the population
+        # round re-uploads only its masks, never the candidate tensors
+        base.cand_cnt = OBSERVATORY.put("population_tensors", cand_cnt)
+        base.cand_slot = OBSERVATORY.put("population_tensors", cand_slot)
+        base.cand_occ = OBSERVATORY.put("population_tensors", cand_occ)
+        base.sort_rank = OBSERVATORY.put("population_tensors", sort_rank)
         base.occ_span = occ_span
 
     def _plan_live_join(self, unsupported: List[Pod], assignments):

@@ -123,6 +123,24 @@ def _resident_section(registry) -> dict:
     }
 
 
+def _device_section(runner) -> dict:
+    """Device-observatory accounting for THIS run (obs/device.py scope):
+    would-compile counts (distinct dispatch signatures — jit-cache
+    growth is process history and would not replay), dispatches,
+    transfer bytes per site, and the resident footprint/update counts.
+    Counts and bytes only — never wall-clock seconds — so the section is
+    byte-identical across record/replay, the same discipline that keeps
+    anomaly detection out of the sim.  The resident footprint reads the
+    run's OWN schedulers (the process-wide observatory view merges every
+    live cache, including a previous run's not-yet-collected one)."""
+    op = runner.env.operator
+    resident: Dict[str, int] = {}
+    for sched in (op.provisioner.scheduler, op.disruption._scheduler):
+        for consumer, v in sched._resident.footprint().items():
+            resident[consumer] = resident.get(consumer, 0) + v
+    return runner.device_scope.device_section(resident=resident)
+
+
 def build_report(runner) -> dict:
     env = runner.env
     registry = env.registry
@@ -230,6 +248,9 @@ def build_report(runner) -> dict:
             },
         },
         "consolidation": _consolidation_section(registry),
+        # the on-device half of the tick (obs/device.py): what would
+        # compile, what crossed the link, what stays resident
+        "device": _device_section(runner),
         "events": dict(sorted(runner.event_counts.items())),
         # the operator's OWN decision timeline (obs/events.py), distinct
         # from `events` above (what the scenario injected): what the

@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu.api import Pod, Resources, Settings
 from karpenter_tpu.api.objects import reset_name_sequences
+from karpenter_tpu.obs.device import OBSERVATORY, DeviceScope
 from karpenter_tpu.cloud.fake.backend import (
     CloudAPIError,
     FakeImage,
@@ -171,6 +172,17 @@ class ScenarioRunner:
         # ledger surface
         op.slo.replace_rules(scenario.slo_rules)
         op.detector.enabled = False
+        # device observatory scope: per-run compile/transfer/resident
+        # accounting for the report's `device` section.  Scoped counters
+        # are DETERMINISTIC (distinct dispatch signatures, not jit-cache
+        # growth — cache state is process history, and a second run in
+        # the same process would otherwise report zero compiles), so the
+        # section is part of the byte-compared report surface.  The
+        # inert placeholder is swapped for a REGISTERED scope inside
+        # run()'s try/finally — registering here would leak a
+        # permanently active scope if construction fails or the runner
+        # is never run.
+        self.device_scope = DeviceScope()
         self.env.cloud.chaos.reseed(seed + 1)
         self.rng = random.Random(seed)
         self.view = SimView(self)
@@ -344,9 +356,11 @@ class ScenarioRunner:
         the deterministic SLO report.  The trace is closed even when a
         tick raises — a crashing run's trace is exactly the artifact a
         reproduction needs."""
+        self.device_scope = OBSERVATORY.begin_scope()
         try:
             return self._run()
         finally:
+            OBSERVATORY.end_scope(self.device_scope)
             if self.trace is not None:
                 self.trace.close()
 

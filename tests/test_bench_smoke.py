@@ -121,6 +121,43 @@ class TestBenchSmoke:
         assert line["sequential_ms"] > 0
         assert line["speedup_vs_sequential"] > 0
 
+    def test_solve_lines_carry_device_counters(self, bench_lines):
+        """Every solve-style line reports the device observatory's cold
+        vs warm split: compile counts and transfer bytes for the first
+        solve + warmups vs the measured window."""
+        for line in bench_lines:
+            if not line["metric"].startswith(
+                ("schedule_", "consolidation_")
+            ):
+                continue
+            for f in (
+                "compile_count_cold", "compile_count_warm",
+                "transfer_bytes_cold", "transfer_bytes_warm",
+            ):
+                assert f in line, (line["metric"], f)
+                assert line[f] >= 0, (line["metric"], f, line[f])
+        # somewhere the cold windows did real device work (all-zero
+        # columns would mean the seams came unwired); honest zeros exist
+        # — the repack line's scheduler is settle-warmed before the
+        # window opens, and the sidecar's transfers belong to the remote
+        # process
+        assert any(
+            line.get("transfer_bytes_cold", 0) > 0 for line in bench_lines
+        )
+        assert any(
+            line.get("compile_count_cold", 0) > 0 for line in bench_lines
+        )
+
+    def test_flagship_warm_window_compiles_nothing(self, bench_lines):
+        """Acceptance: the flagship warm line shows compile_count == 0
+        (every measured solve replays cached programs) and its transfer
+        bytes bounded by the delta — an unchanged cluster re-serving the
+        resident snapshot ships NOTHING."""
+        line = bench_lines[-1]
+        assert line["metric"] == "schedule_10k_pods_500_types_p50"
+        assert line["compile_count_warm"] == 0, line
+        assert line["transfer_bytes_warm"] == 0, line
+
     def test_scale_restored_after_tiny_run(self, bench_lines):
         assert bench.SCALE == 1.0 and bench.ITERS == 21
 
@@ -283,3 +320,28 @@ class TestMarginalEstimate:
         by = {l["metric"]: l for l in verdict["lines"]}
         assert by["a_p50"]["warm_delta_pct"] == pytest.approx(40.0)
         assert "warm_delta_pct" not in by["b_p50"]
+
+    def test_silent_recompile_gates_even_when_p50_got_lucky(self):
+        """The device-observatory gate: a line whose warm window went
+        from compiling nothing to compiling SOMETHING regresses — even
+        with a faster p50.  Absent counters (pre-observatory baselines)
+        never gate; a warm count that was already nonzero does not gate
+        on staying nonzero."""
+        old = [
+            {"metric": "a_p50", "value": 100.0, "compile_count_warm": 0},
+            {"metric": "b_p50", "value": 100.0},
+            {"metric": "c_p50", "value": 100.0, "compile_count_warm": 2},
+        ]
+        new = [
+            {"metric": "a_p50", "value": 80.0, "compile_count_warm": 3},
+            {"metric": "b_p50", "value": 99.0, "compile_count_warm": 1},
+            {"metric": "c_p50", "value": 99.0, "compile_count_warm": 2},
+        ]
+        verdict = bench.compare_verdict(new, old)
+        assert verdict["ok"] is False
+        assert verdict["regressed"] == ["a_p50"]
+        by = {l["metric"]: l for l in verdict["lines"]}
+        assert by["a_p50"]["new_compile_count_warm"] == 3
+        assert "new_compile_count_warm" not in by["b_p50"]
+        text = "\n".join(bench.render_verdict(verdict))
+        assert "warm recompiles 0 -> 3" in text

@@ -1,0 +1,402 @@
+"""Device observatory (obs/device.py): compile/transfer/resident
+telemetry for the on-device hot path.
+
+Covers the seam semantics (dispatch counting, signature-based
+would-compile accounting, warm-recompile detection, the counted put),
+the scope determinism contract the sim report relies on, the registry
+export, the solver integration (a resident warm tick uploads NOTHING),
+the /debug/device endpoint, the flight `device` section — and the
+twin-run guarantee: observatory on vs off changes zero scheduling
+actions tick-for-tick.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import Disruption, Pod, Resources, Settings
+from karpenter_tpu.api.objects import reset_name_sequences
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.obs.device import (
+    OBSERVATORY,
+    DeviceObservatory,
+    export_device_metrics,
+)
+from karpenter_tpu.cloud.fake.backend import generate_catalog
+from karpenter_tpu.testing import Environment
+
+
+def _jit(fn):
+    import jax
+
+    return jax.jit(fn)
+
+
+class TestDispatchSeam:
+    def test_counts_compiles_transfers_and_dispatches(self):
+        obs = DeviceObservatory()
+        fn = _jit(lambda x: x + 1)
+        obs.begin_tick(1)
+        obs.dispatch("f", fn, np.zeros(4, np.float32))
+        t = obs.total
+        assert t.dispatches["f"] == 1
+        assert t.compiles["f"] == 1
+        assert t.transfer_bytes["f"] == 16  # 4 x float32
+        assert t.warm_recompiles == {}
+        assert t.compile_s["f"] > 0.0
+        # cache-hot repeat: a dispatch and an upload, no compile
+        obs.dispatch("f", fn, np.ones(4, np.float32))
+        assert t.dispatches["f"] == 2
+        assert t.compiles["f"] == 1
+        assert t.transfer_bytes["f"] == 32
+
+    def test_warm_recompile_flags_only_after_first_tick(self):
+        obs = DeviceObservatory()
+        fn = _jit(lambda x: x * 2)
+        obs.begin_tick(1)
+        obs.dispatch("f", fn, np.zeros(4, np.float32))
+        # a SECOND shape in the same first tick is a cold compile
+        obs.dispatch("f", fn, np.zeros(8, np.float32))
+        assert obs.total.compiles["f"] == 2
+        assert obs.total.warm_recompiles == {}
+        # the same shapes on a later tick: cached, nothing counts
+        obs.begin_tick(2)
+        obs.dispatch("f", fn, np.zeros(8, np.float32))
+        assert obs.total.compiles["f"] == 2
+        # a FRESH shape on a later tick is the warm-recompile signal
+        obs.dispatch("f", fn, np.zeros(16, np.float32))
+        assert obs.total.compiles["f"] == 3
+        assert obs.total.warm_recompiles["f"] == 1
+
+    def test_device_args_count_zero_transfer(self):
+        import jax
+
+        obs = DeviceObservatory()
+        fn = _jit(lambda x: x + 1)
+        dev = jax.device_put(np.zeros(4, np.float32))
+        obs.dispatch("f", fn, dev)
+        assert obs.total.transfer_bytes.get("f", 0) == 0
+
+    def test_disabled_observatory_is_a_passthrough(self):
+        obs = DeviceObservatory()
+        obs.enabled = False
+        fn = _jit(lambda x: x + 1)
+        out = obs.dispatch("f", fn, np.zeros(4, np.float32))
+        assert float(np.asarray(out)[0]) == 1.0
+        assert obs.total.dispatches == {}
+        obs.put("site", np.zeros(8, np.float32))
+        assert obs.total.transfer_bytes == {}
+
+    def test_tick_section_deltas(self):
+        obs = DeviceObservatory()
+        fn = _jit(lambda x: x - 1)
+        obs.begin_tick(1)
+        obs.dispatch("f", fn, np.zeros(4, np.float32))
+        sec = obs.tick_section()
+        assert sec["compiles"] == 1
+        assert sec["dispatches"] == 1
+        assert sec["transfer_bytes"] == 16
+        obs.begin_tick(2)
+        sec2 = obs.tick_section()
+        assert sec2["compiles"] == 0 and sec2["dispatches"] == 0
+
+
+class TestPutSeam:
+    def test_counts_leaf_bytes_and_returns_device_value(self):
+        obs = DeviceObservatory()
+        out = obs.put("site", np.zeros(10, np.float32))
+        assert int(np.asarray(out).shape[0]) == 10
+        assert obs.total.transfer_bytes["site"] == 40
+        # tuple pytrees sum their leaves
+        obs.put("site", (np.zeros(2, np.float32), np.zeros(3, np.int32)))
+        assert obs.total.transfer_bytes["site"] == 40 + 8 + 12
+
+
+class TestScopes:
+    def test_scope_counts_signatures_not_cache_growth(self):
+        """The determinism contract: a scope opened AFTER the process
+        already compiled a shape still counts it — a second sim run in
+        one process must report the same would-compile count as the
+        first, or run/run byte-identity dies."""
+        obs = DeviceObservatory()
+        fn = _jit(lambda x: x + 3)
+        obs.dispatch("f", fn, np.zeros(4, np.float32))  # process-warm
+        scope = obs.begin_scope()
+        obs.dispatch("f", fn, np.zeros(4, np.float32))
+        sec = scope.device_section()
+        assert sec["compiles"] == {"f": 1}  # distinct signature, counted
+        assert obs.total.compiles["f"] == 1  # actual compile: only once
+        # repeats dedup within the scope
+        obs.dispatch("f", fn, np.zeros(4, np.float32))
+        assert scope.device_section()["compiles"] == {"f": 1}
+        assert scope.device_section()["dispatches"] == {"f": 3 - 1}
+        obs.end_scope(scope)
+        obs.dispatch("f", fn, np.zeros(4, np.float32))
+        assert scope.device_section()["dispatches"] == {"f": 2}
+
+    def test_section_is_counts_and_bytes_only(self):
+        obs = DeviceObservatory()
+        fn = _jit(lambda x: x + 4)
+        scope = obs.begin_scope()
+        obs.dispatch("f", fn, np.zeros(4, np.float32))
+        sec = scope.device_section()
+        assert set(sec) == {
+            "compiles", "dispatches", "transfer_bytes", "resident",
+        }
+        flat = json.dumps(sec)
+        assert "seconds" not in flat and "_s\"" not in flat
+
+
+class _Owner:
+    pass
+
+
+class TestExport:
+    def test_delta_export_and_warm_events(self):
+        obs = DeviceObservatory()
+        reg = Registry()
+        fn = _jit(lambda x: x + 5)
+        obs.begin_tick(1)
+        obs.dispatch("f", fn, np.zeros(4, np.float32))
+        exported, warm = export_device_metrics(reg, obs, None)
+        assert warm == []  # first-tick compile is cold
+        assert reg.counter(
+            "karpenter_device_compiles_total", {"fn": "f"}
+        ) == 1
+        assert reg.counter(
+            "karpenter_device_transfer_bytes_total", {"site": "f"}
+        ) == 16
+        assert reg.histogram(
+            "karpenter_device_compile_seconds", {"fn": "f"}
+        )
+        # idle re-export: deltas are zero, nothing double-counts
+        exported, warm = export_device_metrics(reg, obs, exported)
+        assert reg.counter(
+            "karpenter_device_compiles_total", {"fn": "f"}
+        ) == 1
+        # a warm recompile surfaces as an event attribution
+        obs.begin_tick(2)
+        obs.dispatch("f", fn, np.zeros(8, np.float32))
+        exported, warm = export_device_metrics(reg, obs, exported)
+        assert len(warm) == 1 and warm[0]["fn"] == "f"
+        assert warm[0]["compile_s"] > 0
+        assert reg.counter(
+            "karpenter_device_warm_recompiles_total", {"fn": "f"}
+        ) == 1
+
+    def test_resident_gauge_tracks_and_unsets(self):
+        obs = DeviceObservatory()
+        reg = Registry()
+        owner = _Owner()
+        obs.set_resident_footprint(owner, {"solve": 1000})
+        exported, _ = export_device_metrics(reg, obs, None)
+        assert reg.gauge(
+            "karpenter_device_resident_bytes", {"consumer": "solve"}
+        ) == 1000.0
+        obs.set_resident_footprint(owner, {"removal": 64})
+        exported, _ = export_device_metrics(reg, obs, exported)
+        assert reg.gauge(
+            "karpenter_device_resident_bytes", {"consumer": "solve"}
+        ) is None
+        assert reg.gauge(
+            "karpenter_device_resident_bytes", {"consumer": "removal"}
+        ) == 64.0
+
+    def test_multiple_owners_merge(self):
+        obs = DeviceObservatory()
+        a, b = _Owner(), _Owner()
+        obs.set_resident_footprint(a, {"solve": 100})
+        obs.set_resident_footprint(b, {"solve": 20, "removal": 7})
+        assert obs.resident_footprint() == {"solve": 120, "removal": 7}
+
+    def test_dead_owner_drops_out_without_a_new_report(self):
+        """The merge is computed at READ time over the weak dict: a
+        collected cache's bytes vanish from the footprint on their own,
+        even if no surviving cache ever reports again (steady warm
+        clusters never rebuild)."""
+        import gc
+
+        obs = DeviceObservatory()
+        a, b = _Owner(), _Owner()
+        obs.set_resident_footprint(a, {"solve": 100})
+        obs.set_resident_footprint(b, {"removal": 7})
+        del a
+        gc.collect()
+        assert obs.resident_footprint() == {"removal": 7}
+        assert obs.snapshot()["resident"]["bytes_total"] == 7
+        assert obs.tick_section()["resident_bytes"] == 7
+
+
+class TestSolverIntegration:
+    def test_warm_resident_solve_uploads_nothing(self):
+        """The warm-tick transfer contract on a real solve: the cold
+        solve pays the seed upload, a warm compile-cache hit packs from
+        resident buffers and ships ZERO bytes; the resident footprint is
+        live and consumer-labeled."""
+        from karpenter_tpu.scheduling import TensorScheduler
+
+        env = Environment(
+            shapes=generate_catalog(generations=(1, 2), cpus=(4, 8))
+        )
+        pool = env.default_node_pool()
+        nc = env.default_node_class()
+        types = env.instance_types.list(pool, nc)
+        pods = [
+            Pod(requests=Resources(cpu=0.5, memory=2**30))
+            for _ in range(40)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types})
+        scope = OBSERVATORY.begin_scope()
+        try:
+            ts.solve(pods)
+            cold = scope.device_section()
+            assert cold["transfer_bytes"].get("resident_seed", 0) > 0
+            assert ts.resident_rebuilds == 1
+            before = sum(cold["transfer_bytes"].values())
+            ts.solve(pods)  # compile-cache hit -> resident match
+            warm = scope.device_section()
+            assert ts.last_resident
+            assert sum(warm["transfer_bytes"].values()) == before
+            assert warm["resident"]["updates"].get("seed") == 1
+        finally:
+            OBSERVATORY.end_scope(scope)
+        fp = ts._resident.footprint()
+        assert fp.get("solve", 0) > 0
+
+    def test_warm_delta_counts_donated_update_and_payload_bytes(self):
+        from karpenter_tpu.scheduling import TensorScheduler
+
+        env = Environment(
+            shapes=generate_catalog(generations=(1, 2), cpus=(4, 8))
+        )
+        pool = env.default_node_pool()
+        nc = env.default_node_class()
+        types = env.instance_types.list(pool, nc)
+        pods = [
+            Pod(requests=Resources(cpu=0.5, memory=2**30))
+            for _ in range(24)
+        ]
+        ts = TensorScheduler([pool], {pool.name: types})
+        ts.solve(pods)
+        scope = OBSERVATORY.begin_scope()
+        try:
+            pods2 = pods + [Pod(requests=Resources(cpu=1, memory=2**30))]
+            ts.solve(pods2)  # delta tick: one class arrives
+            sec = scope.device_section()
+            assert ts.last_resident and ts.last_delta_rows > 0
+            assert sec["resident"]["updates"].get("donated") == 1
+            # the delta shipped only scatter payloads — bytes came from
+            # the resident_delta dispatch, not a re-seed
+            assert sec["transfer_bytes"].get("resident_delta", 0) > 0
+            assert "resident_seed" not in sec["transfer_bytes"]
+        finally:
+            OBSERVATORY.end_scope(scope)
+
+
+class TestEndpointAndFlight:
+    def test_debug_device_endpoint_serves_snapshot(self):
+        from karpenter_tpu.obs.http import start_telemetry
+
+        reg = Registry()
+        server = start_telemetry(0, reg, device=OBSERVATORY)
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/device", timeout=5
+            ) as resp:
+                snap = json.loads(resp.read().decode())
+            assert "resident" in snap and "compiles" in snap
+            assert snap["enabled"] is True
+            assert reg.counter(
+                "karpenter_telemetry_scrapes_total",
+                {"endpoint": "debug/device"},
+            ) == 1
+        finally:
+            server.shutdown()
+
+    def test_flight_tick_carries_device_section(self):
+        env = Environment(
+            shapes=generate_catalog(generations=(1, 2), cpus=(4, 8))
+        )
+        env.default_node_class()
+        env.default_node_pool()
+        env.kube.put_pod(Pod(requests=Resources(cpu=0.5, memory=2**30)))
+        env.settle(max_rounds=10)
+        ticks = list(env.operator.flight._ring)
+        dev = ticks[-1]["device"]
+        assert set(dev) >= {
+            "compiles", "warm_recompiles", "dispatches", "transfer_bytes",
+            "resident_bytes", "resident_delta_bytes",
+        }
+        # the pod's solve dispatched on SOME recorded tick
+        assert any(t["device"]["dispatches"] > 0 for t in ticks), [
+            t["device"] for t in ticks
+        ]
+        # and the registry families were exported by the diagnosis tail
+        assert sum(
+            env.registry.counters.get(
+                "karpenter_device_dispatches_total", {}
+            ).values()
+        ) > 0
+
+
+def _twin_run(enabled: bool):
+    """Drive a deterministic provision→churn→consolidate schedule and
+    record every tick's full action surface."""
+    reset_name_sequences()
+    env = Environment(
+        shapes=generate_catalog(generations=(1, 2), cpus=(4, 8)),
+        settings=Settings(
+            cluster_name="twin", enable_device_observatory=enabled
+        ),
+    )
+    env.operator.provisioner.launch_concurrency = 1
+    env.default_node_class()
+    env.default_node_pool(
+        disruption=Disruption(consolidation_policy="WhenUnderutilized")
+    )
+    pods = [
+        Pod(
+            name=f"twin-{i}",
+            requests=Resources(cpu=0.5 if i % 2 else 1.0, memory=2**30),
+        )
+        for i in range(30)
+    ]
+    for p in pods:
+        env.kube.put_pod(p)
+    actions = []
+    for t in range(14):
+        if t == 6:
+            # mass deletion strands capacity: consolidation must act
+            for p in pods[:20]:
+                env.kube.delete_pod(p.key())
+        env.step(2.0)
+        actions.append(
+            (
+                sorted(env.kube.node_claims),
+                sorted(env.kube.nodes),
+                sorted(
+                    (k, p.node_name) for k, p in env.kube.pods.items()
+                ),
+                sorted(
+                    i.id
+                    for i in env.cloud.instances.values()
+                    if i.state == "running"
+                ),
+            )
+        )
+    return actions
+
+
+class TestTwinRun:
+    def test_observatory_on_off_changes_zero_actions(self):
+        """The observatory is accounting, never policy: with it disabled
+        the same schedule takes the identical actions tick-for-tick."""
+        try:
+            on = _twin_run(enabled=True)
+            off = _twin_run(enabled=False)
+        finally:
+            OBSERVATORY.enabled = True
+        assert on == off

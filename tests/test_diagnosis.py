@@ -460,6 +460,169 @@ class TestDoctor:
             for c in diag["suspected_causes"]
         )
 
+    def test_dump_without_device_sections_has_no_device_causes(
+        self, tmp_path
+    ):
+        """Backward compatibility: a pre-observatory dump (no `device`
+        sections) diagnoses exactly as before — zero device causes, and
+        the device summary stays all-zero."""
+        path = _synthetic_regression_dump(tmp_path)
+        diag = diagnose(load_flight(str(path)))
+        assert not any(
+            "device" in c or "transfer regression" in c
+            for c in diag["suspected_causes"]
+        )
+        assert diag["device"] == {
+            "compiles": 0, "warm_recompiles": 0, "transfer_bytes": 0,
+            "resident_bytes_final": 0,
+        }
+
+
+def _device_dump(
+    tmp_path,
+    name: str,
+    roll: bool = False,
+    transfer_spike: bool = False,
+    warm_no_roll: bool = False,
+    recompile_event: bool = False,
+):
+    """Forge a flight dump with device sections: 24 ticks, quiet through
+    tick 15, then the configured pathology from tick 16 on.  Resident
+    delta rows stay FLAT throughout — the transfer spike is never
+    justified by the cluster delta."""
+    clock = FakeClock()
+    reg = Registry()
+    led = EventLedger(clock=clock, registry=reg)
+    reg.ledger = led
+    fr = FlightRecorder(clock, reg, ledger=led, capacity=64)
+    for i in range(24):
+        clock.step(1.0)
+        set_tick(f"tick-{i + 1:06d}")
+        device = {
+            "compiles": 0, "warm_recompiles": 0, "dispatches": 2,
+            "transfer_bytes": 2048, "resident_bytes": 500_000,
+            "resident_delta_bytes": 0,
+        }
+        if roll and i == 16:
+            reg.event("CatalogRolled", provider="image")
+        if roll and i >= 16:
+            device.update(compiles=3, warm_recompiles=3)
+        if transfer_spike and i >= 16:
+            device["transfer_bytes"] = 300_000
+        if warm_no_roll and i == 10:
+            device.update(compiles=1, warm_recompiles=1)
+        if recompile_event and i == 12:
+            reg.event(
+                "DeviceRecompile", fn="pack_kernel", compile_s=0.82
+            )
+        # the per-tick delta rows the transfer rule normalizes by
+        reg.observe("karpenter_solver_resident_delta_rows", 4.0)
+        fr.record(
+            i + 1, f"tick-{i + 1:06d}", 0.01, {"pending": 0},
+            device=device,
+        )
+    path = tmp_path / f"flight-{name}.jsonl"
+    fr.dump(str(path), trigger="manual")
+    return path
+
+
+class TestDoctorDeviceRules:
+    def test_recompile_storm_after_catalog_roll_is_named(self, tmp_path):
+        """Acceptance: doctor names the recompile storm from the dump
+        alone — compile activity concentrated after CatalogRolled, with
+        the triggering event cited and the warm count called out."""
+        path = _device_dump(tmp_path, "storm", roll=True)
+        diag = diagnose(load_flight(str(path)))
+        (cause,) = [
+            c for c in diag["suspected_causes"]
+            if "device recompile storm" in c
+        ]
+        assert "CatalogRolled" in cause
+        assert "24 device compile(s)" in cause  # 8 ticks x 3
+        assert "vs 0 before" in cause
+        assert "warm jit entry points" in cause
+        assert diag["device"]["compiles"] == 24
+        assert diag["device"]["warm_recompiles"] == 24
+        text = render_diagnosis(diag)
+        assert "device:" in text and "recompile storm" in text
+
+    def test_transfer_spike_without_delta_rows_is_named(self, tmp_path):
+        """Acceptance: a warm tick uploading more than its delta rows
+        justify is a suspected cause, from the dump alone."""
+        path = _device_dump(tmp_path, "xfer", transfer_spike=True)
+        diag = diagnose(load_flight(str(path)))
+        (cause,) = [
+            c for c in diag["suspected_causes"]
+            if "transfer regression" in c
+        ]
+        assert "300000B" in cause and "2048B" in cause
+        assert "delta rows stayed flat" in cause
+
+    def test_warm_recompile_without_roll_is_flagged(self, tmp_path):
+        path = _device_dump(tmp_path, "warm", warm_no_roll=True)
+        diag = diagnose(load_flight(str(path)))
+        (cause,) = [
+            c for c in diag["suspected_causes"]
+            if "not explained by a catalog roll" in c
+        ]
+        assert "warm-tick device recompile" in cause
+        assert "tick 10" in cause
+
+    def test_roll_without_compile_spike_does_not_silence_warm_rule(
+        self, tmp_path
+    ):
+        """A catalog roll that explains NOTHING (no compile activity
+        after it) must not swallow earlier warm recompiles — the warm
+        rule is independent of the storm rule, not its else-arm."""
+        clock = FakeClock()
+        reg = Registry()
+        led = EventLedger(clock=clock, registry=reg)
+        reg.ledger = led
+        fr = FlightRecorder(clock, reg, ledger=led, capacity=64)
+        for i in range(24):
+            clock.step(1.0)
+            set_tick(f"tick-{i + 1:06d}")
+            device = {
+                "compiles": 0, "warm_recompiles": 0, "dispatches": 2,
+                "transfer_bytes": 2048, "resident_bytes": 500_000,
+                "resident_delta_bytes": 0,
+            }
+            if i == 5:  # warm recompile long BEFORE the roll
+                device.update(compiles=1, warm_recompiles=1)
+            if i == 20:  # a roll with no compile spike behind it
+                reg.event("CatalogRolled", provider="image")
+            fr.record(
+                i + 1, f"tick-{i + 1:06d}", 0.01, {"pending": 0},
+                device=device,
+            )
+        path = tmp_path / "flight-roll-no-spike.jsonl"
+        fr.dump(str(path), trigger="manual")
+        diag = diagnose(load_flight(str(path)))
+        assert any(
+            "not explained by a catalog roll" in c
+            for c in diag["suspected_causes"]
+        ), diag["suspected_causes"]
+        assert not any(
+            "recompile storm" in c for c in diag["suspected_causes"]
+        )
+
+    def test_device_recompile_event_restated_as_cause(self, tmp_path):
+        path = _device_dump(tmp_path, "evt", recompile_event=True)
+        diag = diagnose(load_flight(str(path)))
+        (cause,) = [
+            c for c in diag["suspected_causes"]
+            if "warm recompile of device fn" in c
+        ]
+        assert "pack_kernel" in cause and "0.82" in cause
+
+    def test_quiet_device_sections_raise_no_causes(self, tmp_path):
+        path = _device_dump(tmp_path, "quiet")
+        diag = diagnose(load_flight(str(path)))
+        assert not any(
+            "recompile" in c or "transfer regression" in c
+            for c in diag["suspected_causes"]
+        ), diag["suspected_causes"]
+
     def test_cli_on_dump_and_live_endpoint(self, tmp_path, capsys):
         from karpenter_tpu.__main__ import main as cli_main
         from karpenter_tpu.obs.http import start_telemetry

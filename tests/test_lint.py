@@ -35,6 +35,12 @@ baked into the image, so this enforces the checks that catch real rot:
    per-element fallbacks and the authoritative re-derivation of winning
    actions; a new call site quietly walking subsets host-side reverts
    the search promotion and must be consciously allowlisted.
+9. no raw `jax.device_put(...)` outside the device observatory's counted
+   seam (obs/device.py `DeviceObservatory.put`) — every host->device
+   upload must count into `karpenter_device_transfer_bytes_total{site}`
+   or the transfer accounting silently rots; a new upload site routes
+   through `OBSERVATORY.put(site, ...)` or is consciously allowlisted
+   by (file, qualified name).
 """
 
 import ast
@@ -632,6 +638,122 @@ def test_sequential_descent_lint_has_teeth():
         bad, "karpenter_tpu/controllers/x.py",
         {("karpenter_tpu/controllers/x.py", "C.scan"),
          ("karpenter_tpu/controllers/x.py", "C.multi")},
+    )
+    assert not ok, ok
+
+
+# rule 9: the counted-upload seam.  Transfer accounting
+# (karpenter_device_transfer_bytes_total{site}) is only as complete as
+# its coverage: every EXPLICIT host->device upload must route through
+# `DeviceObservatory.put` (obs/device.py), which is therefore the one
+# sanctioned raw `device_put` call site.  Implicit uploads (numpy
+# arguments to jit calls) are counted at the dispatch seam and need no
+# allowlisting.  Any NEW raw device_put — a fresh cache, a pinned
+# tensor — must either take the seam or be consciously added here.
+_DEVICE_PUT_ALLOWLIST = {
+    ("karpenter_tpu/obs/device.py", "DeviceObservatory.put"),
+}
+
+_DEVICE_PUT_NAMES = frozenset({"device_put"})
+
+
+def device_put_offenders(source: str, rel: str, allowlist):
+    """AST scan for `jax.device_put(...)` / `<alias>.device_put(...)` /
+    bare `device_put(...)` calls: every call site must be allowlisted by
+    (file, qualified name); hits lexically inside a for/while loop — a
+    per-item upload loop on the hot path — are called out."""
+    tree = ast.parse(source)
+    offenders = []
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self):
+            self.scope = []
+            self.loops = 0
+
+        def _scoped(self, node, push):
+            self.scope.append(push)
+            self.generic_visit(node)
+            self.scope.pop()
+
+        def visit_ClassDef(self, node):
+            self._scoped(node, node.name)
+
+        def visit_FunctionDef(self, node):
+            self._scoped(node, node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def _loop(self, node):
+            self.loops += 1
+            self.generic_visit(node)
+            self.loops -= 1
+
+        visit_For = visit_While = visit_AsyncFor = _loop
+
+        def visit_Call(self, node):
+            f = node.func
+            name = (
+                f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute)
+                else None
+            )
+            if name in _DEVICE_PUT_NAMES:
+                qual = ".".join(self.scope)
+                if (rel, qual) not in allowlist:
+                    where = "INSIDE A LOOP" if self.loops else "call"
+                    offenders.append(
+                        f"{rel}:{node.lineno}: {qual or '<module>'}: "
+                        f"{name}(...) [{where}]"
+                    )
+            self.generic_visit(node)
+
+    Visitor().visit(tree)
+    return offenders
+
+
+def test_no_raw_device_put_outside_counted_seam():
+    """Transfer-accounting guard: raw device_put only inside the counted
+    seam (obs/device.py DeviceObservatory.put) — an upload that bypasses
+    it vanishes from karpenter_device_transfer_bytes_total{site}, and
+    the bench's transfer columns and the doctor's transfer-regression
+    rule quietly under-count."""
+    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(pkg_root.parent).as_posix()
+        offenders += device_put_offenders(
+            path.read_text(), rel, _DEVICE_PUT_ALLOWLIST
+        )
+    assert not offenders, (
+        "raw device_put outside the counted seam (route the upload "
+        "through OBSERVATORY.put(site, ...), or consciously allowlist "
+        "it):\n" + "\n".join(offenders)
+    )
+
+
+def test_device_put_lint_has_teeth():
+    """The checker fires on attribute and bare call forms (tagging
+    in-loop hits), and stays quiet on allowlisted sites."""
+    bad = (
+        "class U:\n"
+        "    def upload(self, arrays):\n"
+        "        for a in arrays:\n"
+        "            d = jax.device_put(a)\n"
+        "    def pin(self, a):\n"
+        "        return device_put(a)\n"
+    )
+    hits = device_put_offenders(
+        bad, "karpenter_tpu/ops/x.py", _DEVICE_PUT_ALLOWLIST
+    )
+    assert len(hits) == 2, hits
+    assert "INSIDE A LOOP" in hits[0] and "U.upload" in hits[0], hits
+    assert "U.pin" in hits[1], hits
+    ok = device_put_offenders(
+        bad, "karpenter_tpu/ops/x.py",
+        {("karpenter_tpu/ops/x.py", "U.upload"),
+         ("karpenter_tpu/ops/x.py", "U.pin")},
     )
     assert not ok, ok
 

@@ -38,6 +38,18 @@ def test_same_seed_twice_is_byte_identical():
     assert w1.sha256() == w2.sha256()
     assert r1 == r2
     assert r1["invariants"]["violations"] == []
+    # the device-observatory section is part of the byte-compared report
+    # surface (r1 == r2 above proves identity even though the SECOND run
+    # hit process-warm jit caches): would-compile counts per kernel,
+    # transfer bytes per site, the resident footprint — counts and bytes
+    # only, no wall-clock keys
+    dev = r1["device"]
+    assert dev["compiles"] and dev["dispatches"]
+    assert sum(dev["transfer_bytes"].values()) > 0
+    assert set(dev) == {
+        "compiles", "dispatches", "transfer_bytes", "resident",
+    }
+    assert "seconds" not in json.dumps(dev)
     # different seed actually changes the run (the RNG is wired through)
     w3 = TraceWriter()
     _, r3 = run_scenario("steady", seed=4, ticks=40, trace=w3)
