@@ -1025,6 +1025,304 @@ def run_consolidation_search() -> None:
 # ---------------------------------------------------------------------------
 
 
+def run_store_plane() -> None:
+    """The fleet-scale store plane (docs/designs/store-scale.md), benched
+    the way solves are benched: two lines.
+
+    ``store_ops_mixed_p50`` measures the SERVER's sustainable ops/sec —
+    the store process is the plane's single serialization point, so its
+    per-op CPU is what caps the fleet.  A 100-op mix (production-shaped
+    pod puts with affinity/tolerations/spread, bind/evict cycles,
+    cluster events, stats) is pre-encoded as request payloads (client
+    work: another process's CPU), then the server half runs the REAL
+    code path per op: request decode, dispatch (fence + verb + commit
+    rendering), response encode, and the watch fan-out to a 16-watcher
+    fleet (the motivation's many-controllers/many-mirrors shape) via
+    the same frame rendering serve_watch uses.  Sockets are absent:
+    syscall time is identical per codec, and the codec is the variable
+    under test.  The structural difference under measurement: tagged
+    JSON re-serializes every subscriber's frame (the PR-1 baseline
+    behavior), bin1 renders a batch's frame once and ships the bytes
+    verbatim to the whole fan-out.  The line carries ops/sec for both
+    codecs and ``speedup_codec`` (binary over tagged JSON; acceptance
+    floor 3x, asserted by the tier-1 bench smoke).
+
+    ``store_watch_resync_p50`` measures the reconnect path against a
+    LIVE server over real sockets: a watcher that saw seq N reconnects
+    after a 10-event gap and receives a replayed delta; the line carries
+    the delta bytes next to a cold client's full-snapshot bytes
+    (``bytes_ratio`` < 0.10 is the acceptance floor — a short gap must
+    not cost a snapshot)."""
+    import socket as socket_mod
+
+    from karpenter_tpu.api import NodeClass, NodePool, Pod, Resources
+    from karpenter_tpu.api.objects import SelectorTerm
+    from karpenter_tpu.service.codec import (
+        CODEC_BIN,
+        CODEC_JSON,
+        decode_payload,
+        encode_payload,
+        recv_frame,
+        send_frame,
+    )
+    from karpenter_tpu.service.store_server import StoreServer, VersionedStore
+    from karpenter_tpu.state.binwire import SCHEMA_FP
+    from karpenter_tpu.state.remote import RemoteKubeStore
+    from karpenter_tpu.state.wire import to_wire
+
+    from karpenter_tpu.api.objects import Toleration, TopologySpreadConstraint
+    from karpenter_tpu.api.requirements import Op, Requirement
+
+    subscribers = 16
+    ops_per_mix = 100
+
+    def rich_pod(i: int) -> Pod:
+        # production-shaped: the affinity/toleration/spread payload a
+        # real TPU workload carries is what the wire actually moves
+        return Pod(
+            name=f"mix{i}",
+            requests=Resources(cpu=2, memory="8Gi"),
+            labels={"app": f"a{i % 3}", "tier": "web", "team": "ml"},
+            node_selector={"zone": "zone-a"},
+            required_affinity=[
+                Requirement("tpu-gen", Op.IN, ["v5e", "v5p"]),
+                Requirement("zone", Op.IN, ["zone-a", "zone-b"]),
+            ],
+            tolerations=[Toleration(key="tpu", value="true")],
+            topology_spread=[
+                TopologySpreadConstraint(
+                    1, "zone", label_selector=(("app", "a0"),)
+                )
+            ],
+        )
+
+    bytes_per_op = {}
+    ops_per_sec = {}
+    p50_by_codec = {}
+    for codec in (CODEC_JSON, CODEC_BIN):
+        server = StoreServer(store=VersionedStore())
+        store = server.store
+        subs = [
+            store.subscribe(f"w{i}", codec)[2] for i in range(subscribers)
+        ]
+        pods = [rich_pod(i) for i in range(16)]
+
+        def mix_payloads(_pods=pods, _codec=codec):
+            """The CLIENT half of one 100-op mix, pre-encoded: request
+            building is another process's CPU; the measured window is
+            the server's."""
+
+            def hdr(h):
+                return encode_payload(h, _codec)
+
+            def obj_field(o):
+                return to_wire(o) if _codec == CODEC_JSON else o
+
+            out = []
+            # 64 pod puts (4 rotating phase flips: real churn — every
+            # put is a fresh rv broadcast to the whole fan-out)
+            for r in range(4):
+                for p in _pods:
+                    p.phase = "Pending" if r % 2 else "Running"
+                    out.append(
+                        hdr(
+                            {
+                                "method": "put",
+                                "kind": "Pod",
+                                "obj": obj_field(p),
+                                "identity": "writer",
+                            }
+                        )
+                    )
+            # 8 bind + 8 evict cycles
+            for p in _pods[:8]:
+                out.append(
+                    hdr(
+                        {
+                            "method": "bind_pod",
+                            "key": p.key(),
+                            "node_name": "mixnode",
+                            "identity": "writer",
+                        }
+                    )
+                )
+            for p in _pods[:8]:
+                out.append(
+                    hdr(
+                        {
+                            "method": "evict_pod",
+                            "key": p.key(),
+                            "identity": "writer",
+                        }
+                    )
+                )
+            # 4 cluster events + 16 stats
+            for i in range(4):
+                out.append(
+                    hdr(
+                        {
+                            "method": "record_event",
+                            "kind": "Pod",
+                            "reason": "Scheduled",
+                            "obj_name": f"mix{i}",
+                            "identity": "writer",
+                        }
+                    )
+                )
+            for _ in range(16):
+                out.append(hdr({"method": "stat"}))
+            return out
+
+        counted = {"bytes": 0, "ops": 0}
+
+        def serve_mix(payloads, _server=server, _subs=subs, _codec=codec):
+            # the server half, per op: request decode, dispatch (fence +
+            # verb + commit rendering), response encode, and each
+            # subscriber connection's frame — exactly what serve_watch's
+            # sender threads run
+            for payload in payloads:
+                response = _server.dispatch(
+                    decode_payload(payload, _codec), _codec
+                )
+                out = encode_payload(response, _codec)
+                counted["bytes"] += len(payload) + len(out)
+                counted["ops"] += 1
+                for sub in _subs:
+                    if sub.batches:
+                        batches = list(sub.batches)
+                        sub.batches.clear()
+                        frame = _server._frame_payload(batches, _codec)
+                        counted["bytes"] += len(frame)
+
+        serve_mix(mix_payloads())  # warm + seed the mix's pods
+        samples = []
+        for _ in range(max(ITERS, 5)):
+            payloads = mix_payloads()  # client work, untimed
+            t0 = time.perf_counter()
+            serve_mix(payloads)
+            samples.append(time.perf_counter() - t0)
+        server.server_close()
+        p50 = statistics.median(samples) * 1000.0
+        p50_by_codec[codec] = p50
+        ops_per_sec[codec] = round(ops_per_mix / (p50 / 1000.0), 1)
+        bytes_per_op[codec] = int(counted["bytes"] / max(counted["ops"], 1))
+
+    speedup = round(p50_by_codec[CODEC_JSON] / p50_by_codec[CODEC_BIN], 2)
+    _emit(
+        "store_ops_mixed_p50",
+        p50_by_codec[CODEC_BIN],
+        "store",
+        CODEC_BIN,
+        subscribers,
+        phases={},
+        ops=ops_per_mix,
+        subscribers=subscribers,
+        ops_per_sec_bin1=ops_per_sec[CODEC_BIN],
+        ops_per_sec_json=ops_per_sec[CODEC_JSON],
+        json_ms=round(p50_by_codec[CODEC_JSON], 2),
+        bytes_per_op_bin1=bytes_per_op[CODEC_BIN],
+        bytes_per_op_json=bytes_per_op[CODEC_JSON],
+        speedup_codec=speedup,
+    )
+
+    # ---- watch-resync latency + delta-vs-snapshot bytes (live server)
+    server = StoreServer(store=VersionedStore()).start_background()
+    host, port = server.address
+    gap_events = 10
+    seeded = max(200, _n(400))
+    try:
+        writer = RemoteKubeStore(
+            host, port, identity="seed", start_watch=False
+        )
+        writer.put_node_class(
+            NodeClass(
+                name="default",
+                subnet_selector_terms=[SelectorTerm.of(Name="*")],
+                security_group_selector_terms=[SelectorTerm.of(Name="*")],
+            )
+        )
+        writer.put_node_pool(NodePool(name="default", node_class_ref="default"))
+        for i in range(seeded):
+            writer.put_pod(
+                Pod(
+                    name=f"seed{i}",
+                    requests=Resources(cpu=0.5, memory="1Gi"),
+                    labels={"app": f"a{i % 7}"},
+                )
+            )
+
+        def watch_once(since_seq):
+            """One raw watch exchange; returns (ack, frame, bytes)."""
+            sock = socket_mod.create_connection((host, port), timeout=10.0)
+            try:
+                sock.settimeout(10.0)
+                send_frame(
+                    sock,
+                    encode_payload(
+                        {
+                            "method": "watch",
+                            "identity": "resync-probe",
+                            "codecs": [CODEC_BIN, CODEC_JSON],
+                            "schema_fp": SCHEMA_FP,
+                            "since_seq": since_seq,
+                            "epoch": server.store.epoch,
+                        },
+                        CODEC_JSON,
+                    ),
+                )
+                ack_payload = recv_frame(sock)
+                ack = decode_payload(ack_payload, CODEC_JSON)
+                codec = ack.get("codec", CODEC_JSON)
+                frame_payload = recv_frame(sock)
+                frame = decode_payload(frame_payload, codec)
+                return ack, frame, len(ack_payload) + len(frame_payload)
+            finally:
+                sock.close()
+
+        # the full-snapshot cost a cold (or compacted-past) client pays
+        _ack, _frame, snapshot_bytes = watch_once(0)
+        assert _ack["resync"] == "snapshot", _ack
+        measured = {"bytes": 0, "count": 0}
+        state = {"n": 0}
+
+        def resync_once():
+            seq0 = server.store.log_seq
+            for _ in range(gap_events):
+                state["n"] += 1
+                writer.put_pod(
+                    Pod(
+                        name=f"gap{state['n']}",
+                        requests=Resources(cpu=0.5, memory="1Gi"),
+                    )
+                )
+            ack, frame, nbytes = watch_once(seq0)
+            assert ack["resync"] == "replay", ack
+            assert len(frame["events"]) == gap_events, len(frame["events"])
+            measured["bytes"] += nbytes
+            measured["count"] += 1
+
+        p50, noise, _ = _measure(resync_once)
+        delta_bytes = int(measured["bytes"] / max(measured["count"], 1))
+        ratio = round(delta_bytes / snapshot_bytes, 4)
+        _emit(
+            "store_watch_resync_p50",
+            p50,
+            "store",
+            CODEC_BIN,
+            seeded,
+            noise_ms=noise,
+            phases={},
+            gap_events=gap_events,
+            kind="replay",
+            delta_bytes=delta_bytes,
+            snapshot_bytes=snapshot_bytes,
+            bytes_ratio=ratio,
+        )
+        writer.close()
+    finally:
+        server.stop()
+
+
 def _device_ms(
     kind: str, pools, inventory, pods, chain: int = 6
 ) -> Tuple[float, float]:
@@ -1415,6 +1713,7 @@ def _run_all() -> None:
     run_consolidation_repack()
     run_consolidation_sweep()
     run_consolidation_search()
+    run_store_plane()
 
     pools, inventory, pods = build_multipool_spot()
     _run_scheduler_config(

@@ -162,10 +162,16 @@ def main(argv=None) -> int:
         from karpenter_tpu.state.remote import RemoteKubeStore
 
         host, _, port = args.store_address.partition(":")
-        kube = (
-            RemoteKubeStore(host, int(port), identity=identity)
-            if port
-            else RemoteKubeStore(host, identity=identity)
+        # the operator's default registry: the client half of the store
+        # plane (karpenter_store_rpc_seconds, byte counters, StoreResync
+        # events) lands on this process's /metrics and flight recorder
+        kube = RemoteKubeStore(
+            host,
+            int(port) if port else 8082,
+            identity=identity,
+            codec=settings.store_codec,
+            registry=REGISTRY,
+            events_cap=settings.store_events_cap,
         )
         log.info("shared cluster store at %s", args.store_address)
     else:

@@ -72,6 +72,15 @@ class Settings:
     # only; off turns every seam into a passthrough (the twin-run test
     # proves on/off changes zero scheduling actions)
     enable_device_observatory: bool = True
+    # fleet-scale store plane, CLIENT side (docs/designs/store-scale.md).
+    # store_codec: "auto" negotiates the compact binary payload codec
+    # (state/binwire.py) per connection and falls back to tagged JSON
+    # against an older server; "json" never negotiates.  store_events_cap
+    # bounds the mirror's local cluster-event ledger (the server's own
+    # bounds are the store-server flags --replay-log-events /
+    # --watch-queue-batches / --events-cap, chart store.* values).
+    store_codec: str = "auto"
+    store_events_cap: int = 4096
 
     @classmethod
     def from_file(cls, path: str) -> "Settings":
@@ -152,3 +161,7 @@ class Settings:
             )
         if self.flight_ticks < 1:
             raise ValueError("flight_ticks must be >= 1")
+        if self.store_codec not in ("auto", "json"):
+            raise ValueError("store_codec must be 'auto' or 'json'")
+        if self.store_events_cap < 1:
+            raise ValueError("store_events_cap must be >= 1")

@@ -218,8 +218,72 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "counter",
         "method",
         "store-server RPCs dispatched, per method (put / delete / "
-        "bind_pod / evict_pod / lease_* / watch / ...); served from the "
-        "store process's own registry on ITS telemetry endpoint",
+        "bind_pod / evict_pod / lease_* / watch / hello / ...); served "
+        "from the store process's own registry on ITS telemetry endpoint",
+    ),
+    # ---- fleet-scale store plane (docs/designs/store-scale.md)
+    "karpenter_store_request_seconds": (
+        "histogram",
+        "method",
+        "server-side wall time of one store RPC dispatch (fence + verb "
+        "+ broadcast), per method — the store process's latency anatomy, "
+        "on ITS telemetry endpoint",
+    ),
+    "karpenter_store_rpc_seconds": (
+        "histogram",
+        "method",
+        "client-side wall time of one store RPC including retries "
+        "(state/remote.py), per method — the operator's view of store "
+        "latency; watched by the anomaly detector and baselined by "
+        "doctor like a solver phase",
+    ),
+    "karpenter_store_watch_clients": (
+        "gauge",
+        "(none)",
+        "watch subscribers currently registered on this store server "
+        "(operator replicas, read replicas, passive mirrors)",
+    ),
+    "karpenter_store_watch_queue_depth": (
+        "gauge",
+        "(none)",
+        "deepest per-subscriber broadcast queue after the last commit; "
+        "queues are BOUNDED (store_watch_queue_batches) — a subscriber "
+        "that hits the bound is coalesced onto a forced resync instead "
+        "of growing server memory",
+    ),
+    "karpenter_store_bytes_sent_total": (
+        "counter",
+        "codec",
+        "bytes written to store-plane sockets (frames + length prefix), "
+        "per negotiated payload codec — on the server AND on each "
+        "client's own registry; the bin1/json split is the negotiated "
+        "binary codec's adoption in one glance",
+    ),
+    "karpenter_store_bytes_received_total": (
+        "counter",
+        "codec",
+        "bytes read off store-plane sockets (frames + length prefix), "
+        "per negotiated payload codec, both halves of the plane",
+    ),
+    "karpenter_store_resync_total": (
+        "counter",
+        "kind",
+        "watch resyncs: 'replay' (a reconnect gap served from the "
+        "replay log — events only, no snapshot), 'snapshot' (the log "
+        "was compacted past the client's seq; full state), 'overflow' "
+        "(a slow subscriber's bounded queue filled and was coalesced "
+        "onto a forced resync), 'epoch' (the store's own continuity "
+        "broke under its watchers — a read replica full-resynced from "
+        "its primary); servers count what they served, clients count "
+        "what they underwent",
+    ),
+    "karpenter_store_compactions_total": (
+        "counter",
+        "log",
+        "bounded-log trims on the store server: 'replay' (the delta "
+        "resync log dropped its oldest batch — clients older than "
+        "compacted_seq now snapshot), 'events' (the durable "
+        "cluster-event ledger dropped its oldest entries)",
     ),
     # ---- diagnosis layer (docs/designs/observability.md, PR 7)
     "karpenter_reconcile_tick_duration_seconds": (

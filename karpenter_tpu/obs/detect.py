@@ -56,6 +56,9 @@ WATCHED_FAMILIES = (
     # device observatory: a compile-time blowup (recompile storm, a jit
     # suddenly retracing every tick) judges exactly like a phase blowup
     "karpenter_device_compile_seconds",
+    # store plane: the client half's per-RPC latency (state/remote.py)
+    # — a store server falling over shows up here first, per method
+    "karpenter_store_rpc_seconds",
 )
 
 _MAD_SCALE = 1.4826  # MAD -> stddev-equivalent under normality

@@ -51,6 +51,7 @@ _FAMILY_SHORT = {
     "karpenter_reconcile_tick_duration_seconds": "tick",
     "karpenter_provisioner_scheduling_duration_seconds": "scheduling",
     "karpenter_device_compile_seconds": "device_compile",
+    "karpenter_store_rpc_seconds": "store_rpc",
 }
 
 # device-rule thresholds: a warm tick's upload bytes must not grow past

@@ -51,6 +51,10 @@ DELTA_HISTOGRAMS = (
     # resident scatter sizes the doctor's transfer rule normalizes by
     "karpenter_device_compile_seconds",
     "karpenter_solver_resident_delta_rows",
+    # store plane (docs/designs/store-scale.md): the operator's per-RPC
+    # store latency, so a flight dump brackets store slowness next to
+    # the solver phases it stalls
+    "karpenter_store_rpc_seconds",
 )
 
 

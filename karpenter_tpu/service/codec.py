@@ -19,6 +19,12 @@ from typing import Dict, Tuple
 
 import numpy as np
 
+from karpenter_tpu.state.binwire import (
+    BIN_VERSION,
+    decode_value,
+    encode_value,
+)
+
 MAX_FRAME = 1 << 30  # 1 GiB sanity bound
 
 
@@ -58,6 +64,47 @@ def decode(payload: bytes) -> Tuple[dict, Dict[str, np.ndarray]]:
             m["shape"]
         )
     return header, arrays
+
+
+# ----------------------------------------------------- negotiated payloads
+#
+# The store protocol (service/store_server.py + state/remote.py) frames
+# the SAME length-prefixed payloads but negotiates the payload codec at
+# connect (`hello`): "json" is the tagged-JSON header format above (the
+# compatibility baseline every endpoint speaks), "bin1" is the compact
+# binary value codec (state/binwire.py) — magic byte + codec version +
+# one encoded value, so a peer can reject an unknown version instead of
+# misparsing it.  Arrays never ride store frames; the solver protocol
+# keeps calling encode/decode directly.
+
+CODEC_JSON = "json"
+CODEC_BIN = "bin1"
+_BIN_MAGIC = 0xB5
+
+
+def encode_payload(header: dict, codec: str = CODEC_JSON) -> bytes:
+    if codec == CODEC_BIN:
+        return bytes((_BIN_MAGIC, BIN_VERSION)) + encode_value(header)
+    return encode(header, {})
+
+
+def decode_payload(payload: bytes, codec: str = CODEC_JSON) -> dict:
+    if codec == CODEC_BIN:
+        if len(payload) < 2 or payload[0] != _BIN_MAGIC:
+            raise ValueError("not a bin1 payload (bad magic)")
+        if payload[1] != BIN_VERSION:
+            raise ValueError(f"unsupported bin1 version: {payload[1]}")
+        try:
+            return decode_value(payload, 2)
+        except (IndexError, TypeError, struct.error) as exc:
+            # a truncated/corrupt payload must surface as the one
+            # malformed-frame error type callers already handle, not
+            # kill a watch thread with a stray IndexError (or the
+            # TypeError cls(**kw) raises when a corrupt frame elides a
+            # REQUIRED dataclass field)
+            raise ValueError(f"malformed bin1 payload: {exc}") from exc
+    header, _ = decode(payload)
+    return header
 
 
 # ------------------------------------------------------------ socket I/O

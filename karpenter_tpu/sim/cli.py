@@ -65,19 +65,34 @@ def main(argv=None, allow_reexec: bool = False) -> int:
 
     pin_cpu_platform(8)
 
+    from karpenter_tpu.sim.fleet import (
+        FLEET_SCENARIOS,
+        _FleetTrace,
+        replay_fleet,
+        run_fleet,
+    )
     from karpenter_tpu.sim.report import wall_profile
     from karpenter_tpu.sim.runner import SCENARIOS, replay, run_scenario
-    from karpenter_tpu.sim.trace import TraceWriter
+    from karpenter_tpu.sim.trace import TraceWriter, read_trace
 
     if args.list:
         for name, factory in sorted(SCENARIOS.items()):
             print(f"{name}: {factory(200).description}")
+        for name, description in sorted(FLEET_SCENARIOS.items()):
+            print(f"{name}: {description}")
         return 0
 
     if args.replay:
         trace_path = args.trace or (args.replay + ".replayed")
-        writer = TraceWriter(trace_path)
-        runner, report, recorded = replay(args.replay, trace=writer)
+        # fleet traces replay through the fleet runner (the meta line
+        # says which kind of trace this is)
+        head = next(iter(read_trace(args.replay)), {})
+        if head.get("fleet"):
+            writer = _FleetTrace(trace_path)
+            runner, report, recorded = replay_fleet(args.replay, trace=writer)
+        else:
+            writer = TraceWriter(trace_path)
+            runner, report, recorded = replay(args.replay, trace=writer)
         matches = recorded is not None and report == recorded
         print(
             f"replayed {args.replay} -> {trace_path} "
@@ -85,11 +100,22 @@ def main(argv=None, allow_reexec: bool = False) -> int:
             f"{'matches' if matches else 'DIFFERS FROM'} the recorded one",
             file=sys.stderr,
         )
+    elif args.scenario in FLEET_SCENARIOS:
+        trace_path = args.trace or f"sim-{args.scenario}-seed{args.seed}.jsonl"
+        writer = _FleetTrace(trace_path)
+        runner, report = run_fleet(
+            args.scenario, args.seed, args.ticks, trace=writer
+        )
+        matches = True
+        print(
+            f"trace -> {trace_path} (sha256 {writer.sha256()[:16]})",
+            file=sys.stderr,
+        )
     else:
         if args.scenario not in SCENARIOS:
             print(
                 f"unknown scenario {args.scenario!r}; have "
-                f"{', '.join(sorted(SCENARIOS))}",
+                f"{', '.join(sorted({**SCENARIOS, **FLEET_SCENARIOS}))}",
                 file=sys.stderr,
             )
             return 64
@@ -105,7 +131,14 @@ def main(argv=None, allow_reexec: bool = False) -> int:
         )
 
     if args.profile:
-        report = dict(report, profile=wall_profile(runner.env.registry))
+        if hasattr(runner, "env"):
+            report = dict(report, profile=wall_profile(runner.env.registry))
+        else:
+            print(
+                "--profile is not supported for fleet scenarios "
+                "(per-operator wall profiles are not aggregated); ignoring",
+                file=sys.stderr,
+            )
     print(json.dumps(report, indent=2, sort_keys=True))
 
     if report["invariants"]["violations"]:

@@ -1,0 +1,698 @@
+"""Fleet chaos scenarios: 3+ REAL Operators against ONE store server.
+
+The single-operator simulator (sim/runner.py) proves the controllers;
+this module proves the fleet-scale STORE PLANE under them
+(docs/designs/store-scale.md): three live Operator replicas dial one
+`StoreServer` as thin clients (state/remote.py), a read replica follows
+it over the watch protocol, a deliberately wedged watcher leans on the
+bounded fan-out queues — and the whole thing is driven deterministically
+on a FakeClock through seeded workload churn plus a scripted failover
+storm (leader crash, rejoin, graceful release, a second crash of the new
+leader), extending the 2-operator election-storm suite to fleet shape.
+
+Determinism contract (same as sim/runner.py): everything the generators
+and the chaos script decide is RECORDED into the trace as ``ev`` lines
+(chosen pod sizes, chosen kill targets, chosen crash victims), so
+``replay`` re-applies the tape with no generator in the loop; per-tick
+``dig`` lines fingerprint the PRIMARY server's canonical state, the
+launch log, and the leader.  Two runs of the same (scenario, seed,
+ticks) — and a replay of either — are byte-identical.  Ledger lines ride
+along per replica, except ``StoreResync``: like anomaly events, resyncs
+depend on wall-clock thread pacing (a socket hiccup heals through one)
+and must stay out of byte-compared surfaces.
+
+Invariants (checked every tick + at the end, reported not assumed):
+single writer per round outside scripted failover handoffs, no duplicate
+nominations between writers, every launch from that round's writer, ZERO
+NodeClaim double-launches, live claims registered against running
+instances, and the read replica converged with the primary's rv numbers
+preserved key-for-key.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.api import NodeClass, NodePool, Pod, Resources, Settings
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import (
+    SelectorTerm,
+    reset_name_sequences,
+    tolerates_all,
+)
+from karpenter_tpu.cloud.fake.backend import FakeCloud, generate_catalog
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.service.codec import CODEC_JSON
+from karpenter_tpu.service.store_server import StoreServer, VersionedStore
+from karpenter_tpu.sim.trace import TraceWriter, read_trace
+from karpenter_tpu.state.kube import Node
+from karpenter_tpu.state.remote import RemoteKubeStore
+from karpenter_tpu.state.wire import canonical
+from karpenter_tpu.testing import FAST_BATCH_WINDOWS
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.leader import LEASE_DURATION_S, LeaderElector
+
+TICK_S = 2.0
+SETTLE_MAX_ROUNDS = 60
+
+# the scripted failover storm, as tick fractions of the run: crash the
+# leader, let the standby take over on lease expiry, rejoin, force a
+# graceful mid-run handoff, then crash the NEW leader — every replica
+# should lead at some point
+_CRASH_A, _REJOIN_A, _RELEASE, _CRASH_B, _REJOIN_B = (
+    0.2, 0.4, 0.55, 0.7, 0.85,
+)
+
+FLEET_SCENARIOS: Dict[str, str] = {
+    "store-fleet-chaos": (
+        "3 real Operators + a read replica + a wedged watcher against one "
+        "store server through seeded churn and a scripted failover storm"
+    ),
+}
+
+
+class _FleetTrace(TraceWriter):
+    """The fleet trace: same JSONL discipline as the single-op trace,
+    with a fleet-shaped meta line, per-replica ledger lines, and a
+    per-tick fleet line (leader / writers / launch fingerprint) next to
+    the digest over the PRIMARY server's state (the authoritative truth
+    all mirrors converge to)."""
+
+    def fleet_meta(
+        self, scenario: str, seed: int, ticks: int, operators: int
+    ) -> None:
+        self._write(
+            {
+                "t": "meta",
+                "v": 1,
+                "fleet": True,
+                "scenario": scenario,
+                "seed": seed,
+                "ticks": ticks,
+                "tick_s": TICK_S,
+                "operators": operators,
+            }
+        )
+
+    def fleet_led(self, tick: int, replica: str, ev) -> None:
+        self._write(
+            {
+                "t": "led",
+                "tick": tick,
+                "replica": replica,
+                "seq": ev.seq,
+                "ts": ev.ts,
+                "type": ev.type,
+                "trace_id": ev.trace_id,
+                "attrs": dict(ev.attrs),
+            }
+        )
+
+    def fleet_tick(
+        self,
+        tick: int,
+        leader: str,
+        writers: List[str],
+        launches: int,
+        launch_sha: str,
+    ) -> None:
+        self._write(
+            {
+                "t": "fleet",
+                "tick": tick,
+                "leader": leader,
+                "writers": writers,
+                "launches": launches,
+                "launch_sha": launch_sha,
+            }
+        )
+
+
+class FleetRunner:
+    def __init__(
+        self,
+        scenario: str = "store-fleet-chaos",
+        seed: int = 0,
+        ticks: int = 36,
+        operators: int = 3,
+        trace: Optional[_FleetTrace] = None,
+        tape: Optional[Dict[int, List[Tuple[str, dict]]]] = None,
+    ):
+        if scenario not in FLEET_SCENARIOS:
+            raise ValueError(
+                f"unknown fleet scenario {scenario!r}; "
+                f"have {sorted(FLEET_SCENARIOS)}"
+            )
+        self.scenario = scenario
+        self.seed = seed
+        self.ticks = ticks
+        self.n_operators = operators
+        self.trace = trace or _FleetTrace()
+        self.tape = tape  # replay mode when set
+        # two rngs: the WORKLOAD rng only runs in generate mode (its
+        # choices are recorded onto the tape); the DRIVE rng paces
+        # nothing that the tape must carry and draws identically in
+        # replay (reserved for future fuzzing — the fleet currently
+        # reconciles in the production order)
+        self._gen_rng = random.Random(seed)
+        reset_name_sequences()
+
+        self.primary = StoreServer(
+            store=VersionedStore(replay_log_events=64)
+        ).start_background()
+        host, port = self.primary.address
+        self.replica = StoreServer(
+            replica_of=self.primary.address
+        ).start_background()
+        # the deliberately wedged watcher: an in-process subscriber with
+        # a tiny bound that is NEVER drained — churn must overflow it
+        # into one coalesced resync, not into server memory
+        _mode, _payload, self.sink = self.primary.store.subscribe(
+            "wedged-sink", CODEC_JSON, cap=4
+        )
+
+        self.clock = FakeClock()
+        self.cloud = FakeCloud(
+            self.clock, shapes=generate_catalog()
+        ).with_default_topology()
+        settings = Settings(cluster_name="fleet")
+        self.ops: Dict[str, Operator] = {}
+        self.kubes: Dict[str, RemoteKubeStore] = {}
+        self.names = [f"op-{i}" for i in range(operators)]
+        for name in self.names:
+            kube = RemoteKubeStore(host, port, identity=name)
+            elector = LeaderElector(kube, self.clock, name)
+            registry = Registry()
+            op = Operator(
+                self.cloud,
+                kube,
+                settings=settings,
+                clock=self.clock,
+                registry=registry,
+                batch_windows=FAST_BATCH_WINDOWS,
+                elector=elector,
+            )
+            self._instrument_launches(op, name)
+            # same determinism contract as sim/runner.py: the anomaly
+            # detector judges wall-clock values and gates the
+            # DeviceRecompile ledger events, both of which depend on
+            # process history — neither may enter a byte-compared trace
+            op.detector.enabled = False
+            self.kubes[name] = kube
+            self.ops[name] = op
+        # a passive reader mirroring the READ REPLICA: proves the
+        # replica serves snapshot+watch traffic with primary ordering
+        self.reader = RemoteKubeStore(
+            *self.replica.address, identity="replica-reader"
+        )
+        self._led_seqs = {name: 0 for name in self.names}
+        self.launches: List[Tuple[int, str, str]] = []
+        self.tick_no = -1
+        self.crashed: set = set()
+        self.release_pending: set = set()
+        self.failover_ticks: set = set()
+        self.violations: List[str] = []
+        self.live_pods: List[Pod] = []
+        self.writers_by_tick: Dict[int, List[str]] = {}
+        self.leader_history: List[str] = []
+
+        kube = self.kubes[self.names[0]]
+        kube.put_node_class(
+            NodeClass(
+                name="default",
+                subnet_selector_terms=[SelectorTerm.of(Name="*")],
+                security_group_selector_terms=[SelectorTerm.of(Name="*")],
+            )
+        )
+        kube.put_node_pool(NodePool(name="default", node_class_ref="default"))
+        self._sync("init")
+
+    # ----------------------------------------------------------- plumbing
+    def _instrument_launches(self, op: Operator, name: str) -> None:
+        orig = op.cloud_provider.create
+
+        def create(claim, _orig=orig, _name=name):
+            self.launches.append((self.tick_no, _name, claim.name))
+            return _orig(claim)
+
+        op.cloud_provider.create = create
+
+    def _sync(self, note: str) -> None:
+        for name, kube in self.kubes.items():
+            if not kube.wait_synced(timeout=15.0):
+                raise AssertionError(
+                    f"mirror {name} failed to sync ({note}): "
+                    f"synced_rv={kube.synced_rv} "
+                    f"server_rv={self.primary.store.rv}"
+                )
+
+    def _violation(self, msg: str) -> None:
+        self.violations.append(f"tick {self.tick_no}: {msg}")
+
+    def _kubelet(self) -> None:
+        """FakeKubelet over the shared store: register Nodes for running
+        instances, bind pods the CURRENT leader nominated (a deposed
+        replica's in-memory nominations are inert)."""
+        kube = self.kubes[self.names[0]]
+        now = self.clock.now()
+        for claim in list(kube.node_claims.values()):
+            if not claim.provider_id or claim.deleted_at is not None:
+                continue
+            inst = self.cloud.instances.get(claim.provider_id)
+            if inst is None or inst.state != "running":
+                continue
+            if kube.node_by_provider_id(claim.provider_id) is not None:
+                continue
+            labels = dict(claim.labels)
+            labels[L.LABEL_HOSTNAME] = claim.name
+            kube.put_node(
+                Node(
+                    name=claim.name,
+                    provider_id=claim.provider_id,
+                    labels=labels,
+                    taints=list(claim.taints),
+                    capacity=claim.capacity,
+                    allocatable=claim.allocatable,
+                    ready=True,
+                    created_at=now,
+                )
+            )
+        ordered = sorted(
+            self.ops.items(), key=lambda kv: not kv[1].elector.leading
+        )
+        for pod in list(kube.pods.values()):
+            if pod.node_name or pod.phase != "Pending":
+                continue
+            for _name, op in ordered:
+                target = op.cluster.nominated_node(pod.key())
+                if target is None:
+                    continue
+                node = kube.nodes.get(target)
+                if node is None or not node.ready or node.cordoned:
+                    continue
+                if not tolerates_all(pod.tolerations, node.taints):
+                    continue
+                kube.bind_pod(pod.key(), node.name)
+                op.cluster.clear_nomination(pod.key())
+                break
+
+    # ------------------------------------------------------------- events
+    def _generate_events(self, tick: int) -> List[Tuple[str, dict]]:
+        """Seeded workload + the scripted failover storm — every choice
+        RESOLVED here and recorded, so replay never consults an rng."""
+        rng = self._gen_rng
+        events: List[Tuple[str, dict]] = []
+        r = rng.random()
+        if r < 0.5:
+            events.append(
+                ("pod_create", {"cpu": rng.choice([0.5, 1, 2])})
+            )
+        elif r < 0.6 and self.live_pods:
+            victim = self.live_pods[
+                rng.randrange(len(self.live_pods))
+            ]
+            events.append(("pod_delete", {"key": victim.key()}))
+        elif r < 0.67:
+            running = sorted(
+                i.id
+                for i in self.cloud.instances.values()
+                if i.state == "running"
+            )
+            if running:
+                events.append(
+                    ("instance_kill", {"id": rng.choice(running)})
+                )
+
+        leader = next(
+            (n for n, op in self.ops.items() if op.elector.leading), None
+        )
+
+        def at(frac: float) -> bool:
+            return tick == int(self.ticks * frac)
+
+        if at(_CRASH_A) and leader is not None:
+            events.append(("op_crash", {"replica": leader}))
+        if at(_REJOIN_A):
+            events.append(("op_rejoin", {"replica": ""}))
+        if at(_RELEASE) and leader is not None:
+            events.append(("op_release", {"replica": leader}))
+        if at(_CRASH_B) and leader is not None:
+            events.append(("op_crash", {"replica": leader}))
+        if at(_REJOIN_B):
+            events.append(("op_rejoin", {"replica": ""}))
+        return events
+
+    def _apply_event(self, kind: str, data: dict) -> None:
+        kube = self.kubes[self.names[0]]
+        if kind == "pod_create":
+            pod = Pod(
+                requests=Resources(cpu=data["cpu"], memory="1Gi")
+            )
+            kube.put_pod(pod)
+            self.live_pods.append(pod)
+        elif kind == "pod_delete":
+            key = data["key"]
+            self.live_pods = [
+                p for p in self.live_pods if p.key() != key
+            ]
+            if key in kube.pods:
+                kube.delete_pod(key)
+        elif kind == "instance_kill":
+            if data["id"] in self.cloud.instances:
+                self.cloud.terminate_instances([data["id"]])
+        elif kind == "op_crash":
+            self.crashed.add(data["replica"])
+            self.failover_ticks.add(self.tick_no)
+        elif kind == "op_rejoin":
+            self.crashed.clear()
+        elif kind == "op_release":
+            self.release_pending.add(data["replica"])
+            self.failover_ticks.add(self.tick_no)
+
+    # --------------------------------------------------------------- tick
+    def _tick(
+        self,
+        tick: int,
+        events: List[Tuple[str, dict]],
+        phase: str = "run",
+    ) -> None:
+        self.tick_no = tick
+        self.trace.tick_start(tick, TICK_S, phase)
+        for kind, data in events:
+            self.trace.event(tick, kind, data)
+            self._apply_event(kind, data)
+
+        self.clock.step(TICK_S)
+        # while a crashed leader holds the lease, push toward expiry so
+        # the standby takes over inside the crash window
+        if self.crashed and any(
+            self.ops[n].elector.leading for n in self.crashed
+        ):
+            self.clock.step(LEASE_DURATION_S / 3 + 1)
+        self._sync(f"tick {tick} pre-kubelet")
+        self._kubelet()
+        self._sync(f"tick {tick} post-kubelet")
+
+        writers: List[str] = []
+        noms_added: Dict[str, set] = {}
+        # deterministic rotation of the reconcile order: after a crash
+        # or release, WHICH standby acquires next depends on who ticks
+        # first — rotating spreads leadership across the whole fleet
+        # over the storm (replay-safe: a pure function of the tick)
+        pivot = tick % len(self.names)
+        for name in self.names[pivot:] + self.names[:pivot]:
+            if name in self.crashed:
+                continue
+            op = self.ops[name]
+            before = set(op.cluster._nominations)
+            op.reconcile_once()
+            if op.elector.leading:
+                writers.append(name)
+            added = set(op.cluster._nominations) - before
+            if added:
+                noms_added[name] = added
+            if name in self.release_pending:
+                # graceful handoff: the leader frees the Lease at the
+                # end of its tick (the SIGTERM path); the next replica
+                # acquires on ITS next tick
+                op.elector.release()
+                self.release_pending.discard(name)
+
+        self.writers_by_tick[tick] = writers
+        if len(writers) > 1 and tick not in self.failover_ticks:
+            self._violation(f"multiple writers outside failover: {writers}")
+        if len(noms_added) > 1 and tick not in self.failover_ticks:
+            # across a scripted handoff, the OUTGOING leader's full tick
+            # already nominated before the incoming one reconciled — the
+            # same benign re-nomination two consecutive ticks produce;
+            # the claim-level no-double-launch invariant still holds
+            # unconditionally (checked at the end)
+            seen: set = set()
+            for name, keys in noms_added.items():
+                if seen & keys:
+                    self._violation(
+                        f"duplicate nominations across writers: {name}"
+                    )
+                seen |= keys
+        leader = next(
+            (n for n, op in self.ops.items() if op.elector.leading), ""
+        )
+        if not self.leader_history or self.leader_history[-1] != leader:
+            self.leader_history.append(leader)
+
+        self._sync(f"tick {tick} post-ticks")
+        self._kubelet()
+        self._sync(f"tick {tick} final")
+        self._drain_ledgers(tick)
+        self._digest(tick, leader)
+
+    def _drain_ledgers(self, tick: int) -> None:
+        for name in self.names:
+            op = self.ops[name]
+            for led in op.ledger.drain(self._led_seqs[name]):
+                self._led_seqs[name] = led.seq
+                if led.type == "StoreResync":
+                    # resyncs depend on wall-clock thread pacing (a
+                    # transient socket hiccup heals through one); like
+                    # anomaly events they stay out of byte-compared
+                    # surfaces
+                    continue
+                self.trace.fleet_led(tick, name, led)
+
+    def _digest(self, tick: int, leader: str) -> None:
+        env = SimpleNamespace(
+            kube=self.primary.store.kube, cloud=self.cloud, clock=self.clock
+        )
+        self.trace.digest(tick, env)
+        h = hashlib.sha256()
+        for rnd, name, claim in self.launches:
+            h.update(f"{rnd}/{name}/{claim};".encode())
+        self.trace.fleet_tick(
+            tick,
+            leader,
+            self.writers_by_tick.get(tick, []),
+            len(self.launches),
+            h.hexdigest()[:16],
+        )
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> dict:
+        try:
+            self.trace.fleet_meta(
+                self.scenario, self.seed, self.ticks, self.n_operators
+            )
+            for tick in range(self.ticks):
+                self.tick_no = tick
+                events = (
+                    list(self.tape.get(tick, ()))
+                    if self.tape is not None
+                    else self._generate_events(tick)
+                )
+                self._tick(tick, events)
+            # settle re-derives from state in run AND replay (not on the
+            # tape), exactly like the single-operator runner's drain
+            self.crashed.clear()
+            self._settle()
+            report = self._report()
+            self.trace.report(report)
+            return report
+        finally:
+            self.close()
+
+    def _settle(self) -> None:
+        kube = self.kubes[self.names[0]]
+        for i in range(SETTLE_MAX_ROUNDS):
+            if not kube.pending_pods():
+                break
+            self._tick(self.ticks + i, [], phase="settle")
+
+    def _report(self) -> dict:
+        kube = self.kubes[self.names[0]]
+        if kube.pending_pods():
+            self._violation("pods still pending after settle")
+        names = [c for _, _, c in self.launches]
+        doubles = sorted(
+            {c for c in names if names.count(c) > 1}
+        )
+        if doubles:
+            self._violation(f"double-launched claims: {doubles}")
+        live_claims = {
+            c.provider_id
+            for c in kube.node_claims.values()
+            if c.deleted_at is None and c.provider_id
+        }
+        running = {
+            i.id
+            for i in self.cloud.instances.values()
+            if i.state == "running"
+        }
+        if not live_claims <= running:
+            self._violation(
+                f"claims without instances: {sorted(live_claims - running)}"
+            )
+        replicas_led = sorted({n for _, n, _ in self.launches})
+        for name, op in self.ops.items():
+            if not op.kube.wait_synced(timeout=15.0):
+                self._violation(f"mirror {name} never converged")
+
+        # --- read-replica convergence with the primary's rv ordering.
+        # This wait is genuinely wall-clock (real follower threads over
+        # real sockets), so it paces on a real Clock — only the OUTCOME
+        # booleans enter the byte-compared report, and they are
+        # convergence facts, not timings.
+        from karpenter_tpu.utils.clock import Clock
+
+        wall = Clock()
+        replica_synced = False
+        rv_equal = False
+        reader_synced = False
+        deadline = wall.now() + 15.0
+        while wall.now() < deadline:
+            with self.primary.store.lock:
+                p_rv = self.primary.store.rv
+            if (
+                self.replica.store.rv >= p_rv
+                and self.reader.synced_rv >= p_rv
+            ):
+                break
+            wall.sleep(0.02)
+        with self.primary.store.lock, self.replica.store.lock:
+            replica_synced = self.replica.store.rv == self.primary.store.rv
+            # rv ordering compared over the keys the primary SERVES: a
+            # snapshot resync carries no delete tombstones, so a
+            # follower that had to snapshot mid-run legitimately lacks
+            # rv entries for long-gone keys
+            from karpenter_tpu.state.wire import STORE_KINDS
+
+            present = {
+                (kind, key)
+                for kind, (_c, attr, _k) in STORE_KINDS.items()
+                for key in getattr(self.primary.store.kube, attr)
+            }
+            rv_equal = all(
+                self.replica.store.rvs.get(kk)
+                == self.primary.store.rvs.get(kk)
+                for kk in present
+            )
+            p_state = {
+                attr: {
+                    k: canonical(v)
+                    for k, v in getattr(
+                        self.primary.store.kube, attr
+                    ).items()
+                }
+                for attr in ("pods", "nodes", "node_claims", "node_pools")
+            }
+            r_state = {
+                attr: {
+                    k: canonical(v)
+                    for k, v in getattr(
+                        self.replica.store.kube, attr
+                    ).items()
+                }
+                for attr in ("pods", "nodes", "node_claims", "node_pools")
+            }
+            replica_synced = replica_synced and p_state == r_state
+        reader_synced = all(
+            canonical(self.reader.pods[k]) == canonical(v)
+            for k, v in self.primary.store.kube.pods.items()
+            if k in self.reader.pods
+        ) and set(self.reader.pods) == set(self.primary.store.kube.pods)
+        if not (replica_synced and rv_equal):
+            self._violation("read replica diverged from the primary")
+        if not reader_synced:
+            self._violation("replica reader mirror diverged")
+
+        store = self.primary.store
+        compactions = self.primary.registry.counter(
+            "karpenter_store_compactions_total", {"log": "replay"}
+        )
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "ticks": self.ticks,
+            "operators": self.n_operators,
+            "launches": len(self.launches),
+            "double_launches": len(doubles),
+            "replicas_led": replicas_led,
+            "leader_transitions": max(0, len(self.leader_history) - 1),
+            "writers_max_per_tick": max(
+                (len(w) for w in self.writers_by_tick.values()), default=0
+            ),
+            "store": {
+                "codec": sorted(
+                    {k._sock_codec for k in self.kubes.values()}
+                ),
+                "rv": store.rv,
+                "seq": store.log_seq,
+                "replay_log_compactions": int(compactions),
+                "slow_watcher_overflowed": self.sink.overflows >= 1,
+            },
+            "replica": {
+                "synced": replica_synced,
+                "rv_ordering_preserved": rv_equal,
+                "reader_synced": reader_synced,
+            },
+            "invariants": {"violations": self.violations},
+        }
+
+    def close(self) -> None:
+        for kube in self.kubes.values():
+            kube.close()
+        self.reader.close()
+        self.replica.stop()
+        self.primary.stop()
+        self.trace.close()
+
+
+# ------------------------------------------------------------------ entry
+def run_fleet(
+    scenario: str,
+    seed: int,
+    ticks: int,
+    trace: Optional[_FleetTrace] = None,
+) -> Tuple[FleetRunner, dict]:
+    runner = FleetRunner(scenario, seed, ticks, trace=trace or _FleetTrace())
+    report = runner.run()
+    return runner, report
+
+
+def read_fleet_tape(
+    path: str,
+) -> Tuple[dict, Dict[int, List[Tuple[str, dict]]], Optional[dict]]:
+    meta: Optional[dict] = None
+    tape: Dict[int, List[Tuple[str, dict]]] = {}
+    report: Optional[dict] = None
+    for line in read_trace(path):
+        t = line.get("t")
+        if t == "meta":
+            meta = line
+        elif t == "ev":
+            tape.setdefault(line["tick"], []).append(
+                (line["kind"], line["data"])
+            )
+        elif t == "report":
+            report = line["slo"]
+    if meta is None or not meta.get("fleet"):
+        raise ValueError(f"not a fleet trace (no fleet meta line): {path}")
+    return meta, tape, report
+
+
+def replay_fleet(
+    path: str, trace: Optional[_FleetTrace] = None
+) -> Tuple[FleetRunner, dict, Optional[dict]]:
+    meta, tape, recorded = read_fleet_tape(path)
+    runner = FleetRunner(
+        meta["scenario"],
+        meta["seed"],
+        meta["ticks"],
+        operators=meta.get("operators", 3),
+        trace=trace or _FleetTrace(),
+        tape=tape,
+    )
+    report = runner.run()
+    return runner, report, recorded

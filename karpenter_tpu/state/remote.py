@@ -40,18 +40,35 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
+from karpenter_tpu.metrics.registry import Registry
 from karpenter_tpu.obs.context import current_trace_id
-from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
+from karpenter_tpu.service.codec import (
+    CODEC_BIN,
+    CODEC_JSON,
+    decode_payload,
+    encode_payload,
+    recv_frame,
+    send_frame,
+)
+from karpenter_tpu.state.binwire import SCHEMA_FP
 from karpenter_tpu.state.kube import KubeStore
-from karpenter_tpu.state.wire import STORE_KINDS, canonical, from_wire, to_wire
+from karpenter_tpu.state.wire import (
+    STORE_KINDS,
+    canonical,
+    from_wire,
+    materialize,
+    to_wire,
+)
 from karpenter_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)
 
 RETRIES = 3
 BACKOFF_S = 0.05  # doubles per attempt
+EVENTS_CAP = 4096  # mirror-side cluster-event ledger bound (default)
 
 
 class StoreUnavailableError(ConnectionError):
@@ -69,11 +86,27 @@ class RemoteKubeStore(KubeStore):
         request_timeout: float = 10.0,
         start_watch: bool = True,
         clock: Optional[Clock] = None,
+        codec: str = "auto",
+        registry: Optional[Registry] = None,
+        events_cap: int = EVENTS_CAP,
     ):
         super().__init__()
         self.host = host
         self.port = port
         self.identity = identity or f"client-{id(self):x}"
+        # payload-codec preference: "auto" negotiates the compact binary
+        # codec per connection (`hello` on the RPC socket, `codecs` on
+        # the watch request) and falls back to tagged JSON against a
+        # server that doesn't speak it; "json" never negotiates.
+        self.codec = codec
+        # store-plane telemetry (karpenter_store_rpc_seconds,
+        # karpenter_store_bytes_*, StoreResync ledger events) lands in
+        # the caller's registry — pass the operator's so the flight
+        # recorder and doctor see the client half of the store plane.  A
+        # bare default registry drops ledger events by design.
+        self.registry = registry or Registry()
+        # mirror-side cluster-event ledger bound (Settings.store_events_cap)
+        self.events_cap = events_cap
         # injectable pacing clock: retry backoff and wait_synced polling
         # sleep on it, so under a FakeClock (the simulator's determinism
         # contract — no raw time.sleep outside utils/clock.py) the waits
@@ -87,6 +120,7 @@ class RemoteKubeStore(KubeStore):
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
         self._sock: Optional[socket.socket] = None
+        self._sock_codec = CODEC_JSON  # negotiated per RPC connection
         self._rpc_lock = threading.Lock()  # one in-flight RPC per conn
         self._mirror_lock = threading.RLock()  # mirror + rv bookkeeping
         self._lease_mutex = threading.Lock()  # lease ops end-to-end
@@ -95,6 +129,18 @@ class RemoteKubeStore(KubeStore):
         self._lease_rvs: Dict[str, int] = {}
         self._event_rv = 0
         self.synced_rv = 0
+        # last seq contiguously applied from the WATCH stream (snapshot
+        # or event frames) — the delta-resync cursor.  NOT synced_rv:
+        # that also counts rvs from our own RPC responses, whose
+        # neighboring foreign events may still be in flight on the watch
+        # socket; replaying from synced_rv could skip them.
+        self._watch_seq = 0
+        # ...and the epoch that seq belongs to: seq spaces are
+        # per-VersionedStore, and the server refuses to treat a cursor
+        # from another epoch as covered (a fresh store's seqs could have
+        # overtaken a stale cursor — a bare number proves nothing)
+        self._watch_epoch = ""
+        self.watch_resyncs: Dict[str, int] = {}
         self._stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
         self._watch_sock: Optional[socket.socket] = None
@@ -113,7 +159,57 @@ class RemoteKubeStore(KubeStore):
                 raise StoreUnavailableError(
                     f"cluster store at {self.host}:{self.port}: {exc}"
                 ) from exc
+            self._sock_codec = CODEC_JSON
+            if self.codec == "auto":
+                self._sock_codec = self._hello(self._sock)
         return self._sock
+
+    def _hello(self, sock: socket.socket) -> str:
+        """Negotiate the payload codec for this connection.  The hello
+        itself rides JSON; a server that doesn't know the method (the
+        pre-fleet-scale protocol) answers with an error, which simply
+        means: keep speaking JSON."""
+        self._tx(
+            sock,
+            encode_payload(
+                {
+                    "method": "hello",
+                    "codecs": [CODEC_BIN, CODEC_JSON],
+                    "schema_fp": SCHEMA_FP,
+                    "identity": self.identity,
+                },
+                CODEC_JSON,
+            ),
+            CODEC_JSON,
+        )
+        response = decode_payload(self._rx(sock, CODEC_JSON), CODEC_JSON)
+        if (
+            response.get("status") == "ok"
+            and response.get("codec") == CODEC_BIN
+            and response.get("schema_fp") == SCHEMA_FP
+        ):
+            return CODEC_BIN
+        return CODEC_JSON
+
+    # byte accounting wraps the raw frame I/O so every store family in
+    # karpenter_store_bytes_{sent,received}_total{codec} counts the wire
+    # reality (payload + the 8-byte length prefix)
+    def _tx(self, sock: socket.socket, payload: bytes, codec: str) -> None:
+        self.registry.inc(
+            "karpenter_store_bytes_sent_total",
+            {"codec": codec},
+            by=len(payload) + 8,
+        )
+        send_frame(sock, payload)
+
+    def _rx(self, sock: socket.socket, codec: str) -> bytes:
+        payload = recv_frame(sock)
+        self.registry.inc(
+            "karpenter_store_bytes_received_total",
+            {"codec": codec},
+            by=len(payload) + 8,
+        )
+        return payload
 
     def _close_sock(self) -> None:
         if self._sock is not None:
@@ -135,12 +231,18 @@ class RemoteKubeStore(KubeStore):
         if tid:
             header["ctx"] = {"trace_id": tid}
         last: Optional[Exception] = None
+        t0 = time.perf_counter()
         for attempt in range(RETRIES):
             with self._rpc_lock:
                 try:
                     sock = self._connect()
-                    send_frame(sock, encode(header, {}))
-                    response, _ = decode(recv_frame(sock))
+                    codec = self._sock_codec
+                    self._tx(
+                        sock,
+                        encode_payload(self._prep(header, codec), codec),
+                        codec,
+                    )
+                    response = decode_payload(self._rx(sock, codec), codec)
                     break
                 except socket.timeout as exc:
                     # a timed-out request must surface as retryable, not
@@ -159,9 +261,28 @@ class RemoteKubeStore(KubeStore):
             raise StoreUnavailableError(
                 f"cluster store at {self.host}:{self.port}: {last}"
             ) from last
+        self.registry.observe(
+            "karpenter_store_rpc_seconds",
+            time.perf_counter() - t0,
+            {"method": str(header.get("method", "?"))},
+        )
         if response.get("status") == "error":
             raise RuntimeError(f"store error: {response.get('error')}")
         return response
+
+    @staticmethod
+    def _prep(header: dict, codec: str) -> dict:
+        """Verb headers carry the live OBJECT in ``obj`` (the binary
+        codec ships it natively — no tree build at all); the JSON path
+        converts to the tagged tree here, at encode time."""
+        obj = header.get("obj")
+        if (
+            obj is not None
+            and codec == CODEC_JSON
+            and not isinstance(obj, dict)
+        ):
+            header = dict(header, obj=to_wire(obj))
+        return header
 
     # ------------------------------------------------------------ mirroring
     def _record_applied(self, kind: str, key: str, obj, rv: int) -> None:
@@ -202,7 +323,11 @@ class RemoteKubeStore(KubeStore):
                     if ev["event_rv"] > self._event_rv:
                         self._event_rv = ev["event_rv"]
                         if remote:
-                            self.events.append(from_wire(ev["event"]))
+                            self.events.append(materialize(ev["event"]))
+                            if len(self.events) > self.events_cap:
+                                del self.events[
+                                    : len(self.events) - self.events_cap
+                                ]
                     continue
                 spec = STORE_KINDS.get(kind)
                 if spec is None:
@@ -236,7 +361,7 @@ class RemoteKubeStore(KubeStore):
                     self.synced_rv = max(self.synced_rv, rv)
                     continue
                 local = store_dict.get(key)
-                server_obj = from_wire(ev["obj"])  # decoded once, reused
+                server_obj = materialize(ev["obj"])  # decoded once, reused
                 server_enc = canonical(server_obj)
                 if not remote:
                     # own write: local object IS the source of this event
@@ -263,7 +388,7 @@ class RemoteKubeStore(KubeStore):
             kind = header["kind"]
             key = header.get("key")
             if key is None:  # put headers carry the object, not the key
-                key = STORE_KINDS[kind][2](from_wire(header["obj"]))
+                key = STORE_KINDS[kind][2](materialize(header["obj"]))
             # Whose write won?  If the server's bytes equal what WE tried
             # to push, the "conflict" is our own racing flush (the verb's
             # forward and the renewal thread's flush both shipping the
@@ -276,15 +401,15 @@ class RemoteKubeStore(KubeStore):
             if (
                 server_wire is not None
                 and pushed_wire is not None
-                and canonical(from_wire(server_wire))
-                == canonical(from_wire(pushed_wire))
+                and canonical(materialize(server_wire))
+                == canonical(materialize(pushed_wire))
             ):
                 with self._mirror_lock:
                     local = getattr(self, STORE_KINDS[kind][1]).get(key)
                     if local is not None:
                         self._rvs[(kind, key)] = response["rv"]
                         self._shadow[(kind, key)] = canonical(
-                            from_wire(server_wire)
+                            materialize(server_wire)
                         )
                         return response
             log.warning(
@@ -305,7 +430,7 @@ class RemoteKubeStore(KubeStore):
                 self._record_applied(kind, key, None, rv)
                 self.synced_rv = max(self.synced_rv, rv)
             else:
-                obj = from_wire(obj_wire)
+                obj = materialize(obj_wire)
                 store_dict[key] = obj
                 self._record_applied(kind, key, obj, rv)
 
@@ -362,8 +487,10 @@ class RemoteKubeStore(KubeStore):
         with self._mirror_lock:
             result = local_put(obj)
             base = self._rvs.get((kind, STORE_KINDS[kind][2](obj)), 0)
+        # the live object rides the header; `_prep` tree-ifies it only
+        # when the connection negotiated down to JSON
         self._forward(
-            {"method": "put", "kind": kind, "obj": to_wire(obj), "base_rv": base}
+            {"method": "put", "kind": kind, "obj": obj, "base_rv": base}
         )
         return result
 
@@ -438,6 +565,12 @@ class RemoteKubeStore(KubeStore):
 
     def record_event(self, kind, reason, obj_name, message=""):
         super().record_event(kind, reason, obj_name, message)
+        with self._mirror_lock:
+            # the cap applies to OWN events too, not just watch-absorbed
+            # foreign ones (the server's echo of this event is skipped by
+            # the event_rv check, so this is the only trim site for it)
+            if len(self.events) > self.events_cap:
+                del self.events[: len(self.events) - self.events_cap]
         try:
             response = self._rpc(
                 {
@@ -576,12 +709,35 @@ class RemoteKubeStore(KubeStore):
                 sock = socket.create_connection(
                     (self.host, self.port), timeout=self.connect_timeout
                 )
-                send_frame(
-                    sock,
-                    encode({"method": "watch", "identity": self.identity}, {}),
-                )
-                header, _ = decode(recv_frame(sock))
-                self._apply_snapshot(header["snapshot"])
+                sock.settimeout(self.request_timeout)
+                # delta resync: present the last seq this mirror applied
+                # from the watch stream; the server replays just the gap
+                # when its replay log still covers it, and falls back to
+                # a full snapshot when compaction has passed us by
+                request = {
+                    "method": "watch",
+                    "identity": self.identity,
+                    "codecs": (
+                        [CODEC_BIN, CODEC_JSON]
+                        if self.codec == "auto"
+                        else [CODEC_JSON]
+                    ),
+                    "schema_fp": SCHEMA_FP,
+                    "since_seq": self._watch_seq,
+                    "epoch": self._watch_epoch,
+                }
+                self._tx(sock, encode_payload(request, CODEC_JSON), CODEC_JSON)
+                ack = decode_payload(self._rx(sock, CODEC_JSON), CODEC_JSON)
+                self._note_epoch(str(ack.get("epoch") or ""))
+                if "snapshot" in ack:  # legacy server: inline snapshot
+                    codec = CODEC_JSON
+                    self._apply_snapshot(ack["snapshot"])
+                else:
+                    codec = ack.get("codec", CODEC_JSON)
+                    self._handle_watch_frame(
+                        decode_payload(self._rx(sock, codec), codec),
+                        initial=True,
+                    )
                 backoff = BACKOFF_S
                 # BLOCKING reads: a short recv timeout could fire
                 # mid-frame and desync the stream (the consumed prefix is
@@ -591,9 +747,20 @@ class RemoteKubeStore(KubeStore):
                 sock.settimeout(None)
                 self._watch_sock = sock
                 while not self._stop.is_set():
-                    frame, _ = decode(recv_frame(sock))
-                    self._absorb_events(frame.get("events", ()), remote=True)
-            except (ConnectionError, OSError, ValueError, struct.error):
+                    self._handle_watch_frame(
+                        decode_payload(self._rx(sock, codec), codec)
+                    )
+            except (
+                ConnectionError,
+                OSError,
+                ValueError,
+                KeyError,
+                struct.error,
+            ):
+                # KeyError included (mirroring the replica follower): a
+                # frame missing an expected key — a malformed or
+                # down-version peer — must reconnect-and-resync, never
+                # silently kill the watch thread and freeze the mirror
                 if self._stop.wait(backoff):
                     break
                 backoff = min(backoff * 2, 1.0)
@@ -604,6 +771,73 @@ class RemoteKubeStore(KubeStore):
                         sock.close()
                     except OSError:
                         pass
+
+    def _handle_watch_frame(self, frame: dict, initial: bool = False) -> None:
+        """One pushed watch frame: ordinary events, or a resync the
+        server forced (reconnect gap, or this client fell so far behind
+        that its bounded queue overflowed and was coalesced)."""
+        ftype = frame.get("type")
+        if ftype == "events":
+            self._absorb_events(frame.get("events", ()), remote=True)
+            # frames arrive in seq order on one stream; assignment (not
+            # max) lets a post-restart server's fresh, lower seq epoch
+            # take over (see _apply_snapshot)
+            self._watch_seq = frame.get("seq", self._watch_seq)
+            return
+        if ftype != "resync":
+            return
+        # a mid-stream resync may announce a NEW epoch (a read replica
+        # that had to full-resync from a restarted primary rotates its
+        # own) — the reset must land before the payload applies
+        if "epoch" in frame:
+            self._note_epoch(str(frame.get("epoch") or ""))
+        mode = frame.get("mode", "snapshot")
+        first_sync = initial and self._watch_seq == 0 and self.synced_rv == 0
+        if not first_sync:
+            # a genuine resync (not the very first state transfer):
+            # count it and put it on the decision ledger — a mirror that
+            # keeps resyncing is either too slow or repeatedly cut off
+            self.watch_resyncs[mode] = self.watch_resyncs.get(mode, 0) + 1
+            self.registry.inc(
+                "karpenter_store_resync_total", {"kind": mode}
+            )
+            self.registry.event(
+                "StoreResync", mode=mode, identity=self.identity
+            )
+        if mode == "snapshot":
+            self._apply_snapshot(frame["snapshot"])
+        else:
+            self._absorb_events(frame.get("events", ()), remote=True)
+        self._watch_seq = frame.get("seq", self._watch_seq)
+
+    def _note_epoch(self, epoch: str) -> None:
+        """Adopt the server's epoch id, resetting every old-space cursor
+        the moment a CHANGE is detected — before any payload applies.
+        Doing it at detection time (not at snapshot-apply time) matters:
+        if the connection drops between the ack and the sync frame, the
+        next reconnect must still present a new-epoch-consistent cursor
+        (seq 0), never a new epoch label over an old-space seq that the
+        busy new server's log might falsely 'cover'."""
+        with self._mirror_lock:
+            if epoch == self._watch_epoch:
+                return
+            if self._watch_epoch:
+                # genuine epoch change: old-space cursors are meaningless
+                self._watch_seq = 0
+                self.synced_rv = 0
+                # per-key rvs drop to 0 for CLEAN keys — 0 keeps the
+                # snapshot deletion sweep working (the key is still
+                # provably server-acked) while never vetoing adoption of
+                # new-space rvs.  Dirty keys keep their entries and heal
+                # through flush -> fence conflict -> adopt.
+                for (kind, key) in list(self._rvs):
+                    _cls, attr, _key_fn = STORE_KINDS[kind]
+                    obj = getattr(self, attr).get(key)
+                    if obj is None or not self._locally_dirty(
+                        kind, key, obj
+                    ):
+                        self._rvs[(kind, key)] = 0
+            self._watch_epoch = epoch
 
     def _apply_snapshot(self, snap: dict) -> None:
         """Full-state resync: adopt the server's objects, drop mirror
@@ -632,7 +866,7 @@ class RemoteKubeStore(KubeStore):
                     ):
                         self.synced_rv = max(self.synced_rv, rv)
                         continue
-                    server_obj = from_wire(obj_wire)  # decoded once, reused
+                    server_obj = materialize(obj_wire)  # decoded once
                     if local is not None and canonical(local) == canonical(
                         server_obj
                     ):
@@ -641,9 +875,24 @@ class RemoteKubeStore(KubeStore):
                     store_dict[key] = server_obj
                     self._record_applied(kind, key, server_obj, rv)
                     self._notify(kind, "put", server_obj)
-            self.events = [from_wire(e) for e in snap.get("events", [])]
+            # the cap is an INVARIANT, not a steady-state tendency: a
+            # snapshot from a server with a larger ledger adopts only
+            # the newest events_cap entries
+            self.events = [
+                materialize(e)
+                for e in snap.get("events", [])[-self.events_cap :]
+            ]
             self._event_rv = snap.get("event_rv", self._event_rv)
+            # synced_rv MAXES: it also credits rvs from our own RPC
+            # responses, which the origin-skipping watch stream never
+            # echoes — assignment could regress below a racing own write
+            # and stall wait_synced forever.  Epoch changes already
+            # zeroed it in _note_epoch, so maxing never resurrects an
+            # old space.  _watch_seq assigns: only the watch stream
+            # advances it, and in-epoch a snapshot's seq is >= anything
+            # it delivered.
             self.synced_rv = max(self.synced_rv, snap.get("rv", 0))
+            self._watch_seq = snap.get("seq", 0)
 
     def wait_synced(self, min_rv: Optional[int] = None, timeout: float = 5.0) -> bool:
         """Block until the mirror has applied every server mutation up to

@@ -48,28 +48,32 @@ from karpenter_tpu.api.resources import Resources
 from karpenter_tpu.state.kube import Node, PodDisruptionBudget
 from karpenter_tpu.utils.leader import Lease
 
-_DATACLASSES = {
-    cls.__name__: cls
-    for cls in (
-        BlockDeviceMapping,
-        Disruption,
-        Lease,
-        Node,
-        NodeClaim,
-        NodeClass,
-        NodePool,
-        Overhead,
-        PersistentVolumeClaim,
-        Pod,
-        PodAffinityTerm,
-        PodDisruptionBudget,
-        SelectorTerm,
-        StorageClass,
-        Taint,
-        Toleration,
-        TopologySpreadConstraint,
-    )
-}
+# the CLOSED set of classes the store protocol itself ships.  The binary
+# codec (state/binwire.py) derives its class-id table and schema
+# fingerprint from exactly this tuple, so it must stay static: classes
+# added later via register_dataclass extend the tagged-JSON codec only
+# (the simulator's trace lines), never the negotiated binary protocol.
+STORE_WIRE_CLASSES = (
+    BlockDeviceMapping,
+    Disruption,
+    Lease,
+    Node,
+    NodeClaim,
+    NodeClass,
+    NodePool,
+    Overhead,
+    PersistentVolumeClaim,
+    Pod,
+    PodAffinityTerm,
+    PodDisruptionBudget,
+    SelectorTerm,
+    StorageClass,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+
+_DATACLASSES = {cls.__name__: cls for cls in STORE_WIRE_CLASSES}
 
 def register_dataclass(cls: type) -> type:
     """Extend the wire codec with an additional dataclass.
@@ -182,6 +186,17 @@ def from_wire(data: Any) -> Any:
     if isinstance(data, list):
         return [from_wire(v) for v in data]
     return data
+
+
+def materialize(value: Any) -> Any:
+    """Wire tree OR already-decoded value -> decoded value.
+
+    The negotiated binary codec (state/binwire.py) ships store objects
+    natively, so an event's ``obj`` may arrive as a live dataclass (or a
+    tuple, for cluster-event appends) instead of a tagged tree; the
+    tagged-JSON path always ships trees.  Both halves of the store plane
+    normalize through this one seam."""
+    return from_wire(value) if isinstance(value, (dict, list)) else value
 
 
 def canonical(obj: Any) -> str:

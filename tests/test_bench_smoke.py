@@ -121,6 +121,34 @@ class TestBenchSmoke:
         assert line["sequential_ms"] > 0
         assert line["speedup_vs_sequential"] > 0
 
+    def test_store_ops_line(self, bench_lines):
+        """The fleet-scale store plane's throughput line: the negotiated
+        binary codec must carry >= 3x the tagged-JSON baseline on the
+        same server-side op mix (the acceptance floor), and fewer bytes
+        per op."""
+        line = next(
+            l for l in bench_lines if l["metric"] == "store_ops_mixed_p50"
+        )
+        assert line["kernel"] == "bin1"
+        assert line["subscribers"] >= 8
+        assert line["ops_per_sec_bin1"] > 0 and line["ops_per_sec_json"] > 0
+        assert line["speedup_codec"] >= 3.0, line
+        assert line["bytes_per_op_bin1"] < line["bytes_per_op_json"]
+
+    def test_store_resync_line(self, bench_lines):
+        """The delta watch resync line: a 10-event gap must replay (not
+        snapshot) and move < 10% of the full-snapshot bytes (the
+        acceptance floor)."""
+        line = next(
+            l
+            for l in bench_lines
+            if l["metric"] == "store_watch_resync_p50"
+        )
+        assert line["kind"] == "replay"
+        assert line["gap_events"] == 10
+        assert 0 < line["delta_bytes"] < line["snapshot_bytes"]
+        assert line["bytes_ratio"] < 0.10, line
+
     def test_solve_lines_carry_device_counters(self, bench_lines):
         """Every solve-style line reports the device observatory's cold
         vs warm split: compile counts and transfer bytes for the first

@@ -113,6 +113,7 @@ class TestChart:
         assert extras == {
             "solver-deployment.yaml",
             "store-deployment.yaml",
+            "store-replica.yaml",
             "store-service.yaml",
         }, extras
 
@@ -273,6 +274,51 @@ class TestStoreBackend:
         dep = docs[("Deployment", "karpenter-tpu")]
         (c,) = dep["spec"]["template"]["spec"]["containers"]
         assert any(a.startswith("--store-address=") for a in c["args"])
+
+    def test_store_carries_fleet_scale_bounds(self):
+        """The store-scale knobs (docs/designs/store-scale.md) flow from
+        values to server flags, and the settings configmap carries the
+        matching client posture."""
+        docs = self._docs(
+            {"store.enabled": "true", "store.replayLogEvents": "8192"}
+        )
+        (sc,) = docs[("Deployment", "karpenter-tpu-store")]["spec"][
+            "template"
+        ]["spec"]["containers"]
+        assert "--replay-log-events=8192" in sc["args"]
+        assert "--watch-queue-batches=256" in sc["args"]
+        assert "--events-cap=4096" in sc["args"]
+        import json
+
+        settings = json.loads(
+            docs[("ConfigMap", "karpenter-tpu-global-settings")]["data"][
+                "settings.json"
+            ]
+        )
+        assert settings["store_codec"] == "auto"
+        assert settings["store_events_cap"] == 4096
+
+    def test_read_replica_toggle(self):
+        """store.readReplica.enabled renders a follower Deployment +
+        Service dialing the primary with --replica-of; off by default;
+        refused without the primary."""
+        docs = self._docs({"store.enabled": "true"})
+        assert ("Deployment", "karpenter-tpu-store-replica") not in docs
+        docs = self._docs(
+            {"store.enabled": "true", "store.readReplica.enabled": "true"}
+        )
+        rep = docs[("Deployment", "karpenter-tpu-store-replica")]
+        (rc,) = rep["spec"]["template"]["spec"]["containers"]
+        assert "--replica-of=karpenter-tpu-store:8082" in rc["args"]
+        svc = docs[("Service", "karpenter-tpu-store-replica")]
+        assert (
+            svc["spec"]["selector"].items()
+            <= rep["spec"]["template"]["metadata"]["labels"].items()
+        )
+        with pytest.raises(ValueError, match="readReplica"):
+            render_chart(
+                CHART, {**SET, "store.readReplica.enabled": "true"}
+            )
 
 
 class TestCRDs:

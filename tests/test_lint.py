@@ -41,6 +41,12 @@ baked into the image, so this enforces the checks that catch real rot:
    or the transfer accounting silently rots; a new upload site routes
    through `OBSERVATORY.put(site, ...)` or is consciously allowlisted
    by (file, qualified name).
+10. every wire frame `method`/`type` literal the store plane sends
+    through service/codec.py must appear (backticked) in
+    docs/designs/store-scale.md — the protocol-vocabulary doc-rot
+    guard: a new RPC method or pushed frame type cannot ship without
+    the design doc saying what it means, which is also what keeps the
+    mixed-version negotiation story reviewable.
 """
 
 import ast
@@ -776,3 +782,90 @@ def test_scheduler_update_lint_has_teeth():
         {("karpenter_tpu/controllers/x.py", "C.scan")},
     )
     assert not ok, ok
+
+
+# rule 10: the store plane's wire vocabulary.  A dict literal with a
+# "method" or "type" key and a string-literal value, in a store-plane
+# file, IS a wire frame construction site — the literal is part of the
+# protocol and must be documented in the store design doc's frame
+# vocabulary.  (Receiving-side comparisons reuse the same literals, so
+# guarding construction sites covers the vocabulary.)
+_STORE_FRAME_FILES = (
+    "karpenter_tpu/service/store_server.py",
+    "karpenter_tpu/state/remote.py",
+)
+
+_STORE_FRAME_KEYS = frozenset({"method", "type"})
+
+
+def documented_store_frame_literals() -> set:
+    """Every backticked lowercase token in the store design doc — a
+    superset of the frame vocabulary (prose backticks are harmless
+    extras; the lint only needs sent literals ⊆ this set)."""
+    doc = (
+        pathlib.Path(karpenter_tpu.__path__[0]).parent
+        / "docs" / "designs" / "store-scale.md"
+    )
+    return set(re.findall(r"`([a-z][a-z0-9_]*)`", doc.read_text()))
+
+
+def store_frame_offenders(source: str, rel: str, documented: set):
+    """AST scan: every dict literal carrying a ``"method"``/``"type"``
+    key with a string-literal value must name a documented frame
+    method/type.  Dynamic values (variables, f-strings) are out of
+    scope — the doc cannot enumerate them either."""
+    tree = ast.parse(source)
+    offenders = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key, value in zip(node.keys, node.values):
+            if not (
+                isinstance(key, ast.Constant)
+                and key.value in _STORE_FRAME_KEYS
+                and isinstance(value, ast.Constant)
+                and isinstance(value.value, str)
+            ):
+                continue
+            if value.value not in documented:
+                offenders.append(
+                    f"{rel}:{value.lineno}: frame {key.value} literal "
+                    f"{value.value!r} absent from "
+                    "docs/designs/store-scale.md"
+                )
+    return offenders
+
+
+def test_store_frame_literals_documented():
+    """Doc-rot guard for the store protocol: a frame method/type literal
+    sent through service/codec.py without a docs/designs/store-scale.md
+    entry means someone grew the wire vocabulary and skipped documenting
+    it."""
+    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
+    documented = documented_store_frame_literals()
+    offenders = []
+    for rel in _STORE_FRAME_FILES:
+        path = pkg_root.parent / rel
+        offenders += store_frame_offenders(path.read_text(), rel, documented)
+    assert not offenders, (
+        "store wire frame literals not documented (add them to the "
+        "frame vocabulary in docs/designs/store-scale.md):\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_store_frame_lint_has_teeth():
+    """The checker fires on undocumented method AND type literals, and
+    stays quiet on documented ones and dynamic values."""
+    documented = {"put", "events"}
+    src = (
+        "def f(m):\n"
+        "    a = {'method': 'put', 'kind': 'Pod'}\n"
+        "    b = {'type': 'events', 'seq': 1}\n"
+        "    c = {'method': 'rogue_rpc'}\n"
+        "    d = {'type': 'rogue_frame'}\n"
+        "    e = {'method': m}\n"  # dynamic: out of scope
+    )
+    hits = store_frame_offenders(src, "karpenter_tpu/x.py", documented)
+    assert len(hits) == 2, hits
+    assert "rogue_rpc" in hits[0] and "rogue_frame" in hits[1], hits

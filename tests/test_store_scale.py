@@ -1,0 +1,868 @@
+"""Fleet-scale store plane (docs/designs/store-scale.md).
+
+Covers the four legs of the new plane: (1) the negotiated binary codec —
+round-trip parity with tagged JSON on every wire object, and a binary
+client against a JSON-only (pre-fleet-scale) server negotiating down
+cleanly; (2) delta watch resync — a reconnecting client presents its
+last seq and receives only the gap, falling back to a snapshot once
+compaction passed it; (3) backpressured fan-out — a deliberately wedged
+watch client is coalesced onto a forced resync while healthy clients
+keep streaming (the unbounded-queue regression); (4) compaction bounds
+and the read replica's rv-ordering guarantee.
+"""
+
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api import (
+    NodeClaim,
+    NodeClass,
+    NodePool,
+    Pod,
+    Resources,
+)
+from karpenter_tpu.api.objects import (
+    PodAffinityTerm,
+    SelectorTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api.requirements import Op, Requirement
+from karpenter_tpu.service.codec import (
+    CODEC_BIN,
+    CODEC_JSON,
+    decode_payload,
+    encode_payload,
+    recv_frame,
+    send_frame,
+)
+from karpenter_tpu.service.store_server import StoreServer, VersionedStore
+from karpenter_tpu.state.binwire import (
+    SCHEMA_FP,
+    decode_value,
+    encode_value,
+)
+from karpenter_tpu.state.kube import KubeStore, Node
+from karpenter_tpu.state.remote import RemoteKubeStore
+from karpenter_tpu.state.wire import canonical, from_wire, to_wire
+
+import json
+
+
+def _json_round_trip(obj):
+    return from_wire(json.loads(json.dumps(to_wire(obj))))
+
+
+def _bin_round_trip(obj):
+    return decode_value(encode_value(obj))
+
+
+def _seeded_pod(rng: random.Random) -> Pod:
+    pod = Pod(
+        name=f"p{rng.randrange(10_000)}",
+        requests=Resources(
+            cpu=rng.choice([0.25, 1, 3]), memory=f"{rng.randrange(1, 9)}Gi"
+        ),
+        labels={f"k{i}": f"v{rng.randrange(5)}" for i in range(rng.randrange(3))},
+        node_selector=(
+            {"zone": rng.choice(["a", "b"])} if rng.random() < 0.4 else {}
+        ),
+        phase=rng.choice(["Pending", "Running"]),
+    )
+    if rng.random() < 0.4:
+        pod.required_affinity = [
+            Requirement("z", Op.IN, ["z1", "z2"]),
+            Requirement("gen", Op.GT, ["2"]),
+        ]
+    if rng.random() < 0.3:
+        pod.tolerations = [Toleration(key="t", value="v")]
+    if rng.random() < 0.3:
+        pod.topology_spread = [
+            TopologySpreadConstraint(1, "zone", label_selector=(("x", "y"),))
+        ]
+    if rng.random() < 0.2:
+        pod.pod_affinity = [
+            PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                anti=True,
+                label_selector=(("x", "y"),),
+            )
+        ]
+    return pod
+
+
+def _wire_corpus():
+    rng = random.Random(7)
+    yield NodeClass(
+        name="default",
+        subnet_selector_terms=[SelectorTerm.of(Name="*")],
+        security_group_selector_terms=[SelectorTerm.of(Name="*", Tag="x")],
+    )
+    yield NodePool(name="np", node_class_ref="default", weight=7)
+    yield Node(
+        name="n1",
+        ready=True,
+        labels={"a": "b"},
+        taints=[Taint("k", "v", "NoSchedule")],
+        capacity=Resources(cpu=8, memory="32Gi"),
+        allocatable=Resources(cpu=7.5, memory="30Gi"),
+    )
+    yield NodeClaim(name="c1", labels={"x": "y"}, provider_id="i-123")
+    from karpenter_tpu.state.kube import PodDisruptionBudget
+
+    yield PodDisruptionBudget(
+        name="pdb", label_selector={"app": "web"}, min_available=1
+    )
+    from karpenter_tpu.api import PersistentVolumeClaim, StorageClass
+
+    yield StorageClass(name="sc", zones=("zone-a",))
+    yield PersistentVolumeClaim(name="pvc", storage_class="sc")
+    from karpenter_tpu.utils.leader import Lease
+
+    yield Lease(name="lead", holder="a", renewed_at=12.5, duration_s=15.0)
+    for _ in range(40):
+        yield _seeded_pod(rng)
+
+
+class TestBinCodecParity:
+    def test_every_wire_object_round_trips_identically(self):
+        """Fuzz parity: for every store-protocol object, the binary and
+        JSON codecs decode to canonical-equal values."""
+        for obj in _wire_corpus():
+            via_json = _json_round_trip(obj)
+            via_bin = _bin_round_trip(obj)
+            assert canonical(via_bin) == canonical(obj), type(obj).__name__
+            assert canonical(via_bin) == canonical(via_json)
+
+    def test_scalars_and_containers(self):
+        for v in (
+            None, True, False, 0, -1, 2**70, -(2**70), 1.5, -0.0, "", "héllo",
+            [1, "a", None], (1, 2), frozenset({"b", "a"}),
+            {"k": {"nested": [1.25]}},
+        ):
+            rt = _bin_round_trip(v)
+            assert rt == v and type(rt) is type(v)
+            if isinstance(v, float):
+                assert repr(rt) == repr(v)  # -0.0 survives
+
+    def test_default_elision_shrinks_the_wire(self):
+        pod = Pod(requests=Resources(cpu=1, memory="2Gi"))
+        assert len(encode_value(pod)) < len(
+            json.dumps(to_wire(pod)).encode()
+        ) / 4
+
+    def test_unknown_class_id_and_trailing_bytes_refuse(self):
+        with pytest.raises(ValueError, match="unknown bin1 class id"):
+            decode_value(bytes([13, 250, 1, 0]))
+        with pytest.raises(ValueError, match="trailing"):
+            decode_value(encode_value(1) + b"\x00")
+
+    def test_schema_fp_covers_field_defaults(self):
+        """Elision round-trips through declared defaults, so a build
+        whose DEFAULTS drifted (names unchanged) must fingerprint
+        differently and negotiate down to JSON — otherwise an elided
+        field would silently decode to the wrong value."""
+        import dataclasses as dc
+
+        from karpenter_tpu.state.binwire import _build_tables
+
+        @dc.dataclass
+        class Thing:
+            name: str
+            ready: bool = False
+
+        @dc.dataclass
+        class ThingFlipped:
+            name: str
+            ready: bool = True
+
+        ThingFlipped.__name__ = "Thing"  # same names, drifted default
+        _c1, _i1, fp1 = _build_tables((Thing,))
+        _c2, _i2, fp2 = _build_tables((ThingFlipped,))
+        assert fp1 != fp2
+
+    def test_payload_layer_versioned(self):
+        payload = encode_payload({"method": "ping"}, CODEC_BIN)
+        assert decode_payload(payload, CODEC_BIN) == {"method": "ping"}
+        with pytest.raises(ValueError, match="magic"):
+            decode_payload(b"\x00\x01\x00", CODEC_BIN)
+        with pytest.raises(ValueError, match="version"):
+            decode_payload(bytes([0xB5, 99]) + b"\x00", CODEC_BIN)
+
+
+# --------------------------------------------------------------- harness
+@pytest.fixture
+def server():
+    srv = StoreServer().start_background()
+    yield srv
+    srv.stop()
+
+
+def _client(server, **kw):
+    host, port = server.address
+    return RemoteKubeStore(host, port, **kw)
+
+
+def _default_objects(kube):
+    kube.put_node_class(
+        NodeClass(
+            name="default",
+            subnet_selector_terms=[SelectorTerm.of(Name="*")],
+            security_group_selector_terms=[SelectorTerm.of(Name="*")],
+        )
+    )
+    kube.put_node_pool(NodePool(name="default", node_class_ref="default"))
+
+
+def _raw_watch(
+    server,
+    identity="raw",
+    since_seq=0,
+    codecs=(CODEC_BIN, CODEC_JSON),
+    epoch=None,
+):
+    """A protocol-level watch: returns (sock, ack, first_frame, codec).
+    Presents the server's own epoch by default — a cursor is only
+    meaningful inside the seq space it came from."""
+    sock = socket.create_connection(server.address, timeout=5.0)
+    send_frame(
+        sock,
+        encode_payload(
+            {
+                "method": "watch",
+                "identity": identity,
+                "codecs": list(codecs),
+                "schema_fp": SCHEMA_FP,
+                "since_seq": since_seq,
+                "epoch": server.store.epoch if epoch is None else epoch,
+            },
+            CODEC_JSON,
+        ),
+    )
+    sock.settimeout(5.0)
+    ack = decode_payload(recv_frame(sock), CODEC_JSON)
+    codec = ack.get("codec", CODEC_JSON)
+    first = decode_payload(recv_frame(sock), codec)
+    return sock, ack, first, codec
+
+
+def _wait(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestNegotiation:
+    def test_rpc_connection_negotiates_bin(self, server):
+        a = _client(server, identity="a", start_watch=False)
+        try:
+            a.put_pod(Pod(name="p", requests=Resources(cpu=1)))
+            assert a._sock_codec == CODEC_BIN
+            assert server.store.kube.pods["default/p"].requests.cpu == 1
+        finally:
+            a.close()
+
+    def test_json_pref_never_negotiates(self, server):
+        a = _client(server, identity="a", start_watch=False, codec="json")
+        try:
+            a.put_pod(Pod(name="p", requests=Resources(cpu=1)))
+            assert a._sock_codec == CODEC_JSON
+        finally:
+            a.close()
+
+    def test_binary_client_against_legacy_server_negotiates_down(self):
+        """The mixed-version session: a binary-preferring client against
+        the pre-fleet-scale protocol (no hello, inline-snapshot watch)
+        stays fully functional over tagged JSON."""
+        srv = StoreServer(legacy_protocol=True).start_background()
+        a = b = None
+        try:
+            a = _client(srv, identity="a")
+            b = _client(srv, identity="b")
+            assert a.wait_synced()
+            _default_objects(a)
+            a.put_pod(Pod(name="p1", requests=Resources(cpu=1)))
+            assert a._sock_codec == CODEC_JSON
+            assert b.wait_synced()
+            assert "default/p1" in b.pods
+            a.delete_pod("default/p1")
+            assert b.wait_synced()
+            assert "default/p1" not in b.pods
+        finally:
+            for c in (a, b):
+                if c is not None:
+                    c.close()
+            srv.stop()
+
+    def test_schema_fp_mismatch_falls_back_to_json(self, server):
+        sock = socket.create_connection(server.address, timeout=5.0)
+        try:
+            send_frame(
+                sock,
+                encode_payload(
+                    {
+                        "method": "hello",
+                        "codecs": [CODEC_BIN, CODEC_JSON],
+                        "schema_fp": "someone-elses-build",
+                    },
+                    CODEC_JSON,
+                ),
+            )
+            sock.settimeout(5.0)
+            response = decode_payload(recv_frame(sock), CODEC_JSON)
+            assert response["status"] == "ok"
+            assert response["codec"] == CODEC_JSON
+        finally:
+            sock.close()
+
+
+class TestDeltaResync:
+    def test_reconnect_replays_only_the_gap(self, server):
+        a = _client(server, identity="a", start_watch=False)
+        try:
+            _default_objects(a)
+            for i in range(8):
+                a.put_pod(Pod(name=f"p{i}", requests=Resources(cpu=1)))
+            seen_seq = server.store.log_seq
+            for i in range(8, 18):
+                a.put_pod(Pod(name=f"p{i}", requests=Resources(cpu=1)))
+            sock, ack, first, codec = _raw_watch(server, since_seq=seen_seq)
+            try:
+                assert ack["resync"] == "replay"
+                assert first["type"] == "resync" and first["mode"] == "replay"
+                keys = [
+                    ev["key"] for ev in first["events"] if "key" in ev
+                ]
+                assert keys == [f"default/p{i}" for i in range(8, 18)]
+                # rv ordering preserved in the replayed gap
+                rvs = [ev["rv"] for ev in first["events"]]
+                assert rvs == sorted(rvs)
+            finally:
+                sock.close()
+            assert (
+                server.registry.counter(
+                    "karpenter_store_resync_total", {"kind": "replay"}
+                )
+                >= 1
+            )
+        finally:
+            a.close()
+
+    def test_compacted_gap_falls_back_to_snapshot(self):
+        store = VersionedStore(replay_log_events=5)
+        srv = StoreServer(store=store).start_background()
+        a = None
+        try:
+            a = _client(srv, identity="a", start_watch=False)
+            _default_objects(a)
+            a.put_pod(Pod(name="p0", requests=Resources(cpu=1)))
+            seen_seq = store.log_seq
+            for i in range(1, 20):  # blows past the 5-event replay log
+                a.put_pod(Pod(name=f"p{i}", requests=Resources(cpu=1)))
+            assert store.compacted_seq > seen_seq
+            sock, ack, first, codec = _raw_watch(srv, since_seq=seen_seq)
+            try:
+                assert ack["resync"] == "snapshot"
+                assert first["mode"] == "snapshot"
+                assert len(first["snapshot"]["kinds"]["Pod"]) == 20
+            finally:
+                sock.close()
+            assert (
+                srv.registry.counter(
+                    "karpenter_store_resync_total", {"kind": "snapshot"}
+                )
+                >= 1
+            )
+            assert (
+                srv.registry.counter(
+                    "karpenter_store_compactions_total", {"log": "replay"}
+                )
+                >= 1
+            )
+        finally:
+            if a is not None:
+                a.close()
+            srv.stop()
+
+    def test_live_client_heals_through_delta_resync(self, server):
+        """Kill a mirror's watch socket mid-stream: the reconnect
+        presents since_seq and the server replays just the gap — the
+        mirror converges without a full snapshot."""
+        a = _client(server, identity="a", start_watch=False)
+        b = _client(server, identity="b")
+        try:
+            _default_objects(a)
+            a.put_pod(Pod(name="p0", requests=Resources(cpu=1)))
+            assert b.wait_synced()
+            assert b._watch_seq > 0
+            # sever b's stream, then write while it is dark
+            _wait(lambda: b._watch_sock is not None, msg="watch socket")
+            b._watch_sock.close()
+            for i in range(1, 6):
+                a.put_pod(Pod(name=f"p{i}", requests=Resources(cpu=1)))
+            assert b.wait_synced(timeout=10.0)
+            assert set(b.pods) == set(server.store.kube.pods)
+            _wait(
+                lambda: server.registry.counter(
+                    "karpenter_store_resync_total", {"kind": "replay"}
+                )
+                >= 1,
+                msg="server-side replay resync count",
+            )
+        finally:
+            a.close()
+            b.close()
+
+
+class TestSeqEpochReset:
+    def test_fresh_server_epoch_resets_the_resync_cursor(self):
+        """Seq spaces are per-server: a store restarted over a FRESH
+        VersionedStore starts a new epoch at 0.  The mirror must ADOPT
+        the new epoch's seq from its snapshot resync (never max() it
+        with the stale higher cursor), or a later reconnect would
+        present an inflated since_seq and receive a wrong delta that
+        silently skips events."""
+        srv1 = StoreServer().start_background()
+        host, port = srv1.address
+        a = RemoteKubeStore(host, port, identity="writer", start_watch=False)
+        b = RemoteKubeStore(host, port, identity="mirror")
+        try:
+            _default_objects(a)
+            for i in range(30):
+                a.put_pod(Pod(name=f"old{i}", requests=Resources(cpu=1)))
+            assert b.wait_synced()
+            stale_seq = b._watch_seq
+            assert stale_seq >= 30
+            a.close()
+            srv1.stop()
+            # a NEW store (fresh epoch) on the same address, pre-seeded
+            # with different state (its log does not reach genesis for
+            # the mirror's cursor — snapshot is the only honest sync)
+            kube = KubeStore()
+            kube.put_pod(Pod(name="fresh", requests=Resources(cpu=1)))
+            srv2 = StoreServer(host, port, store=VersionedStore(kube))
+            srv2.start_background()
+            try:
+                _wait(
+                    lambda: "default/fresh" in b.pods
+                    and "default/old0" not in b.pods,
+                    timeout=10.0,
+                    msg="mirror adoption of the new epoch's state",
+                )
+                # the cursor was ASSIGNED the new epoch's seq — not
+                # maxed with the stale one
+                _wait(
+                    lambda: b._watch_seq <= srv2.store.log_seq,
+                    msg="resync cursor reset to the new epoch",
+                )
+                assert b._watch_seq < stale_seq
+                # and delta resync works from the NEW epoch: new events
+                # flow, and the mirror tracks the new seq space
+                w = RemoteKubeStore(
+                    host, port, identity="writer2", start_watch=False
+                )
+                try:
+                    w.put_pod(Pod(name="after", requests=Resources(cpu=1)))
+                    assert b.wait_synced(timeout=10.0)
+                    assert "default/after" in b.pods
+                finally:
+                    w.close()
+            finally:
+                srv2.stop()
+        finally:
+            b.close()
+
+
+    def test_overtaken_cursor_from_another_epoch_still_snapshots(self):
+        """The deeper epoch hazard: a fresh store whose NEW seq space
+        has already OVERTAKEN the stale cursor would look 'covered' to a
+        bare number — the epoch id in the handshake is what forces the
+        honest snapshot instead of a wrong delta that silently skips the
+        inter-epoch divergence."""
+        srv1 = StoreServer().start_background()
+        host, port = srv1.address
+        a = RemoteKubeStore(host, port, identity="writer", start_watch=False)
+        b = RemoteKubeStore(host, port, identity="mirror")
+        try:
+            _default_objects(a)
+            for i in range(5):
+                a.put_pod(Pod(name=f"old{i}", requests=Resources(cpu=1)))
+            assert b.wait_synced()
+            stale_seq = b._watch_seq
+            old_epoch = b._watch_epoch
+            assert stale_seq >= 5 and old_epoch
+            a.close()
+            srv1.stop()
+            # fresh epoch whose log OVERTAKES the stale cursor and
+            # reaches genesis — a bare since_seq would be "covered"
+            srv2 = StoreServer(host, port).start_background()
+            try:
+                w = RemoteKubeStore(
+                    host, port, identity="writer2", start_watch=False
+                )
+                try:
+                    _default_objects(w)
+                    for i in range(stale_seq + 5):
+                        w.put_pod(
+                            Pod(name=f"new{i}", requests=Resources(cpu=1))
+                        )
+                    assert srv2.store.covers(
+                        stale_seq, srv2.store.epoch
+                    ), "precondition: the bare number WOULD be covered"
+                    assert not srv2.store.covers(stale_seq, old_epoch)
+                    _wait(
+                        lambda: "default/new0" in b.pods
+                        and not any(k.startswith("default/old") for k in b.pods),
+                        timeout=10.0,
+                        msg="mirror snapshot-adoption across epochs",
+                    )
+                    assert b._watch_epoch == srv2.store.epoch
+                    # the reconnect was served as a SNAPSHOT resync
+                    _wait(
+                        lambda: srv2.registry.counter(
+                            "karpenter_store_resync_total",
+                            {"kind": "snapshot"},
+                        )
+                        >= 1,
+                        msg="snapshot resync counted",
+                    )
+                finally:
+                    w.close()
+            finally:
+                srv2.stop()
+        finally:
+            b.close()
+
+
+class TestBackpressure:
+    def test_bounded_queue_overflow_coalesces(self):
+        """The unbounded `_Subscriber` queue regression: a subscriber
+        that never drains stops accumulating at the bound and flips to
+        one pending resync, no matter how many more events land."""
+        store = VersionedStore(watch_queue_batches=4)
+        mode, payload, sub = store.subscribe("wedged", CODEC_JSON)
+        kube = store.kube
+        for i in range(50):
+            store.mutate(
+                lambda i=i: kube.put_pod(
+                    Pod(name=f"p{i}", requests=Resources(cpu=1))
+                )
+            )
+        assert len(sub.batches) == 0  # cleared on overflow
+        assert sub.pending_resync
+        assert sub.overflows >= 1
+        # a healthy subscriber registered after the wedge still gets
+        # every event
+        mode2, payload2, sub2 = store.subscribe("healthy", CODEC_JSON)
+        store.mutate(
+            lambda: kube.put_pod(Pod(name="late", requests=Resources(cpu=1)))
+        )
+        assert len(sub2.batches) == 1
+        assert (
+            store.registry.gauge("karpenter_store_watch_queue_depth") <= 4
+        )
+
+    def test_wedged_socket_client_is_resynced_not_oomed(self):
+        """End-to-end: a watch client that stops reading wedges its TCP
+        stream; the server's bounded queue coalesces it onto a forced
+        resync while a healthy mirror keeps streaming.  When the wedged
+        client finally reads again, it receives a resync frame and ends
+        up consistent."""
+        store = VersionedStore(watch_queue_batches=4)
+        srv = StoreServer(store=store).start_background()
+        a = b = None
+        wedged = None
+        try:
+            a = _client(srv, identity="a", start_watch=False)
+            b = _client(srv, identity="b")
+            _default_objects(a)
+            # the wedged client: reads the initial snapshot, then stops.
+            # A tiny receive buffer (set BEFORE connect, or it is
+            # ignored) makes the server's sendall block fast.
+            wedged = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            wedged.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+            wedged.settimeout(10.0)
+            wedged.connect(srv.address)
+            send_frame(
+                wedged,
+                encode_payload(
+                    {
+                        "method": "watch",
+                        "identity": "wedged",
+                        "codecs": [CODEC_JSON],
+                        "schema_fp": SCHEMA_FP,
+                        "since_seq": 0,
+                    },
+                    CODEC_JSON,
+                ),
+            )
+            wedged.settimeout(10.0)
+            decode_payload(recv_frame(wedged), CODEC_JSON)  # ack
+            decode_payload(recv_frame(wedged), CODEC_JSON)  # snapshot
+            # large objects so the kernel buffers fill fast
+            blob = "x" * 262_144
+            for i in range(40):
+                a.put_pod(
+                    Pod(
+                        name=f"big{i}",
+                        requests=Resources(cpu=1),
+                        labels={"blob": blob},
+                    )
+                )
+            # the wedged subscriber's queue overflowed and was coalesced
+            # (its sender thread is still blocked in sendall, so the
+            # resync COUNTER moves only once the client drains — the
+            # bounded-memory guarantee is the flag + the cleared queue)
+            def _wedged_overflowed():
+                with store.lock:
+                    return any(
+                        s.identity == "wedged" and s.overflows >= 1
+                        for s in store._subscribers
+                    )
+
+            _wait(_wedged_overflowed, timeout=10.0, msg="queue overflow")
+            # server memory stayed bounded: no subscriber queue past cap
+            with store.lock:
+                assert all(
+                    len(s.batches) <= 4 for s in store._subscribers
+                )
+            # the healthy mirror never stalled behind the wedged one
+            assert b.wait_synced(timeout=10.0)
+            assert len(b.pods) == 40
+            # the wedged client resumes: drain until the resync marker
+            wedged.settimeout(10.0)
+            saw_resync = False
+            for _ in range(200):
+                frame = decode_payload(recv_frame(wedged), CODEC_JSON)
+                if frame.get("type") == "resync":
+                    saw_resync = True
+                    assert frame["mode"] in ("replay", "snapshot")
+                    break
+            assert saw_resync
+            _wait(
+                lambda: srv.registry.counter(
+                    "karpenter_store_resync_total", {"kind": "overflow"}
+                )
+                >= 1,
+                msg="overflow resync counted once served",
+            )
+        finally:
+            if wedged is not None:
+                wedged.close()
+            for c in (a, b):
+                if c is not None:
+                    c.close()
+            srv.stop()
+
+
+class TestCompaction:
+    def test_mirror_ledger_bounded_for_own_events_too(self, server):
+        """The mirror-side cap applies to events THIS client records,
+        not only watch-absorbed foreign ones (the server's echo of an
+        own event is skipped by the event_rv check, so the local append
+        is the only copy that needs trimming)."""
+        a = _client(server, identity="a", start_watch=False, events_cap=5)
+        try:
+            for i in range(12):
+                a.record_event("Pod", "Created", f"p{i}", "msg")
+            assert len(a.events) <= 5
+            assert a.events[-1][2] == "p11"  # newest retained
+        finally:
+            a.close()
+
+    def test_replicated_snapshot_resets_the_rv_map(self):
+        """A snapshot has no tombstones: applying one must REPLACE the
+        rv map (stale entries for keys the primary deleted would leave
+        the mirror's rv bookkeeping diverged from what it serves)."""
+        store = VersionedStore()
+        store.rvs[("Pod", "default/ghost")] = 7  # pre-snapshot leftover
+        donor = VersionedStore()
+        donor.mutate(
+            lambda: donor.kube.put_pod(
+                Pod(name="real", requests=Resources(cpu=1))
+            )
+        )
+        store.apply_replicated_snapshot(donor.snapshot())
+        assert ("Pod", "default/ghost") not in store.rvs
+        assert store.rvs == donor.rvs
+
+    def test_replay_log_and_event_ledger_stay_bounded(self):
+        store = VersionedStore(replay_log_events=10, events_cap=5)
+        srv = StoreServer(store=store).start_background()
+        a = None
+        try:
+            a = _client(srv, identity="a", start_watch=False)
+            _default_objects(a)
+            for i in range(40):
+                a.put_pod(Pod(name=f"p{i}", requests=Resources(cpu=1)))
+                a.record_event("Pod", "Created", f"p{i}", "msg")
+            with store.lock:
+                assert store._log_events <= 10 + 1
+                assert store.compacted_seq > 0
+                assert len(store.kube.events) <= 5
+            assert (
+                srv.registry.counter(
+                    "karpenter_store_compactions_total", {"log": "events"}
+                )
+                >= 1
+            )
+            # snapshots ship only the retained ledger
+            sock, ack, first, codec = _raw_watch(srv, identity="late")
+            try:
+                assert len(first["snapshot"]["events"]) <= 5
+            finally:
+                sock.close()
+        finally:
+            if a is not None:
+                a.close()
+            srv.stop()
+
+
+class TestReadReplica:
+    def test_replica_serves_snapshot_and_watch_with_primary_rv_order(self):
+        primary = StoreServer().start_background()
+        replica = StoreServer(replica_of=primary.address).start_background()
+        a = r = None
+        try:
+            a = _client(primary, identity="writer", start_watch=False)
+            _default_objects(a)
+            for i in range(12):
+                a.put_pod(Pod(name=f"p{i}", requests=Resources(cpu=1)))
+            a.record_event("Pod", "Created", "p0", "hello")
+            _wait(
+                # cluster-event appends move event_rv, not rv — wait on
+                # BOTH spaces or the ledger comparison below races the
+                # Event batch still in flight
+                lambda: len(replica.store.kube.pods) == 12
+                and replica.store.rv >= primary.store.rv
+                and replica.store.event_rv >= primary.store.event_rv,
+                msg="replica convergence",
+            )
+            # the replica preserved the PRIMARY's rv numbers, key by key
+            with primary.store.lock, replica.store.lock:
+                assert replica.store.rvs == primary.store.rvs
+                assert replica.store.rv == primary.store.rv
+                assert [tuple(e) for e in replica.store.kube.events] == [
+                    tuple(e) for e in primary.store.kube.events
+                ]
+            # a read client against the replica mirrors the same state
+            r = _client(replica, identity="reader")
+            assert r.wait_synced(timeout=10.0)
+            assert set(r.pods) == set(a.pods)
+            assert canonical(r.pods["default/p3"]) == canonical(
+                a.pods["default/p3"]
+            )
+            # live updates flow primary -> replica -> reader, rv-ordered
+            a.put_pod(Pod(name="fresh", requests=Resources(cpu=2)))
+            _wait(
+                lambda: "default/fresh" in r.pods, msg="replicated update"
+            )
+            assert (
+                r._rvs[("Pod", "default/fresh")]
+                == primary.store.rvs[("Pod", "default/fresh")]
+            )
+        finally:
+            if r is not None:
+                r.close()
+            if a is not None:
+                a.close()
+            replica.stop()
+            primary.stop()
+
+    def test_replica_refuses_writes_and_names_the_primary(self):
+        primary = StoreServer().start_background()
+        replica = StoreServer(replica_of=primary.address).start_background()
+        w = None
+        try:
+            w = _client(replica, identity="wrong-way", start_watch=False)
+            with pytest.raises(RuntimeError, match="read-only replica"):
+                w.put_pod(Pod(name="p", requests=Resources(cpu=1)))
+            assert not primary.store.kube.pods
+            assert not replica.store.kube.pods
+        finally:
+            if w is not None:
+                w.close()
+            replica.stop()
+            primary.stop()
+
+    def test_replica_follows_a_legacy_primary(self):
+        """A read replica against a pre-fleet-scale primary (inline
+        snapshot, seq-less event frames): the follower must keep
+        replicating — a frame without a seq key must never kill the
+        follower thread — with every reconnect honestly snapshotting
+        (the legacy protocol has no delta space)."""
+        primary = StoreServer(legacy_protocol=True).start_background()
+        replica = StoreServer(replica_of=primary.address).start_background()
+        a = None
+        try:
+            a = _client(primary, identity="writer", start_watch=False)
+            _default_objects(a)
+            for i in range(6):
+                a.put_pod(Pod(name=f"p{i}", requests=Resources(cpu=1)))
+            _wait(
+                lambda: len(replica.store.kube.pods) == 6,
+                timeout=10.0,
+                msg="replication through the legacy stream",
+            )
+            # keep flowing: the follower thread survived the seq-less
+            # frames (the KeyError-kills-the-thread regression)
+            a.put_pod(Pod(name="late", requests=Resources(cpu=1)))
+            _wait(
+                lambda: "default/late" in replica.store.kube.pods,
+                timeout=10.0,
+                msg="continued replication",
+            )
+            with primary.store.lock, replica.store.lock:
+                assert replica.store.rvs == primary.store.rvs
+        finally:
+            if a is not None:
+                a.close()
+            replica.stop()
+            primary.stop()
+
+    def test_replica_follower_delta_resyncs_after_disconnect(self):
+        primary = StoreServer().start_background()
+        replica = StoreServer(replica_of=primary.address).start_background()
+        a = None
+        try:
+            a = _client(primary, identity="writer", start_watch=False)
+            _default_objects(a)
+            a.put_pod(Pod(name="p0", requests=Resources(cpu=1)))
+            _wait(
+                lambda: "default/p0" in replica.store.kube.pods,
+                msg="initial replication",
+            )
+            # sever the follower link; write in the dark; it heals via
+            # a replay (primary's resync counter moves)
+            _wait(
+                lambda: replica._follow_sock is not None, msg="follow sock"
+            )
+            replica._follow_sock.close()
+            for i in range(1, 6):
+                a.put_pod(Pod(name=f"p{i}", requests=Resources(cpu=1)))
+            _wait(
+                lambda: len(replica.store.kube.pods) == 6,
+                timeout=10.0,
+                msg="replica re-convergence",
+            )
+            with primary.store.lock, replica.store.lock:
+                assert replica.store.rvs == primary.store.rvs
+            assert (
+                primary.registry.counter(
+                    "karpenter_store_resync_total", {"kind": "replay"}
+                )
+                >= 1
+            )
+        finally:
+            if a is not None:
+                a.close()
+            replica.stop()
+            primary.stop()
