@@ -19,9 +19,27 @@ class Settings:
     enable_pod_eni: bool = False
     enable_eni_limited_pod_density: bool = True
     feature_gate_drift: bool = True
-    # pod batching window (settings.md:43-47)
-    batch_idle_duration: float = 1.0
-    batch_max_duration: float = 10.0
+    # provisioning pod batching window (settings.md:43-47): a batch opens
+    # when the first pending pod appears and closes after
+    # provision_batch_idle_s of quiet or provision_batch_max_s total —
+    # the same idle/max discipline batcher/core.py applies to CreateFleet
+    # coalescing (the shared CoalesceWindow arithmetic), on the injected
+    # clock instead of wall time
+    provision_batch_idle_s: float = 1.0
+    provision_batch_max_s: float = 10.0
+    # pipelined reconcile (pipeline.py + docs/designs/pipelined-reconcile
+    # .md): the disruption controller speculatively DISPATCHES its
+    # consolidation search's device rounds at tick boundaries so the
+    # device scores removal masks while the host runs the other
+    # controllers; a fingerprint guard makes actions bit-identical to the
+    # sequential order (the simulator forces this off — its traces are
+    # byte-compared against the sequential schedule)
+    enable_pipelined_reconcile: bool = True
+    # cap on concurrent NodeClaim launches per provisioning flush (the
+    # CreateFleet batcher coalesces them underneath); the sim pins the
+    # provisioner's launch_concurrency override to 1 instead — thread
+    # scheduling must never order a byte-compared cloud-call stream
+    launch_max_concurrency: int = 64
     # span tracing / profiling, off by default (the ENABLE_PROFILING flag,
     # settings.md:18); profile_dir additionally enables the XLA timeline
     # for solver dispatches (TensorBoard-readable)
@@ -82,6 +100,15 @@ class Settings:
     store_codec: str = "auto"
     store_events_cap: int = 4096
 
+    # legacy names accepted on ingest (file and env) so a configmap or
+    # environment written before the provision_batch_* rename keeps
+    # working across an image upgrade; the new name wins when both are
+    # present
+    _LEGACY_NAMES = {
+        "batch_idle_duration": "provision_batch_idle_s",
+        "batch_max_duration": "provision_batch_max_s",
+    }
+
     @classmethod
     def from_file(cls, path: str) -> "Settings":
         """Load from a JSON file — the configmap analogue
@@ -90,6 +117,9 @@ class Settings:
 
         with open(path) as f:
             raw = json.load(f)
+        for old, new in cls._LEGACY_NAMES.items():
+            if old in raw:
+                raw.setdefault(new, raw.pop(old))
         known = {f.name for f in cls.__dataclass_fields__.values()}
         unknown = set(raw) - known
         if unknown:
@@ -106,9 +136,15 @@ class Settings:
         import os
 
         environ = environ if environ is not None else os.environ
+        legacy_of = {new: old for old, new in cls._LEGACY_NAMES.items()}
         kw: Dict[str, object] = {}
         for f in cls.__dataclass_fields__.values():
             raw = environ.get(f"KARPENTER_{f.name.upper()}")
+            if raw is None and f.name in legacy_of:
+                # pre-rename env var: accepted, new name wins when both set
+                raw = environ.get(
+                    f"KARPENTER_{legacy_of[f.name].upper()}"
+                )
             if raw is None:
                 continue
             if f.type in ("bool", bool):
@@ -128,10 +164,14 @@ class Settings:
             raise ValueError("cluster_name is required")
         if not (0.0 <= self.vm_memory_overhead_percent < 1.0):
             raise ValueError("vm_memory_overhead_percent must be in [0,1)")
-        if self.batch_idle_duration < 0 or self.batch_max_duration < 0:
+        if self.provision_batch_idle_s < 0 or self.provision_batch_max_s < 0:
             raise ValueError("batch windows must be non-negative")
-        if self.batch_max_duration < self.batch_idle_duration:
-            raise ValueError("batch_max_duration must be >= batch_idle_duration")
+        if self.provision_batch_max_s < self.provision_batch_idle_s:
+            raise ValueError(
+                "provision_batch_max_s must be >= provision_batch_idle_s"
+            )
+        if self.launch_max_concurrency < 1:
+            raise ValueError("launch_max_concurrency must be >= 1")
         if self.reserved_enis < 0:
             raise ValueError("reserved_enis must be >= 0")
         if self.cloud_max_retries < 0 or self.cloud_retry_budget_per_tick < 0:

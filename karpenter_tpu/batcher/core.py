@@ -18,11 +18,56 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Hashable, List, Sequence, Tuple
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
 
 CREATE_FLEET_WINDOWS = (0.035, 1.0, 1000)
 DESCRIBE_WINDOWS = (0.1, 1.0, 500)
 TERMINATE_WINDOWS = (0.1, 1.0, 500)
+
+
+@dataclass
+class CoalesceWindow:
+    """The idle/max coalescing deadline arithmetic, time-source-agnostic.
+
+    One definition shared by BOTH batching layers: the cloud-call buckets
+    below (wall-clock `time.monotonic`) and the provisioner's pod batch
+    window (the injected Clock — controllers/provisioning.PodBatcher), so
+    the reference's "1s idle / 10s max pod batching, 35ms idle / 1s max
+    CreateFleet coalescing" discipline has exactly one implementation.
+
+    A window OPENS at the first arrival and CLOSES when `idle_s` passes
+    with no new arrivals or `max_s` elapses since the first one; callers
+    that also cap by item count check that themselves (the deadline is
+    pure time arithmetic).
+    """
+
+    idle_s: float
+    max_s: float
+    first_at: Optional[float] = None
+    last_at: Optional[float] = None
+
+    @property
+    def open(self) -> bool:
+        return self.first_at is not None
+
+    def observe(self, now: float, fresh: bool = True) -> None:
+        """An arrival at `now`; `fresh=False` re-observations (the same
+        pending pods seen again next tick) do not push the idle deadline."""
+        if self.first_at is None:
+            self.first_at = now
+            self.last_at = now
+        elif fresh:
+            self.last_at = now
+
+    def deadline(self) -> float:
+        assert self.first_at is not None and self.last_at is not None
+        return min(self.last_at + self.idle_s, self.first_at + self.max_s)
+
+    def ready(self, now: float) -> bool:
+        return self.open and now >= self.deadline()
+
+    def reset(self) -> None:
+        self.first_at = self.last_at = None
 
 
 @dataclass
@@ -107,13 +152,13 @@ class _Bucket:
         self.items: List[Tuple[Any, Future]] = []
         self.closed = False
         self._cv = threading.Condition()
-        self._first_at = time.monotonic()
-        self._last_at = self._first_at
+        self._window = CoalesceWindow(parent.idle_s, parent.max_s)
+        self._window.observe(time.monotonic())
 
     def add(self, request: Any, fut: Future) -> None:
         with self._cv:
             self.items.append((request, fut))
-            self._last_at = time.monotonic()
+            self._window.observe(time.monotonic())
             if len(self.items) >= self.parent.max_items:
                 self.closed = True
             self._cv.notify()
@@ -122,15 +167,13 @@ class _Bucket:
         threading.Thread(target=self._run, daemon=True, name=self.parent.name).start()
 
     def _run(self) -> None:
-        idle, max_s = self.parent.idle_s, self.parent.max_s
         with self._cv:
             while not self.closed:
                 now = time.monotonic()
-                deadline = min(self._last_at + idle, self._first_at + max_s)
-                if now >= deadline:
+                if self._window.ready(now):
                     self.closed = True
                     break
-                self._cv.wait(timeout=deadline - now)
+                self._cv.wait(timeout=self._window.deadline() - now)
         self.parent._detach(self.key, self)
         requests = [r for r, _ in self.items]
         futures = [f for _, f in self.items]
@@ -141,7 +184,7 @@ class _Bucket:
         )
         self.parent.registry.observe(
             "karpenter_cloudprovider_batcher_batch_time_seconds",
-            time.monotonic() - self._first_at,
+            time.monotonic() - (self._window.first_at or 0.0),
             labels,
         )
         try:

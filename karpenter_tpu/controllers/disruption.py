@@ -98,6 +98,48 @@ class _PendingReplacement:
     reason: str
 
 
+@dataclass
+class _PendingMasks:
+    """One population round mid-flight between its dispatch and join
+    halves: the proposed keys/subsets, which of them went to the device
+    (``fresh`` rows of the in-flight ``pending`` handle), or nothing —
+    a round below the batch floor resolves fully sequentially at join."""
+
+    keys: List[tuple]
+    subsets: List[List["Candidate"]]
+    fresh: List[int] = None  # type: ignore[assignment]
+    pending: Optional[object] = None  # solver _PendingPopulation
+
+
+@dataclass
+class _Speculation:
+    """A consolidation search speculatively started at a tick boundary
+    (docs/designs/pipelined-reconcile.md).
+
+    Everything here was computed from the cluster state fingerprinted in
+    ``fp``; the authoritative pass ADOPTS it only when its own freshly
+    computed fingerprint is identical — the verdicts are pure functions
+    of that state, so an adopted search is bit-identical to the
+    synchronous search the sequential schedule would have run, and a
+    mismatch discards the whole object (verdicts, plan, memo) unused.
+    ``seed`` is the pass seed the speculation assumed
+    (``_search_seq + 1`` — never consumed until the authoritative pass
+    increments it)."""
+
+    fp: tuple
+    seed: int
+    cands: List["Candidate"]  # the capped search universe, rank order
+    pool_candidates: List["Candidate"]  # the full ranked pass list
+    pool_inventory: Tuple
+    ev: "_RemovalEvaluator"
+    plan: SearchPlan
+    observed: int = 0  # rounds already observed into the plan
+    pending_keys: Optional[List[tuple]] = None  # the in-flight round
+    pending: Optional[_PendingMasks] = None
+    t_enqueued: float = 0.0  # perf_counter at the last async enqueue
+    overlap_s: float = 0.0  # host wall time the device worked under
+
+
 class _Nomination(NamedTuple):
     """A pod evicted off a consolidated candidate, waiting to be steered
     onto its replacement once it re-pends."""
@@ -252,20 +294,17 @@ class _RemovalEvaluator:
                 by=answered,
             )
 
-    def evaluate_masks(
+    def dispatch_masks(
         self, cands: Sequence[Candidate], keys: Sequence[tuple]
-    ) -> List[Tuple[bool, float]]:
-        """Score one population round: ``keys`` are sorted index tuples
-        into ``cands`` (a rank-order prefix of the pass's universe).  On
-        the batched path every not-yet-memoized mask is scored in ONE
-        vmapped device dispatch (`TensorScheduler.evaluate_population` —
-        counts, removed slots, and class order derived on device from the
-        mask); elements the kernel cannot answer bit-identically — and
-        everything, when ``use_batched_consolidation`` is off — resolve
-        through the sequential `result`.  The (fits, price) pairs are
-        therefore IDENTICAL whichever backend answered, which is what
-        lets the two modes take the same actions tick for tick."""
+    ) -> "_PendingMasks":
+        """The ENQUEUE half of :meth:`evaluate_masks`: when the batched
+        backend is on and the round carries enough fresh masks, aim the
+        scheduler at the full remaining cluster and DISPATCH the
+        population kernel as an async JAX enqueue — no device read, so
+        the caller (the pipelined reconcile's dispatch/advance stages)
+        can run host work while the device scores the round."""
         subsets = [[cands[i] for i in key] for key in keys]
+        pm = _PendingMasks(keys=list(keys), subsets=subsets)
         dc = self.dc
         if dc.use_batched_consolidation:
             fresh = [
@@ -287,36 +326,70 @@ class _RemovalEvaluator:
                 masks = np.zeros((len(fresh), len(universe)), bool)
                 for r, i in enumerate(fresh):
                     masks[r, list(keys[i])] = True
-                verdicts = sched.evaluate_population(masks, universe)
-                reg = dc.registry
-                if sched.last_removal_batch:
+                pm.fresh = fresh
+                pm.pending = sched.dispatch_population(masks, universe)
+        return pm
+
+    def complete_masks(
+        self, pm: "_PendingMasks"
+    ) -> List[Tuple[bool, float]]:
+        """The JOIN half: fetch the in-flight verdicts (the hard barrier
+        before any of them can influence an action), memoize what the
+        kernel answered, and resolve the rest — and everything, when no
+        dispatch happened — through the sequential `result`."""
+        dc = self.dc
+        if pm.pending is not None:
+            sched = dc._scheduler
+            verdicts = sched.fetch_population(pm.pending)
+            reg = dc.registry
+            if sched.last_removal_batch:
+                reg.observe(
+                    "karpenter_consolidation_eval_batch_size",
+                    sched.last_removal_batch,
+                )
+                for phase_name, seconds in sched.last_phases.items():
                     reg.observe(
-                        "karpenter_consolidation_eval_batch_size",
-                        sched.last_removal_batch,
+                        "karpenter_consolidation_search_phase_seconds",
+                        seconds,
+                        {"phase": phase_name},
                     )
-                    for phase_name, seconds in sched.last_phases.items():
-                        reg.observe(
-                            "karpenter_consolidation_search_phase_seconds",
-                            seconds,
-                            {"phase": phase_name},
-                        )
-                answered = 0
-                for r, i in zip(range(len(fresh)), fresh):
-                    v = verdicts[r]
-                    if v.needs_host:
-                        continue
-                    self._memo[self._key(subsets[i])] = (
-                        v.fits, v.replacement_price, None, False,
-                    )
-                    self.sims += 1
-                    answered += 1
-                if answered:
-                    reg.inc(
-                        "karpenter_consolidation_evals_total",
-                        {"path": "batched"},
-                        by=answered,
-                    )
-        return [self.result(s) for s in subsets]
+            answered = 0
+            for r, i in zip(range(len(pm.fresh)), pm.fresh):
+                v = verdicts[r]
+                if v.needs_host:
+                    continue
+                self._memo[self._key(pm.subsets[i])] = (
+                    v.fits, v.replacement_price, None, False,
+                )
+                self.sims += 1
+                answered += 1
+            if answered:
+                reg.inc(
+                    "karpenter_consolidation_evals_total",
+                    {"path": "batched"},
+                    by=answered,
+                )
+        return [self.result(s) for s in pm.subsets]
+
+    def evaluate_masks(
+        self, cands: Sequence[Candidate], keys: Sequence[tuple]
+    ) -> List[Tuple[bool, float]]:
+        """Score one population round: ``keys`` are sorted index tuples
+        into ``cands`` (a rank-order prefix of the pass's universe).  On
+        the batched path every not-yet-memoized mask is scored in ONE
+        vmapped device dispatch (`TensorScheduler.evaluate_population` —
+        counts, removed slots, and class order derived on device from the
+        mask); elements the kernel cannot answer bit-identically — and
+        everything, when ``use_batched_consolidation`` is off — resolve
+        through the sequential `result`.  The (fits, price) pairs are
+        therefore IDENTICAL whichever backend answered, which is what
+        lets the two modes take the same actions tick for tick.
+
+        Dispatch + join back to back — the sequential schedule; the
+        pipelined reconcile calls the same two halves at different
+        points of the tick, so the verdicts cannot differ between the
+        schedules."""
+        return self.complete_masks(self.dispatch_masks(cands, keys))
 
     def result(self, subset: Sequence[Candidate]) -> Tuple[bool, float]:
         """(fits, replacement_price) for one subset — memoized; evaluates
@@ -432,18 +505,33 @@ class DisruptionController:
         # passes, instead of a new object (= new id churning the solver's
         # id-keyed caches) per _simulate call
         self._volume_copies: Dict[str, Tuple] = {}
+        # pipelined reconcile (pipeline.py): the speculative search the
+        # dispatch/advance stages built at tick boundaries, adopted by
+        # the authoritative pass only on a fingerprint match; and the
+        # cross-pass annealing warm start — the previous pass's
+        # surviving masks keyed by its universe fingerprint
+        self._speculation: Optional[_Speculation] = None
+        self._warm_store: Optional[Tuple[tuple, List[tuple]]] = None
 
     # ------------------------------------------------------------- reconcile
     def reconcile(self) -> None:
         """One pass in the reference's mechanism order; at most one
         disruption action per pass per mechanism keeps the cluster
-        observable between steps (the reference serializes the same way)."""
+        observable between steps (the reference serializes the same way).
+
+        Under the pipelined schedule this is also the JOIN: the pass
+        adopts the boundary-dispatched speculation inside `_consolidate`
+        (fingerprint-guarded), and any speculation still unconsumed when
+        the pass ends — an earlier mechanism acted, so consolidation
+        never ran — is dropped here, never carried across ticks."""
         with self.registry.time(
             "karpenter_deprovisioning_evaluation_duration_seconds"
         ):
             try:
                 self._reconcile_pass()
             finally:
+                if self._speculation is not None:
+                    self._drop_speculation("unused")
                 self._cc_exported = export_compile_cache_counters(
                     self.registry, self._scheduler, "disruption",
                     self._cc_exported,
@@ -452,6 +540,253 @@ class DisruptionController:
                     self.registry, self._scheduler, "disruption",
                     self._res_exported,
                 )
+
+    # ------------------------------------------------- pipelined stages
+    def reconcile_dispatch(self) -> None:
+        """The pipelined DISPATCH stage, run read-only at the END of a
+        tick: compute the consolidation pass the next tick would run,
+        propose its round-0 masks (seed ``_search_seq + 1``, warm-
+        started like the authoritative pass would), and enqueue the
+        device scoring asynchronously — so the device works through the
+        tick tail, the inter-tick sleep, and the next tick's host
+        phases.  Mutates NOTHING a decision reads: the plan/evaluator
+        live on the speculation object, the pass seed is not consumed,
+        and the authoritative pass discards everything unless its own
+        fingerprint of the same inputs is identical."""
+        if self._speculation is not None:
+            # the previous speculation was never consumed (reconcile
+            # skipped by backoff / abdication): stale by construction
+            self._drop_speculation("unused")
+        if not (self.use_population_search and self.use_batched_consolidation):
+            return  # nothing to overlap: the pass would run host-side
+        budgets = self._remaining_budgets()
+        pool_candidates = self._ranked_consolidatables(budgets)
+        cands = list(pool_candidates[:SEARCH_UNIVERSE_CAP])
+        if len(cands) < 2:
+            return
+        inv = self._pool_inventory()
+        ev = _RemovalEvaluator(self, pool_candidates, inv)
+        if TensorScheduler.removal_search_guard(
+            ev._universe[: len(cands)],
+            self._remaining_snapshot(frozenset()),
+        ):
+            return  # the pass would take the legacy descent: host-bound
+        fp = self._pass_fingerprint(pool_candidates, inv)
+        if fp is None:
+            # exotic inputs the fingerprint refuses to cover: no
+            # speculation is POSSIBLE — counted so a fingerprint bug
+            # (every tick refusing) is visible on a dashboard instead
+            # of reading as a quiet cluster
+            self.registry.inc(
+                "karpenter_pipeline_speculation_total",
+                {"controller": "disruption", "outcome": "refused"},
+            )
+            return
+        plan = SearchPlan(
+            n=len(cands),
+            prices=[c.price for c in cands],
+            spot=[
+                c.claim.capacity_type == L.CAPACITY_TYPE_SPOT for c in cands
+            ],
+            population=self.search_population,
+            rounds=self.search_rounds,
+            seed=self._search_seq + 1,
+            warm=self._warm_masks(cands),
+        )
+        keys = plan.propose()
+        if not keys:
+            return
+        spec = _Speculation(
+            fp=fp, seed=self._search_seq + 1, cands=cands,
+            pool_candidates=pool_candidates, pool_inventory=inv,
+            ev=ev, plan=plan,
+        )
+        spec.pending_keys = keys
+        spec.pending = ev.dispatch_masks(cands, keys)
+        spec.t_enqueued = perf_counter()
+        self._speculation = spec
+
+    def reconcile_advance(self) -> None:
+        """The pipelined ADVANCE stage, run at the START of the next
+        tick: if the speculation's inputs are still fingerprint-current,
+        join the in-flight round (the device had the whole tick tail to
+        score it) and chain the next round's async dispatch — which then
+        overlaps the provisioning solve and every other host phase up to
+        the disruption slot.  Any drift discards the speculation here,
+        before a single verdict is read."""
+        spec = self._speculation
+        if spec is None:
+            return
+        # freshly fetched inventory (cached provider lists — cheap), so
+        # an ICE-masked or rolled type list fails the check here instead
+        # of wasting a round-1 dispatch the join would discard anyway
+        if self._pass_fingerprint(
+            self._ranked_consolidatables(self._remaining_budgets()),
+            self._pool_inventory(),
+        ) != spec.fp:
+            self._drop_speculation("stale")
+            return
+        if spec.pending_keys is None:
+            return  # every round already observed; nothing in flight
+        spec.overlap_s += perf_counter() - spec.t_enqueued
+        results = spec.ev.complete_masks(spec.pending)
+        spec.plan.observe(spec.pending_keys, results)
+        spec.observed += 1
+        keys = spec.plan.propose()
+        if keys:
+            spec.pending_keys = keys
+            spec.pending = spec.ev.dispatch_masks(spec.cands, keys)
+            spec.t_enqueued = perf_counter()
+        else:
+            spec.pending_keys = None
+            spec.pending = None
+
+    def _drop_speculation(self, outcome: str) -> None:
+        self.registry.inc(
+            "karpenter_pipeline_speculation_total",
+            {"controller": "disruption", "outcome": outcome},
+        )
+        self._speculation = None
+
+    def _take_speculation(
+        self, pool_candidates: List["Candidate"], pool_inventory: Tuple
+    ) -> Optional[_Speculation]:
+        """The JOIN's fingerprint guard: hand the authoritative pass the
+        speculation ONLY when the pass's own freshly computed inputs
+        fingerprint-match what the speculation read — otherwise every
+        speculative verdict is discarded and the pass recomputes
+        synchronously, which is what keeps pipelining on/off
+        action-identical tick for tick."""
+        spec = self._speculation
+        if spec is None:
+            return None
+        self._speculation = None
+        if spec.seed != self._search_seq + 1:
+            self._drop_speculation("stale")
+            return None
+        if self._pass_fingerprint(pool_candidates, pool_inventory) != spec.fp:
+            self._drop_speculation("stale")
+            return None
+        self.registry.inc(
+            "karpenter_pipeline_speculation_total",
+            {"controller": "disruption", "outcome": "adopted"},
+        )
+        return spec
+
+    def _ranked_consolidatables(
+        self, budgets: Dict[str, int]
+    ) -> List["Candidate"]:
+        """The consolidation pass's ranked candidate list — the ONE
+        selection both the authoritative pass (`_reconcile_pass` →
+        `_consolidate`) and the speculative dispatch compute, so the
+        fingerprint comparison is between like and like."""
+        reserved = {
+            name
+            for pr in self._pending.values()
+            for name in pr.candidate_names
+        }
+        protected = {pr.claim_name for pr in self._pending.values()}
+        protected |= {n.target for n in self._nominate_later.values()}
+        out = [
+            c
+            for c in self._candidates(budgets)
+            if c.claim.name not in reserved
+            and c.claim.name not in protected
+            and not c.state.nominated
+            and c.pool.disruption.consolidation_policy == "WhenUnderutilized"
+            and self._consolidatable(c)
+        ]
+        out.sort(key=lambda c: c.disruption_cost())
+        return out
+
+    def _pass_fingerprint(
+        self, ranked: List["Candidate"], pool_inventory: Tuple
+    ) -> Optional[tuple]:
+        """Identity+epoch fingerprint of EVERYTHING a consolidation
+        search reads (the same machinery as the solver's compile-cache
+        fingerprints): the ranked candidates with their pods and pools,
+        the remaining-cluster snapshot by content, the inventory list
+        identities, daemonsets, and the search knobs.  None — which
+        never matches — on exotic inputs."""
+        try:
+            pools, inventory = pool_inventory
+            cand_fp = tuple(
+                (
+                    c.claim.name,
+                    c.claim.capacity_type,
+                    c.claim.deleted_at is None,
+                    c.price,
+                    tuple(sorted(c.claim.conditions.items())),
+                    id(c.pool),
+                    c.pool.__dict__.get("_mut", 0),
+                    tuple(
+                        (id(p), p.__dict__["_mut"]) for p in c.reschedulable
+                    ),
+                )
+                for c in ranked
+            )
+            inv_fp = tuple(
+                sorted((name, id(types)) for name, types in inventory.items())
+            )
+            pools_fp = tuple(
+                (id(p), p.__dict__.get("_mut", 0)) for p in pools
+            )
+            ds_fp = tuple(
+                (id(d), d.__dict__.get("_mut", 0))
+                for d in self.kube.daemonset_pods()
+            )
+            ex_fp = tuple(
+                (
+                    sn.name,
+                    tuple(sorted(sn.used.items())),
+                    tuple(sorted(sn.allocatable.items())),
+                    tuple(sorted(sn.labels.items())),
+                    tuple(map(repr, sn.taints)),
+                    sn.marked_for_deletion(),
+                    sn.node is not None and sn.node.cordoned,
+                    sn.nominated,
+                    tuple(
+                        (id(bp), bp.__dict__.get("_mut", 0))
+                        for bp in sn.pods
+                    ),
+                )
+                for sn in self._remaining_snapshot(frozenset())
+            )
+        except Exception:  # exotic duck-typed inputs: never adoptable
+            return None
+        knobs = (
+            self.search_rounds,
+            self.search_population,
+            SEARCH_UNIVERSE_CAP,
+            self.use_batched_consolidation,
+            self.use_population_search,
+        )
+        return (cand_fp, inv_fp, pools_fp, ds_fp, ex_fp, knobs)
+
+    def _universe_fingerprint(self, cands: List["Candidate"]) -> tuple:
+        """The warm-start validity key: mask index i must still mean the
+        same node with the same reschedulable pods and price, or the
+        previous pass's surviving masks are meaningless."""
+        return tuple(
+            (
+                c.claim.name,
+                c.price,
+                c.claim.capacity_type,
+                tuple(sorted(p.key() for p in c.reschedulable)),
+            )
+            for c in cands
+        )
+
+    def _warm_masks(self, cands: List["Candidate"]) -> List[tuple]:
+        """The previous pass's surviving masks, when the candidate
+        universe fingerprint is unchanged — otherwise nothing (the
+        indices would name different nodes)."""
+        if self._warm_store is None:
+            return []
+        ufp, masks = self._warm_store
+        if ufp != self._universe_fingerprint(cands):
+            return []
+        return list(masks)
 
     def _reconcile_pass(self) -> None:
         if self._volume_copies:
@@ -498,10 +833,12 @@ class DisruptionController:
         # must not freeze consolidation in pool B (_launch_replacement
         # enforces one in-flight replacement per TARGET pool), and a
         # node holding in-flight pod nominations is not consolidatable
-        # (its usage is about to grow) — but it still expires/drifts
-        self._consolidate(
-            [c for c in candidates if not c.state.nominated]
-        )
+        # (its usage is about to grow) — but it still expires/drifts.
+        # The pass recomputes its own ranked list through
+        # _ranked_consolidatables: the ONE selection the speculative
+        # dispatch also computes, so the fingerprint guard compares
+        # like with like by construction.
+        self._consolidate()
 
     # ------------------------------------------------- replacement pre-spin
     def _nominate_evicted(self) -> None:
@@ -709,7 +1046,14 @@ class DisruptionController:
                 ]
 
     # ------------------------------------------------------------ candidates
-    def _candidates(self) -> List[Candidate]:
+    def _candidates(
+        self, budgets: Optional[Dict[str, int]] = None
+    ) -> List[Candidate]:
+        """Disruptable nodes under `budgets` (default: the pass's own
+        ``self._budgets``; the speculative dispatch passes a locally
+        computed dict so a read-only stage never touches pass state)."""
+        if budgets is None:
+            budgets = self._budgets
         out = []
         for sn in self.cluster.snapshot():
             claim = sn.claim
@@ -720,7 +1064,7 @@ class DisruptionController:
             pool = self.kube.node_pools.get(sn.pool_name)
             if pool is None or pool.deleted:
                 continue
-            if self._budgets.get(pool.name, 1) <= 0:
+            if budgets.get(pool.name, 1) <= 0:
                 continue
             reschedulable = [p for p in sn.pods if not p.is_daemonset]
             out.append(
@@ -802,27 +1146,28 @@ class DisruptionController:
         return acted
 
     # --------------------------------------------------------- consolidation
-    def _consolidate(self, candidates: Sequence[Candidate]) -> bool:
-        pool_candidates = [
-            c
-            for c in candidates
-            if c.pool.disruption.consolidation_policy == "WhenUnderutilized"
-            and self._consolidatable(c)
-        ]
-        pool_candidates.sort(key=lambda c: c.disruption_cost())
+    def _consolidate(self) -> bool:
+        pool_candidates = self._ranked_consolidatables(self._budgets)
         if not pool_candidates:
+            if self._speculation is not None:
+                self._drop_speculation("stale")
             return False
         # one inventory fetch AND one evaluation context for the whole
         # pass: every simulation — multi-node descent, prefix floor,
         # single-node scan — shares the pools/types snapshot and the
-        # memoized verdicts
-        ev = _RemovalEvaluator(
-            self, pool_candidates, self._pool_inventory()
-        )
+        # memoized verdicts.  Under the pipelined schedule the
+        # speculation's evaluator (and its boundary-dispatched verdicts)
+        # is adopted in its place — ONLY behind the fingerprint guard.
+        inv = self._pool_inventory()
+        spec = self._take_speculation(pool_candidates, inv)
+        if spec is not None:
+            ev = spec.ev
+        else:
+            ev = _RemovalEvaluator(self, pool_candidates, inv)
         # multi-node first (bigger wins), then single-node scan — the
         # whole scan is ONE batched dispatch, answered lazily in rank
         # order so the first acceptable candidate still wins
-        if self._consolidate_multi(pool_candidates, ev):
+        if self._consolidate_multi(pool_candidates, ev, spec=spec):
             return True
         ev.prefetch([[c] for c in pool_candidates])
         for c in pool_candidates:
@@ -881,6 +1226,7 @@ class DisruptionController:
         self,
         ranked: Sequence[Candidate],
         ev: Optional[_RemovalEvaluator] = None,
+        spec: Optional[_Speculation] = None,
     ) -> bool:
         """Multi-node consolidation: a population-annealing SEARCH over
         removal masks (docs/designs/consolidation-search.md).
@@ -924,7 +1270,7 @@ class DisruptionController:
             self._remaining_snapshot(frozenset()),
         ):
             return self._consolidate_multi_descent(ranked, ev)
-        plan = self._search_multi(cands, ev)
+        plan = self._search_multi(cands, ev, spec=spec)
         reg = self.registry
         best = plan.best()
         if best is None:
@@ -945,26 +1291,58 @@ class DisruptionController:
         return acted
 
     def _search_multi(
-        self, cands: List[Candidate], ev: _RemovalEvaluator
+        self,
+        cands: List[Candidate],
+        ev: _RemovalEvaluator,
+        spec: Optional[_Speculation] = None,
     ) -> SearchPlan:
         """The pure SEARCH half of a multi-node pass (no action taken):
         seed a plan, run propose → score → select rounds, record the
         search metrics, return the plan holding every verdict.  Split
         from `_consolidate_multi` so bench.py can measure the search
-        without mutating the cluster."""
+        without mutating the cluster.
+
+        With an adopted speculation the already-proposed rounds are
+        CONTINUED instead of re-proposed: the in-flight round joins here
+        (its device work ran under the other controllers' host phases —
+        the overlap the `karpenter_reconcile_overlap_seconds` histogram
+        measures) and any rounds beyond it run synchronously as usual.
+        The plan is the same object proposing the same masks from the
+        same seed, so the search's verdicts and winner are identical to
+        the sequential schedule's."""
         self._search_seq += 1
-        plan = SearchPlan(
-            n=len(cands),
-            prices=[c.price for c in cands],
-            spot=[
-                c.claim.capacity_type == L.CAPACITY_TYPE_SPOT for c in cands
-            ],
-            population=self.search_population,
-            rounds=self.search_rounds,
-            seed=self._search_seq,
-        )
         reg = self.registry
         rounds_run = 0
+        if spec is not None:
+            plan = spec.plan
+            rounds_run = spec.observed
+            if spec.pending_keys:
+                spec.overlap_s += perf_counter() - spec.t_enqueued
+                results = ev.complete_masks(spec.pending)
+                t0 = perf_counter()
+                plan.observe(spec.pending_keys, results)
+                reg.observe(
+                    "karpenter_consolidation_search_phase_seconds",
+                    perf_counter() - t0,
+                    {"phase": "select"},
+                )
+                rounds_run += 1
+            reg.observe(
+                "karpenter_reconcile_overlap_seconds", spec.overlap_s
+            )
+        else:
+            plan = SearchPlan(
+                n=len(cands),
+                prices=[c.price for c in cands],
+                spot=[
+                    c.claim.capacity_type == L.CAPACITY_TYPE_SPOT
+                    for c in cands
+                ],
+                population=self.search_population,
+                rounds=self.search_rounds,
+                seed=self._search_seq,
+                warm=self._warm_masks(cands),
+            )
         while True:
             t0 = perf_counter()
             keys = plan.propose()
@@ -987,6 +1365,13 @@ class DisruptionController:
         reg.observe("karpenter_consolidation_search_rounds", float(rounds_run))
         reg.observe(
             "karpenter_consolidation_population_size", float(len(plan.seen))
+        )
+        # cross-pass annealing warm start: the NEXT pass re-seeds from
+        # this pass's surviving masks when its universe fingerprint is
+        # unchanged — survivors are a pure function of (seed, universe,
+        # verdicts), so twin runs and record/replay warm identically
+        self._warm_store = (
+            self._universe_fingerprint(cands), plan.survivors()
         )
         return plan
 

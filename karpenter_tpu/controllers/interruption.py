@@ -17,7 +17,6 @@ controller does the graceful cordon/evict/terminate (controller.go:247-259).
 from __future__ import annotations
 
 import logging
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -26,6 +25,7 @@ from karpenter_tpu.api import labels as L
 from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
 from karpenter_tpu.cloud.fake.backend import FakeCloud, QueueMessage
 from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.pipeline import run_concurrently
 from karpenter_tpu.metrics.registry import REGISTRY, Registry
 from karpenter_tpu.state.kube import KubeStore
 
@@ -118,14 +118,14 @@ class InterruptionController:
                 return  # NOT deleted -> redelivered next poll
             self.registry.inc("karpenter_interruption_deleted_messages")
 
-        if self.workers <= 1:
-            for msg in messages:  # deterministic in-order drain (sim mode)
-                process(msg)
-            return
-        with ThreadPoolExecutor(max_workers=self.workers) as pool:
-            # list() propagates nothing: process() swallows per-message
-            # errors (handle AND delete), so the batch always drains
-            list(pool.map(process, messages))
+        # the sanctioned fan-out seam (pipeline.run_concurrently):
+        # workers=1 drains deterministically in order (sim mode), and
+        # process() swallows per-message errors (handle AND delete), so
+        # the batch always drains either way
+        run_concurrently(
+            [(lambda m=msg: process(m)) for msg in messages],
+            max_workers=self.workers,
+        )
 
     def _handle(self, msg: QueueMessage, claims: Dict[str, NodeClaim]) -> None:
         parsed = _parse(msg.body)

@@ -3,8 +3,10 @@
 Re-derivation of karpenter-core's provisioner (reference SURVEY.md §3.2):
 
 - **pod batching window**: a batch opens when the first pending pod
-  appears and closes after `batch_idle_duration` (1s) of quiet or
-  `batch_max_duration` (10s) total (website v0.31 settings.md:43-47).
+  appears and closes after `provision_batch_idle_s` (1s) of quiet or
+  `provision_batch_max_s` (10s) total (website v0.31 settings.md:43-47)
+  — the same CoalesceWindow arithmetic the CreateFleet batcher uses
+  (batcher/core.py), on the injected clock.
 - **solve**: one scheduling pass over the batch via the tensor solver
   (oracle fallback inside), against existing + in-flight nodes, daemonset
   overhead, and the per-pool instance-type inventory from the
@@ -22,7 +24,6 @@ Re-derivation of karpenter-core's provisioner (reference SURVEY.md §3.2):
 from __future__ import annotations
 
 import logging
-from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
 from karpenter_tpu.api import (
@@ -34,8 +35,10 @@ from karpenter_tpu.api import (
     Settings,
 )
 from karpenter_tpu.api import labels as L
+from karpenter_tpu.batcher.core import CoalesceWindow
 from karpenter_tpu.cloud.provider import CloudProvider
 from karpenter_tpu.errors import is_insufficient_capacity
+from karpenter_tpu.pipeline import run_concurrently
 from karpenter_tpu.metrics.registry import (
     REGISTRY,
     Registry,
@@ -51,25 +54,16 @@ from karpenter_tpu.utils.clock import Clock
 log = logging.getLogger(__name__)
 
 
-def _call_outcome(fn, *args) -> Optional[Exception]:
-    """Run ``fn`` and return the exception it raised (None on success) —
-    lets the serial and concurrent launch paths share one outcome loop."""
-    try:
-        fn(*args)
-        return None
-    except Exception as exc:
-        return exc
-
-
 class PodBatcher:
-    """The 1s-idle / 10s-max pending-pod window (settings.md:43-47)."""
+    """The 1s-idle / 10s-max pending-pod window (settings.md:43-47),
+    built on the same :class:`CoalesceWindow` deadline arithmetic the
+    CreateFleet batcher's buckets use — one implementation of the
+    reference's batching discipline for both layers — driven by the
+    injected Clock so the window is deterministic under the simulator."""
 
     def __init__(self, clock: Clock, idle_s: float, max_s: float):
         self.clock = clock
-        self.idle_s = idle_s
-        self.max_s = max_s
-        self._first: Optional[float] = None
-        self._last: Optional[float] = None
+        self._window = CoalesceWindow(idle_s, max_s)
         self._seen: set = set()
 
     def observe(self, pods: Sequence[Pod]) -> None:
@@ -77,24 +71,16 @@ class PodBatcher:
             return
         now = self.clock.now()
         new = {p.key() for p in pods} - self._seen
-        if self._first is None:
-            self._first = now
-            self._last = now
-            self._seen = {p.key() for p in pods}
-        elif new:
-            self._last = now
-            self._seen |= new
+        # re-observing the same pending pods next tick is not an arrival:
+        # only FRESH pods push the idle deadline out
+        self._window.observe(now, fresh=bool(new) or not self._seen)
+        self._seen |= {p.key() for p in pods}
 
     def ready(self) -> bool:
-        if self._first is None:
-            return False
-        now = self.clock.now()
-        return (now - self._last) >= self.idle_s or (
-            now - self._first
-        ) >= self.max_s
+        return self._window.ready(self.clock.now())
 
     def reset(self) -> None:
-        self._first = self._last = None
+        self._window.reset()
         self._seen = set()
 
 
@@ -116,8 +102,8 @@ class Provisioner:
         self.registry = registry
         self.batcher = PodBatcher(
             clock,
-            self.settings.batch_idle_duration,
-            self.settings.batch_max_duration,
+            self.settings.provision_batch_idle_s,
+            self.settings.provision_batch_max_s,
         )
         # long-lived scheduler: its compiled-catalog cache hits whenever the
         # instance-type provider serves the same cached inventory lists
@@ -315,27 +301,29 @@ class Provisioner:
         launched: List[NodeClaim] = []
         if not claims:
             return launched
-        workers = self.launch_concurrency or min(32, len(claims))
-        if workers <= 1:
-            # deterministic serial path (see launch_concurrency): every
-            # cloud call happens in claim order, so a seeded simulation
-            # replays byte-identically
-            outcomes = [
-                (claim, vn, _call_outcome(self.cloud_provider.create, claim))
-                for claim, vn in claims
-            ]
-        else:
-            with ThreadPoolExecutor(
-                max_workers=min(workers, len(claims))
-            ) as pool_exec:
-                futures = [
-                    (claim, vn, pool_exec.submit(self.cloud_provider.create, claim))
-                    for claim, vn in claims
-                ]
-                outcomes = [
-                    (claim, vn, _call_outcome(fut.result))
-                    for claim, vn, fut in futures
-                ]
+        # fan the creates out through the sanctioned pipeline seam: the
+        # validated launch_max_concurrency setting bounds the flush (the
+        # chart can tune it), launch_concurrency=1 stays the simulator's
+        # determinism knob (serial, claim order — pipeline.run_concurrently
+        # degrades to the calling thread), and the in-flight gauge makes
+        # a stuck CreateFleet visible while it is stuck
+        workers = self.launch_concurrency or min(
+            self.settings.launch_max_concurrency, len(claims)
+        )
+        self.registry.set("karpenter_launch_inflight", float(len(claims)))
+        try:
+            excs = run_concurrently(
+                [
+                    (lambda c=claim: self.cloud_provider.create(c))
+                    for claim, _vn in claims
+                ],
+                max_workers=workers,
+            )
+        finally:
+            self.registry.set("karpenter_launch_inflight", 0.0)
+        outcomes = [
+            (claim, vn, exc) for (claim, vn), exc in zip(claims, excs)
+        ]
         for claim, vn, exc in outcomes:
             if exc is not None:
                 if is_insufficient_capacity(exc):

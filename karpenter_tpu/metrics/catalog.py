@@ -337,6 +337,50 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "controller_crash / sigusr1 / http / manual); the dump itself is "
         "a JSONL ring of the last flight_ticks ticks' full context",
     ),
+    # ---- pipelined reconcile (pipeline.py, docs/designs/
+    # pipelined-reconcile.md)
+    "karpenter_reconcile_overlap_seconds": (
+        "histogram",
+        "(none)",
+        "per-tick host wall time that ran WHILE a speculatively "
+        "dispatched consolidation search computed on device (dispatch at "
+        "the previous tick's tail, advance under this tick's "
+        "provisioning solve, join at the disruption slot); observed only "
+        "when the speculation was adopted — the overlap the pipelined "
+        "schedule actually realized, the difference between "
+        "sum-of-phases and max-of-phases tick latency",
+    ),
+    "karpenter_pipeline_speculation_total": (
+        "counter",
+        "controller, outcome",
+        "boundary-dispatched speculations by fate: 'adopted' (the "
+        "authoritative pass's fingerprint matched — verdicts reused, "
+        "overlap banked), 'stale' (cluster state moved between dispatch "
+        "and join — every speculative verdict discarded, the pass "
+        "recomputed synchronously), 'unused' (an earlier mechanism "
+        "acted, consolidation never ran), 'refused' (the pass "
+        "fingerprint declined to cover exotic inputs — no speculation "
+        "possible; every tick refusing is a fingerprint bug, not a "
+        "quiet cluster); adoption rate is the pipeline's hit rate on "
+        "quiet ticks",
+    ),
+    "karpenter_pipeline_stage_errors_total": (
+        "counter",
+        "controller, stage",
+        "speculative dispatch/advance stages that raised; crash-"
+        "contained at the pipeline seam — the tick proceeds and the "
+        "mutate stage recomputes synchronously, so a speculation bug "
+        "can cost latency but never actions",
+    ),
+    "karpenter_launch_inflight": (
+        "gauge",
+        "(none)",
+        "NodeClaim creates currently in flight in the provisioner's "
+        "launch fan-out (bounded by launch_max_concurrency; the "
+        "CreateFleet batcher coalesces them underneath); nonzero between "
+        "flush start and the last outcome — a stuck CreateFleet is "
+        "visible here while it is stuck",
+    ),
     # ---- device observatory (obs/device.py, docs/designs/observability.md)
     "karpenter_device_compiles_total": (
         "counter",

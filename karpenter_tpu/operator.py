@@ -43,6 +43,7 @@ from karpenter_tpu.obs.device import OBSERVATORY, export_device_metrics
 from karpenter_tpu.obs.events import EventLedger
 from karpenter_tpu.obs.flight import FlightRecorder
 from karpenter_tpu.obs.slo import SLOEngine, default_rules
+from karpenter_tpu.pipeline import StageSpec, TickPipeline
 from karpenter_tpu.providers.image import ImageProvider, Resolver
 from karpenter_tpu.providers.instance import InstanceProvider
 from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
@@ -259,6 +260,50 @@ class Operator:
         # per-controller requeue backoff: name -> (retry_at, current delay)
         self._ctrl_backoff: Dict[str, Tuple[float, float]] = {}
         self._stop = threading.Event()
+        # pipelined reconcile schedule (pipeline.py, docs/designs/
+        # pipelined-reconcile.md): the canonical mutate order below is
+        # UNCHANGED either way; pipelining brackets it with disruption's
+        # speculative stages — dispatch (tick end: enqueue the next
+        # consolidation search's round-0 device scoring) and advance
+        # (tick start: join round 0, chain round 1 under the
+        # provisioning solve).  The simulator forces enabled=False so
+        # byte-compared traces record the plain sequential schedule.
+        sequence: List[Tuple[str, object]] = [
+            ("nodeclass", self.node_class_controller),
+            ("provisioner", self.provisioner),
+            ("lifecycle", self.lifecycle),
+        ]
+        if self.interruption is not None:
+            sequence.append(("interruption", self.interruption))
+        sequence += [
+            ("disruption", self.disruption),
+            ("termination", self.termination),
+            # adopt before GC lists, so no race to reap
+            ("link", self.link),
+            ("garbagecollection", self.garbage_collection),
+            ("tagging", self.tagging),
+            ("metrics_state", self.metrics_state),
+            ("consistency", self.consistency),
+        ]
+        specs = [
+            StageSpec(
+                name,
+                controller,
+                dispatch=(
+                    self.disruption.reconcile_dispatch
+                    if name == "disruption" else None
+                ),
+                advance=(
+                    self.disruption.reconcile_advance
+                    if name == "disruption" else None
+                ),
+            )
+            for name, controller in sequence
+        ]
+        self.pipeline = TickPipeline(
+            specs, registry=registry, tracer=self.tracer,
+            enabled=self.settings.enable_pipelined_reconcile,
+        )
 
     # ------------------------------------------------------------------ loop
     def _reconcile(self, name: str, controller) -> None:
@@ -352,33 +397,24 @@ class Operator:
     def _run_controllers(self) -> None:
         # re-arm the shared cloud-API retry budget for this tick
         self.retrying.begin_tick()
-        sequence = [
-            ("nodeclass", self.node_class_controller),
-            ("provisioner", self.provisioner),
-            ("lifecycle", self.lifecycle),
-        ]
-        if self.interruption is not None:
-            sequence.append(("interruption", self.interruption))
-        sequence += [
-            ("disruption", self.disruption),
-            ("termination", self.termination),
-            # adopt before GC lists, so no race to reap
-            ("link", self.link),
-            ("garbagecollection", self.garbage_collection),
-            ("tagging", self.tagging),
-            ("metrics_state", self.metrics_state),
-            ("consistency", self.consistency),
-        ]
-        for name, controller in sequence:
-            # mid-tick abdication: the background renewal thread flips
-            # `leading` False the moment the lease is lost, and the tick
-            # stops before the next controller mutates anything.  The
-            # still_leading() gate also self-fences a WEDGED renewal
-            # thread: once the lease could have expired, the standby may
-            # legitimately hold it, so this replica must stop writing
-            if self.elector is not None and not self.elector.still_leading():
-                return
-            self._reconcile(name, controller)
+
+        # mid-tick abdication gate: the background renewal thread flips
+        # `leading` False the moment the lease is lost, and the tick
+        # stops before the next stage mutates anything.  The
+        # still_leading() gate also self-fences a WEDGED renewal
+        # thread: once the lease could have expired, the standby may
+        # legitimately hold it, so this replica must stop writing
+        def gate() -> bool:
+            return self.elector is None or self.elector.still_leading()
+
+        # a controller inside its crash-requeue backoff window will not
+        # consume speculative work; skip its dispatch/advance stages too
+        def ready(name: str) -> bool:
+            entry = self._ctrl_backoff.get(name)
+            return entry is None or self.clock.now() >= entry[0]
+
+        if not self.pipeline.run(self._reconcile, gate, ready):
+            return
         # 12h pricing refresh (reference pricing/controller.go:39-41).  The
         # provider degrades to last-good prices on API failure, and the
         # belt-and-suspenders except below keeps even an unexpected error

@@ -710,6 +710,51 @@ def population_verdict_kernel(
     return jax.vmap(one)(masks)
 
 
+def dispatch_population_verdicts(
+    padded_args: tuple,
+    k_slots: int,
+    pool_id: np.ndarray,
+    zone_id: np.ndarray,
+    ct_id: np.ndarray,
+    compactable: np.ndarray,
+    cand_cnt: np.ndarray,
+    cand_slot: np.ndarray,
+    cand_occ: np.ndarray,
+    sort_rank: np.ndarray,
+    occ_span: int,
+    masks: np.ndarray,
+    objective: str = "nodes",
+):
+    """The ENQUEUE half of the population scoring kernel over pre-padded
+    base args (`pad_problem` output, device-resident via the removal
+    base): an async JAX dispatch that returns the in-flight device array
+    WITHOUT blocking — the pipelined reconcile's dispatch stage, so the
+    device scores masks while the host runs other controllers.  The
+    caller pads the population and universe axes to power-of-two buckets
+    so XLA compiles once per shape; `fetch_verdict_rows` is the blocking
+    half."""
+    (req, _cnt, maxper, slot, feas, alloc, price, openable,
+     used0, cfg0, npods0, e0, sig0) = padded_args
+    with phase("dispatch"):
+        return OBSERVATORY.dispatch(
+            "population_verdict_kernel", population_verdict_kernel,
+            req, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, e0, sig0,
+            pool_id, zone_id, ct_id, compactable,
+            cand_cnt, cand_slot, cand_occ, sort_rank,
+            jnp.int32(occ_span), masks,
+            k_slots=k_slots, objective=objective,
+        )
+
+
+def fetch_verdict_rows(out, kernel_name: str) -> np.ndarray:
+    """The BLOCKING half of a verdict dispatch: one device read for the
+    whole batch/population, recorded as the kernel's `device.block` span
+    (the hard barrier on the tick timeline)."""
+    with phase("device_block"), TRACER.span(f"device.block.{kernel_name}"):
+        return np.asarray(out)
+
+
 def run_population_verdicts(
     padded_args: tuple,
     k_slots: int,
@@ -725,27 +770,14 @@ def run_population_verdicts(
     masks: np.ndarray,
     objective: str = "nodes",
 ) -> np.ndarray:
-    """Dispatch the population scoring kernel over pre-padded base args
-    (`pad_problem` output, device-resident via the removal base) and
-    fetch the [P, RV_WIDTH] verdict matrix — ONE device read for the
-    whole population.  The caller pads the population and universe axes
-    to power-of-two buckets so XLA compiles once per shape."""
-    (req, _cnt, maxper, slot, feas, alloc, price, openable,
-     used0, cfg0, npods0, e0, sig0) = padded_args
-    with phase("dispatch"):
-        out = OBSERVATORY.dispatch(
-            "population_verdict_kernel", population_verdict_kernel,
-            req, maxper, slot, feas, alloc, price, openable,
-            used0, cfg0, npods0, e0, sig0,
-            pool_id, zone_id, ct_id, compactable,
-            cand_cnt, cand_slot, cand_occ, sort_rank,
-            jnp.int32(occ_span), masks,
-            k_slots=k_slots, objective=objective,
-        )
-    with phase("device_block"), TRACER.span(
-        "device.block.population_verdict_kernel"
-    ):
-        return np.asarray(out)
+    """Dispatch + fetch in one call (the sequential schedule): the [P,
+    RV_WIDTH] verdict matrix for the whole population."""
+    out = dispatch_population_verdicts(
+        padded_args, k_slots, pool_id, zone_id, ct_id, compactable,
+        cand_cnt, cand_slot, cand_occ, sort_rank, occ_span, masks,
+        objective=objective,
+    )
+    return fetch_verdict_rows(out, "population_verdict_kernel")
 
 
 def run_removal_verdicts(
@@ -775,10 +807,7 @@ def run_removal_verdicts(
             cnt_b, rm_b, perm_b,
             k_slots=k_slots, objective=objective,
         )
-    with phase("device_block"), TRACER.span(
-        "device.block.removal_verdict_kernel"
-    ):
-        return np.asarray(out)
+    return fetch_verdict_rows(out, "removal_verdict_kernel")
 
 
 # device-resident constant caches, keyed by source-array identity with the

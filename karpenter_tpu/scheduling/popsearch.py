@@ -73,6 +73,7 @@ class SearchPlan:
         population: int,
         rounds: int,
         seed: int,
+        warm: Sequence[tuple] = (),
     ):
         self.n = int(n)
         self.prices = [float(p) for p in prices]
@@ -80,6 +81,17 @@ class SearchPlan:
         self.population = max(int(population), 4)
         self.rounds = max(int(rounds), 1)
         self.rng = random.Random(seed)
+        # cross-pass annealing warm start: the PREVIOUS pass's surviving
+        # masks, re-seeded into round 0 when the candidate universe is
+        # fingerprint-unchanged (the controller's check) — the annealed
+        # diversity a fresh pass's structured seeds cannot reproduce.
+        # Deterministic: the warm set is itself a pure function of the
+        # previous pass's (seed, universe, verdicts), so twin runs warm
+        # identically; keys outside this universe are dropped defensively.
+        self.warm = [
+            tuple(k) for k in warm
+            if len(k) >= 2 and all(0 <= i < self.n for i in k)
+        ]
         self.seen: set = set()  # every key ever proposed
         self.results: Dict[tuple, Tuple[bool, float]] = {}
         self.round_no = 0
@@ -128,6 +140,12 @@ class SearchPlan:
             child = full[:i] + full[i + 1 :]
             if len(child) >= 2:
                 self._admit(child, out)
+        # warm masks ride AFTER the structured seeds (dedup makes repeats
+        # free) and BEFORE the random filler, so the previous pass's
+        # annealed survivors are in the population even when the filler
+        # budget runs out
+        for key in self.warm:
+            self._admit(key, out)
         return self._random_fill(out)
 
     def _anneal_round(self) -> List[tuple]:
@@ -199,6 +217,11 @@ class SearchPlan:
             if price >= sum(self.prices[i] for i in key):
                 return False
         return True
+
+    def survivors(self) -> List[tuple]:
+        """The final selection round's surviving masks — what a
+        fingerprint-unchanged NEXT pass warm-starts from."""
+        return list(self._survivors)
 
     def best(self) -> Optional[BestAction]:
         """The winning subset across every observed round: max savings,

@@ -92,6 +92,24 @@ class RemovalVerdict(NamedTuple):
     reason: str = ""
 
 
+class _PendingPopulation:
+    """An in-flight population scoring dispatch: the async device array
+    plus everything the blocking half needs to decode it.  ``ready`` is
+    set when the host guards answered without any device work (base
+    refused / empty universe); ``phases`` accumulates the per-phase
+    self-times across BOTH halves so the completed dict matches the
+    one-call form's."""
+
+    __slots__ = ("P", "base", "out", "ready", "phases")
+
+    def __init__(self, P: int):
+        self.P = P
+        self.base: Optional["_RemovalBase"] = None
+        self.out = None
+        self.ready: Optional[List[RemovalVerdict]] = None
+        self.phases: Dict[str, float] = {}
+
+
 class _RemovalBase:
     """One compiled-and-padded base problem for a consolidation pass:
     classes over the candidate-universe pods, existing rows over the FULL
@@ -1045,49 +1063,89 @@ class TensorScheduler:
         here is bit-identical to the same subset scored per-element — and,
         transitively, to the sequential `_simulate`.  Elements the kernel
         cannot answer bit-identically come back ``needs_host`` exactly
-        like the per-subset path."""
-        self.last_phases = phases = {}
-        with phase_collect(phases), phase("other"):
-            return self._evaluate_population(
-                np.asarray(masks, bool), tuple(universe)
-            )
+        like the per-subset path.
 
-    def _evaluate_population(
-        self, masks: np.ndarray, universe: tuple
-    ) -> List[RemovalVerdict]:
-        from karpenter_tpu.ops.packer import _bucket, run_population_verdicts
-
-        self.last_removal_batch = 0
-        base = self._removal_base(universe)
-        P = int(masks.shape[0])
-        if base.reason:
-            return [
-                RemovalVerdict(False, 0.0, True, base.reason)
-                for _ in range(P)
-            ]
-        if base.empty:
-            return [RemovalVerdict(True, 0.0) for _ in range(P)]
-        if base.pop_reason:
-            return [
-                RemovalVerdict(False, 0.0, True, base.pop_reason)
-                for _ in range(P)
-            ]
-        with phase("pad"):
-            up = int(base.cand_slot.shape[0])
-            pp = _bucket(max(P, 1), floor=self.MIN_REMOVAL_BATCH)
-            mb = np.zeros((pp, up), bool)
-            mb[:P, : masks.shape[1]] = masks
-        verd = run_population_verdicts(
-            base.args, base.k_slots,
-            base.pool_id, base.zone_id, base.ct_id, base.compactable,
-            base.cand_cnt, base.cand_slot, base.cand_occ, base.sort_rank,
-            base.occ_span, mb, objective=self.objective,
+        Implemented as :meth:`dispatch_population` + :meth:`fetch_
+        population` back to back — the pipelined reconcile calls the two
+        halves at different points of the tick, this sequential form is
+        the degenerate schedule, and either way the verdicts are the
+        same pure function of (masks, universe, cluster state)."""
+        return self.fetch_population(
+            self.dispatch_population(masks, universe)
         )
-        self.last_removal_batch = P
-        out: List[RemovalVerdict] = []
-        with phase("decode"):
-            for i in range(P):
-                out.append(self._verdict_from_row(verd[i], base))
+
+    def dispatch_population(
+        self,
+        masks: np.ndarray,
+        universe: Sequence[RemovalCandidate],
+    ) -> "_PendingPopulation":
+        """The ENQUEUE half of :meth:`evaluate_population`: build (or
+        cache-hit) the removal base, pad the mask matrix, and dispatch
+        the population kernel as an async JAX enqueue — NO device read.
+        Returns the in-flight handle; the device computes in the
+        background while the host does other work.  Bases the host
+        guards refuse resolve immediately (``ready`` verdicts on the
+        handle) with zero device work, exactly like the sequential
+        path."""
+        masks = np.asarray(masks, bool)
+        pend = _PendingPopulation(P=int(masks.shape[0]))
+        with phase_collect(pend.phases), phase("other"):
+            base = self._removal_base(tuple(universe))
+            P = pend.P
+            if base.reason:
+                pend.ready = [
+                    RemovalVerdict(False, 0.0, True, base.reason)
+                    for _ in range(P)
+                ]
+            elif base.empty:
+                pend.ready = [RemovalVerdict(True, 0.0) for _ in range(P)]
+            elif base.pop_reason:
+                pend.ready = [
+                    RemovalVerdict(False, 0.0, True, base.pop_reason)
+                    for _ in range(P)
+                ]
+            else:
+                from karpenter_tpu.ops.packer import (
+                    _bucket,
+                    dispatch_population_verdicts,
+                )
+
+                with phase("pad"):
+                    up = int(base.cand_slot.shape[0])
+                    pp = _bucket(max(P, 1), floor=self.MIN_REMOVAL_BATCH)
+                    mb = np.zeros((pp, up), bool)
+                    mb[:P, : masks.shape[1]] = masks
+                pend.base = base
+                pend.out = dispatch_population_verdicts(
+                    base.args, base.k_slots,
+                    base.pool_id, base.zone_id, base.ct_id,
+                    base.compactable, base.cand_cnt, base.cand_slot,
+                    base.cand_occ, base.sort_rank, base.occ_span, mb,
+                    objective=self.objective,
+                )
+        return pend
+
+    def fetch_population(
+        self, pend: "_PendingPopulation"
+    ) -> List[RemovalVerdict]:
+        """The BLOCKING half: one device read for the whole population
+        (the pipeline's hard barrier), decoded through the shared
+        `_verdict_from_row`.  Leaves ``last_phases`` /
+        ``last_removal_batch`` exactly as the one-call form did — the
+        handle's phase dict accumulated across both halves."""
+        from karpenter_tpu.ops.packer import fetch_verdict_rows
+
+        self.last_phases = phases = pend.phases
+        self.last_removal_batch = 0
+        with phase_collect(phases), phase("other"):
+            if pend.ready is not None:
+                return pend.ready
+            verd = fetch_verdict_rows(pend.out, "population_verdict_kernel")
+            self.last_removal_batch = pend.P
+            out: List[RemovalVerdict] = []
+            with phase("decode"):
+                for i in range(pend.P):
+                    out.append(self._verdict_from_row(verd[i], pend.base))
         return out
 
     def _removal_base(self, universe: tuple) -> _RemovalBase:

@@ -62,7 +62,6 @@ import logging
 import os
 import socket
 import socketserver
-import struct
 import threading
 import time
 from collections import deque
@@ -79,6 +78,7 @@ from karpenter_tpu.service.codec import (
     recv_frame,
     send_frame,
 )
+from karpenter_tpu.service.watchclient import WatchChannelClient
 from karpenter_tpu.state.binwire import (
     Raw,
     SCHEMA_FP,
@@ -1213,65 +1213,41 @@ class StoreServer(socketserver.ThreadingTCPServer):
     def _follow_loop(self) -> None:
         """Read-replica follower: mirror the primary over the SAME watch
         protocol clients use, tracking the primary's seq space so a
-        reconnect delta-resyncs instead of re-snapshotting."""
+        reconnect delta-resyncs instead of re-snapshotting.  The
+        dial/handshake/backoff/resync choreography is the SHARED
+        watch-client primitive (service/watchclient.py — one definition
+        with RemoteKubeStore's mirror loop); the follower contributes
+        the replica handshake and the verbatim-apply frame handler."""
         host, port = self.replica_of  # type: ignore[misc]
-        backoff = 0.05
-        while not self._follow_stop.is_set():
-            sock = None
-            try:
-                sock = socket.create_connection((host, port), timeout=5.0)
-                send_frame(
-                    sock,
-                    encode_payload(
-                        {
-                            "method": "watch",
-                            "identity": f"replica@{self.address[1]}",
-                            "codecs": list(self.codecs),
-                            "schema_fp": SCHEMA_FP,
-                            "since_seq": self._primary_seq,
-                            "epoch": self._primary_epoch,
-                        },
-                        CODEC_JSON,
-                    ),
-                )
-                ack = decode_payload(recv_frame(sock), CODEC_JSON)
-                self._note_primary_epoch(str(ack.get("epoch") or ""))
-                if "snapshot" in ack:  # legacy primary: inline snapshot
-                    codec = CODEC_JSON
-                    self.store.apply_replicated_snapshot(ack["snapshot"])
-                    self._primary_seq = ack["snapshot"].get("seq", 0)
-                else:
-                    codec = ack.get("codec", CODEC_JSON)
-                    self._apply_frame(
-                        decode_payload(recv_frame(sock), codec)
-                    )
-                backoff = 0.05
-                sock.settimeout(None)
-                self._follow_sock = sock
-                while not self._follow_stop.is_set():
-                    self._apply_frame(
-                        decode_payload(recv_frame(sock), codec)
-                    )
-            except (
-                ConnectionError,
-                OSError,
-                ValueError,
-                KeyError,
-                struct.error,
-            ):
-                # KeyError included: a frame missing an expected key (a
-                # malformed or down-version peer) must reconnect, never
-                # silently kill the follower thread
-                if self._follow_stop.wait(backoff):
-                    break
-                backoff = min(backoff * 2, 1.0)
-            finally:
-                self._follow_sock = None
-                if sock is not None:
-                    try:
-                        sock.close()
-                    except OSError:
-                        pass
+
+        def hello() -> dict:
+            return {
+                "method": "watch",
+                "identity": f"replica@{self.address[1]}",
+                "codecs": list(self.codecs),
+                "schema_fp": SCHEMA_FP,
+                "since_seq": self._primary_seq,
+                "epoch": self._primary_epoch,
+            }
+
+        def legacy_snapshot(snapshot: dict) -> None:
+            self.store.apply_replicated_snapshot(snapshot)
+            self._primary_seq = snapshot.get("seq", 0)
+
+        def set_live(sock) -> None:
+            self._follow_sock = sock
+
+        WatchChannelClient(
+            dial=lambda: socket.create_connection((host, port), timeout=5.0),
+            hello=hello,
+            tx=send_frame,
+            rx=lambda sock, _codec: recv_frame(sock),
+            on_epoch=self._note_primary_epoch,
+            on_legacy_snapshot=legacy_snapshot,
+            on_frame=lambda frame, _initial: self._apply_frame(frame),
+            stop=self._follow_stop,
+            on_live=set_live,
+        ).run()
 
     def _note_primary_epoch(self, epoch: str) -> None:
         """Adopt the primary's epoch id, zeroing the follow cursor the

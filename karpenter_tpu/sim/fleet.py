@@ -200,8 +200,12 @@ class FleetRunner:
             # same determinism contract as sim/runner.py: the anomaly
             # detector judges wall-clock values and gates the
             # DeviceRecompile ledger events, both of which depend on
-            # process history — neither may enter a byte-compared trace
+            # process history — neither may enter a byte-compared trace;
+            # the pipelined reconcile likewise degrades to the
+            # sequential schedule (speculation is wall-clock-shaped
+            # work a byte-compared fleet trace must not record)
             op.detector.enabled = False
+            op.pipeline.enabled = False
             self.kubes[name] = kube
             self.ops[name] = op
         # a passive reader mirroring the READ REPLICA: proves the

@@ -165,6 +165,14 @@ class ScenarioRunner:
         op.provisioner.launch_concurrency = 1
         if op.interruption is not None:
             op.interruption.workers = 1
+        # the pipelined reconcile MUST degrade to the sequential
+        # schedule here (enforced, not configured: a scenario's settings
+        # cannot turn it back on) — speculative dispatch/advance stages
+        # read wall-clock overlap and would put schedule-dependent
+        # metric/ledger noise into a byte-compared trace.  The twin-run
+        # test proves pipelining on/off takes identical ACTIONS, so the
+        # sequential trace speaks for both schedules.
+        op.pipeline.enabled = False
         # the sim evaluates the SCENARIO's SLO rules (deterministic
         # signals only) instead of the production defaults — tick
         # durations are host wall time, and the anomaly detector judges

@@ -53,6 +53,7 @@ from karpenter_tpu.service.codec import (
     recv_frame,
     send_frame,
 )
+from karpenter_tpu.service.watchclient import WatchChannelClient
 from karpenter_tpu.state.binwire import SCHEMA_FP
 from karpenter_tpu.state.kube import KubeStore
 from karpenter_tpu.state.wire import (
@@ -700,77 +701,53 @@ class RemoteKubeStore(KubeStore):
         self._watch_thread.start()
 
     def _watch_loop(self) -> None:
-        import struct
+        # the dial/handshake/backoff/resync choreography is the SHARED
+        # watch-client primitive (service/watchclient.py — one
+        # definition with the read-replica follower); this mirror
+        # contributes the handshake contents, the frame handler, and
+        # the byte-counting tx/rx
+        def dial():
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self.connect_timeout
+            )
+            sock.settimeout(self.request_timeout)
+            return sock
 
-        backoff = BACKOFF_S
-        while not self._stop.is_set():
-            sock = None
-            try:
-                sock = socket.create_connection(
-                    (self.host, self.port), timeout=self.connect_timeout
-                )
-                sock.settimeout(self.request_timeout)
-                # delta resync: present the last seq this mirror applied
-                # from the watch stream; the server replays just the gap
-                # when its replay log still covers it, and falls back to
-                # a full snapshot when compaction has passed us by
-                request = {
-                    "method": "watch",
-                    "identity": self.identity,
-                    "codecs": (
-                        [CODEC_BIN, CODEC_JSON]
-                        if self.codec == "auto"
-                        else [CODEC_JSON]
-                    ),
-                    "schema_fp": SCHEMA_FP,
-                    "since_seq": self._watch_seq,
-                    "epoch": self._watch_epoch,
-                }
-                self._tx(sock, encode_payload(request, CODEC_JSON), CODEC_JSON)
-                ack = decode_payload(self._rx(sock, CODEC_JSON), CODEC_JSON)
-                self._note_epoch(str(ack.get("epoch") or ""))
-                if "snapshot" in ack:  # legacy server: inline snapshot
-                    codec = CODEC_JSON
-                    self._apply_snapshot(ack["snapshot"])
-                else:
-                    codec = ack.get("codec", CODEC_JSON)
-                    self._handle_watch_frame(
-                        decode_payload(self._rx(sock, codec), codec),
-                        initial=True,
-                    )
-                backoff = BACKOFF_S
-                # BLOCKING reads: a short recv timeout could fire
-                # mid-frame and desync the stream (the consumed prefix is
-                # lost and the next read parses payload bytes as a length
-                # header).  close() interrupts the blocking recv by
-                # closing this socket instead.
-                sock.settimeout(None)
-                self._watch_sock = sock
-                while not self._stop.is_set():
-                    self._handle_watch_frame(
-                        decode_payload(self._rx(sock, codec), codec)
-                    )
-            except (
-                ConnectionError,
-                OSError,
-                ValueError,
-                KeyError,
-                struct.error,
-            ):
-                # KeyError included (mirroring the replica follower): a
-                # frame missing an expected key — a malformed or
-                # down-version peer — must reconnect-and-resync, never
-                # silently kill the watch thread and freeze the mirror
-                if self._stop.wait(backoff):
-                    break
-                backoff = min(backoff * 2, 1.0)
-            finally:
-                self._watch_sock = None
-                if sock is not None:
-                    try:
-                        sock.close()
-                    except OSError:
-                        pass
+        def hello() -> dict:
+            # delta resync: present the last seq this mirror applied
+            # from the watch stream; the server replays just the gap
+            # when its replay log still covers it, and falls back to
+            # a full snapshot when compaction has passed us by
+            return {
+                "method": "watch",
+                "identity": self.identity,
+                "codecs": (
+                    [CODEC_BIN, CODEC_JSON]
+                    if self.codec == "auto"
+                    else [CODEC_JSON]
+                ),
+                "schema_fp": SCHEMA_FP,
+                "since_seq": self._watch_seq,
+                "epoch": self._watch_epoch,
+            }
+
+        def set_live(sock) -> None:
+            self._watch_sock = sock
+
+        WatchChannelClient(
+            dial=dial,
+            hello=hello,
+            tx=lambda sock, payload: self._tx(sock, payload, CODEC_JSON),
+            rx=self._rx,
+            on_epoch=self._note_epoch,
+            on_legacy_snapshot=self._apply_snapshot,
+            on_frame=lambda frame, initial: self._handle_watch_frame(
+                frame, initial=initial
+            ),
+            stop=self._stop,
+            on_live=set_live,
+            backoff_s=BACKOFF_S,
+        ).run()
 
     def _handle_watch_frame(self, frame: dict, initial: bool = False) -> None:
         """One pushed watch frame: ordinary events, or a resync the

@@ -121,6 +121,28 @@ class TestBenchSmoke:
         assert line["sequential_ms"] > 0
         assert line["speedup_vs_sequential"] > 0
 
+    def test_pipelined_tick_line(self, bench_lines):
+        """The pipelined-reconcile line drives the REAL operator through
+        the diurnal+interruption-storm schedule twice (sequential vs
+        pipelined) and reports both p50s; the speed floors (adoption,
+        realized overlap, pipelined <= sequential) assert on the
+        full-scale artifact inside run_pipelined_tick itself — at tiny
+        scale the handful of ticks is structure-only."""
+        line = next(
+            l
+            for l in bench_lines
+            if l["metric"] == "reconcile_tick_pipelined_p50"
+        )
+        assert line["path"] == "pipelined"
+        assert line["sequential_ms"] > 0
+        assert line["pipelined_ms"] > 0
+        assert line["pipelined_ms"] == pytest.approx(line["value"], abs=0.01)
+        assert line["speedup"] > 0
+        assert line["speculations_adopted"] >= 0
+        assert line["overlap_seconds"] >= 0
+        assert line["max_phase_ms"] >= 0
+        assert line["ticks"] >= 3
+
     def test_store_ops_line(self, bench_lines):
         """The fleet-scale store plane's throughput line: the negotiated
         binary codec must carry >= 3x the tagged-JSON baseline on the

@@ -129,7 +129,9 @@ class TestChart:
         settings = Settings.from_file(str(path))
         settings.validate()
         assert settings.cluster_name == "prod-cluster"
-        assert settings.batch_idle_duration == 1.0
+        assert settings.provision_batch_idle_s == 1.0
+        assert settings.enable_pipelined_reconcile is True
+        assert settings.launch_max_concurrency == 64
         assert settings.enable_profiling is False
 
     def test_controller_matches_entry_point_contract(self):

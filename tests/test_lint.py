@@ -869,3 +869,114 @@ def test_store_frame_lint_has_teeth():
     hits = store_frame_offenders(src, "karpenter_tpu/x.py", documented)
     assert len(hits) == 2, hits
     assert "rogue_rpc" in hits[0] and "rogue_frame" in hits[1], hits
+
+# rule 11: thread construction in the controller layer is fenced to the
+# pipeline seam.  The pipelined reconcile's determinism story depends on
+# ALL controller-layer concurrency flowing through pipeline.py — the
+# run_concurrently fan-out (degrades to serial in-order under the sim's
+# workers=1 knobs) and the operator's declared dispatch/advance stages.
+# A raw ThreadPoolExecutor/Thread in controllers/ or operator.py is an
+# unscheduled side channel the twin-run and byte-identity proofs cannot
+# see; any genuinely new need must be consciously allowlisted here.
+_THREAD_SEAM_ALLOWLIST = {
+    # the seam itself: the one sanctioned pool constructor
+    ("karpenter_tpu/pipeline.py", "run_concurrently"),
+}
+
+_THREAD_CTOR_NAMES = frozenset({"Thread", "ThreadPoolExecutor"})
+
+
+def thread_ctor_offenders(source: str, rel: str, allowlist):
+    """AST scan for thread construction: ``Thread(...)`` /
+    ``ThreadPoolExecutor(...)`` bare or as an attribute
+    (``threading.Thread``, ``futures.ThreadPoolExecutor``).  Every call
+    site must be allowlisted by (file, qualified name).  Locks /
+    Events / Conditions stay free — the rule fences execution contexts,
+    not synchronization primitives."""
+    tree = ast.parse(source)
+    offenders = []
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self):
+            self.scope = []
+
+        def _scoped(self, node, push):
+            self.scope.append(push)
+            self.generic_visit(node)
+            self.scope.pop()
+
+        def visit_ClassDef(self, node):
+            self._scoped(node, node.name)
+
+        def visit_FunctionDef(self, node):
+            self._scoped(node, node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            f = node.func
+            name = (
+                f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute)
+                else None
+            )
+            if name in _THREAD_CTOR_NAMES:
+                qual = ".".join(self.scope)
+                if (rel, qual) not in allowlist:
+                    offenders.append(
+                        f"{rel}:{node.lineno}: {qual or '<module>'}: "
+                        f"{name}(...)"
+                    )
+            self.generic_visit(node)
+
+    Visitor().visit(tree)
+    return offenders
+
+
+def test_no_raw_threads_outside_pipeline_seam():
+    """Controller-layer concurrency flows through pipeline.py only: a
+    raw thread in controllers/ or operator.py bypasses the pipelined
+    schedule's determinism knobs (docs/designs/pipelined-reconcile.md)."""
+    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
+    scan = sorted((pkg_root / "controllers").glob("*.py"))
+    scan += [pkg_root / "operator.py", pkg_root / "pipeline.py"]
+    offenders = []
+    for path in scan:
+        rel = path.relative_to(pkg_root.parent).as_posix()
+        offenders += thread_ctor_offenders(
+            path.read_text(), rel, _THREAD_SEAM_ALLOWLIST
+        )
+    assert not offenders, (
+        "raw thread construction in the controller layer (route the "
+        "fan-out through pipeline.run_concurrently / declare a pipeline "
+        "stage, or consciously allowlist it):\n" + "\n".join(offenders)
+    )
+
+
+def test_thread_seam_lint_has_teeth():
+    """The checker fires on bare and attribute constructor forms, and
+    stays quiet on allowlisted sites and on bare synchronization
+    primitives."""
+    bad = (
+        "import threading\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class C:\n"
+        "    def fan(self, fns):\n"
+        "        self._lock = threading.Lock()\n"
+        "        t = threading.Thread(target=fns[0])\n"
+        "    def pool(self, fns):\n"
+        "        with ThreadPoolExecutor(max_workers=4) as p:\n"
+        "            pass\n"
+    )
+    hits = thread_ctor_offenders(
+        bad, "karpenter_tpu/controllers/x.py", _THREAD_SEAM_ALLOWLIST
+    )
+    assert len(hits) == 2, hits
+    assert "C.fan" in hits[0] and "Thread" in hits[0], hits
+    assert "C.pool" in hits[1], hits
+    ok = thread_ctor_offenders(
+        bad, "karpenter_tpu/controllers/x.py",
+        {("karpenter_tpu/controllers/x.py", "C.fan"),
+         ("karpenter_tpu/controllers/x.py", "C.pool")},
+    )
+    assert not ok, ok

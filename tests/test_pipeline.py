@@ -1,0 +1,520 @@
+"""Pipelined reconcile: twin actions, speculation lifecycle, the seam.
+
+The contract under test (docs/designs/pipelined-reconcile.md): the
+pipelined schedule — disruption's consolidation search dispatched at
+tick boundaries so its device rounds run under the other controllers'
+host phases — must take IDENTICAL actions tick for tick to the strict
+sequential schedule, the way PR 9 proved the population search against
+the sequential descent.  The fingerprint guard is what makes that true:
+a speculation is adopted only when the authoritative pass reads exactly
+the state the speculation read, and discarded wholesale otherwise.  The
+only acceptable difference between the schedules is latency.
+"""
+
+import random
+import threading
+
+import pytest
+
+from karpenter_tpu.api import Disruption, Pod, Resources, Settings
+from karpenter_tpu.api.objects import reset_name_sequences
+from karpenter_tpu.batcher.core import CoalesceWindow
+from karpenter_tpu.cloud.fake.backend import generate_catalog
+from karpenter_tpu.controllers.disruption import _RemovalEvaluator
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.pipeline import StageSpec, TickPipeline, run_concurrently
+from karpenter_tpu.scheduling.popsearch import SearchPlan
+from karpenter_tpu.testing import Environment
+from karpenter_tpu.utils.trace import Tracer
+
+SIZES = [
+    Resources(cpu=0.5, memory="1Gi"),
+    Resources(cpu=1, memory="2Gi"),
+    Resources(cpu=2, memory="4Gi"),
+]
+
+
+def _build_env(
+    seed: int, npods: int, pipelined: bool = True
+) -> Environment:
+    reset_name_sequences()
+    env = Environment(
+        shapes=generate_catalog(generations=(1, 2), cpus=(4, 8)),
+        settings=Settings(enable_pipelined_reconcile=pipelined),
+    )
+    env.default_node_class()
+    env.default_node_pool(
+        disruption=Disruption(consolidation_policy="WhenUnderutilized")
+    )
+    rng = random.Random(seed)
+    for _ in range(npods):
+        env.kube.put_pod(Pod(requests=rng.choice(SIZES)))
+    env.settle(max_rounds=60)
+    assert not env.kube.pending_pods()
+    return env
+
+
+def _spec_counts(env) -> dict:
+    got = env.registry.counters.get(
+        "karpenter_pipeline_speculation_total", {}
+    )
+    return {dict(k)["outcome"]: v for k, v in got.items()}
+
+
+# ------------------------------------------------------------- the seam
+class _Recorder:
+    def __init__(self, log, name):
+        self.log = log
+        self.name = name
+
+    def reconcile(self):
+        self.log.append(("mutate", self.name))
+
+
+def _specs(log):
+    a = _Recorder(log, "a")
+    b = _Recorder(log, "b")
+    return [
+        StageSpec("a", a),
+        StageSpec(
+            "b", b,
+            dispatch=lambda: log.append(("dispatch", "b")),
+            advance=lambda: log.append(("advance", "b")),
+        ),
+    ]
+
+
+def test_sequential_mode_is_the_plain_mutate_order():
+    """enabled=False runs ONLY the mutate stages, in declaration order —
+    the bit-for-bit sequential schedule the simulator byte-compares."""
+    log = []
+    pipe = TickPipeline(_specs(log), Registry(), Tracer(), enabled=False)
+    assert pipe.run(lambda n, c: c.reconcile(), lambda: True)
+    assert log == [("mutate", "a"), ("mutate", "b")]
+
+
+def test_pipelined_mode_brackets_with_advance_and_dispatch():
+    log = []
+    pipe = TickPipeline(_specs(log), Registry(), Tracer(), enabled=True)
+    assert pipe.run(lambda n, c: c.reconcile(), lambda: True)
+    assert log == [
+        ("advance", "b"),
+        ("mutate", "a"),
+        ("mutate", "b"),
+        ("dispatch", "b"),
+    ]
+
+
+def test_gate_aborts_between_stages():
+    """A False gate (mid-tick leadership loss) stops the tick before the
+    next stage — mutate or speculative — and reports the abort."""
+    log = []
+    calls = {"n": 0}
+
+    def gate():
+        calls["n"] += 1
+        return calls["n"] <= 2  # advance + first mutate run, then stop
+
+    pipe = TickPipeline(_specs(log), Registry(), Tracer(), enabled=True)
+    assert not pipe.run(lambda n, c: c.reconcile(), gate)
+    assert log == [("advance", "b"), ("mutate", "a")]
+
+
+def test_backoff_skips_speculative_stages_only():
+    """ready(name)=False (the operator's crash-requeue backoff) skips a
+    controller's dispatch/advance — speculating for a consumer that
+    will not run is pure waste — while the mutate stage keeps its own
+    backoff handling."""
+    log = []
+    pipe = TickPipeline(_specs(log), Registry(), Tracer(), enabled=True)
+    assert pipe.run(
+        lambda n, c: c.reconcile(), lambda: True,
+        ready=lambda name: name != "b",
+    )
+    assert log == [("mutate", "a"), ("mutate", "b")]
+
+
+def test_legacy_batch_window_settings_still_ingest():
+    """The pre-rename names keep working across an image upgrade: a
+    configmap or environment carrying batch_idle_duration /
+    batch_max_duration loads into the provision_batch_* fields (new
+    name wins when both are present)."""
+    import json
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json") as f:
+        json.dump(
+            {"cluster_name": "c", "batch_idle_duration": 2.5,
+             "batch_max_duration": 20.0},
+            f,
+        )
+        f.flush()
+        s = Settings.from_file(f.name)
+    assert s.provision_batch_idle_s == 2.5
+    assert s.provision_batch_max_s == 20.0
+    s = Settings.from_env(
+        {"KARPENTER_BATCH_IDLE_DURATION": "3.0",
+         "KARPENTER_PROVISION_BATCH_MAX_S": "30.0"}
+    )
+    assert s.provision_batch_idle_s == 3.0
+    assert s.provision_batch_max_s == 30.0
+    # the new name wins when both are present
+    s = Settings.from_env(
+        {"KARPENTER_BATCH_IDLE_DURATION": "3.0",
+         "KARPENTER_PROVISION_BATCH_IDLE_S": "4.0"}
+    )
+    assert s.provision_batch_idle_s == 4.0
+
+
+def test_speculative_stage_crash_is_contained():
+    """A raising dispatch/advance hook is counted and logged; the tick's
+    mutate stages still run — a speculation bug may cost latency, never
+    actions."""
+    log = []
+    reg = Registry()
+    specs = [
+        StageSpec(
+            "a", _Recorder(log, "a"),
+            dispatch=lambda: 1 / 0,
+            advance=lambda: 1 / 0,
+        ),
+    ]
+    pipe = TickPipeline(specs, reg, Tracer(), enabled=True)
+    assert pipe.run(lambda n, c: c.reconcile(), lambda: True)
+    assert log == [("mutate", "a")]
+    assert reg.counter(
+        "karpenter_pipeline_stage_errors_total",
+        {"controller": "a", "stage": "dispatch"},
+    ) == 1
+    assert reg.counter(
+        "karpenter_pipeline_stage_errors_total",
+        {"controller": "a", "stage": "advance"},
+    ) == 1
+
+
+def test_run_concurrently_serial_is_in_order():
+    """max_workers<=1 runs on the calling thread in submission order —
+    the simulator's determinism knob."""
+    order = []
+    main = threading.get_ident()
+
+    def mk(i):
+        def fn():
+            assert threading.get_ident() == main
+            order.append(i)
+            if i == 1:
+                raise RuntimeError("boom")
+        return fn
+
+    outcomes = run_concurrently([mk(0), mk(1), mk(2)], max_workers=1)
+    assert order == [0, 1, 2]
+    assert outcomes[0] is None and outcomes[2] is None
+    assert isinstance(outcomes[1], RuntimeError)
+
+
+def test_run_concurrently_pool_preserves_result_order():
+    import time
+
+    def mk(i):
+        def fn():
+            time.sleep(0.01 * (3 - i))
+            if i == 0:
+                raise ValueError("first")
+        return fn
+
+    outcomes = run_concurrently([mk(0), mk(1), mk(2)], max_workers=3)
+    assert isinstance(outcomes[0], ValueError)
+    assert outcomes[1] is None and outcomes[2] is None
+
+
+# ----------------------------------------------------- batching window
+def test_coalesce_window_idle_and_max():
+    w = CoalesceWindow(idle_s=1.0, max_s=10.0)
+    assert not w.open and not w.ready(0.0)
+    w.observe(0.0)
+    assert w.open and not w.ready(0.5)
+    w.observe(0.9)  # fresh arrival pushes the idle deadline
+    assert not w.ready(1.5)
+    assert w.ready(1.9)
+    # max wins over a steady trickle
+    w.reset()
+    w.observe(0.0)
+    for t in range(1, 12):
+        w.observe(t * 0.9)
+    assert w.ready(10.0)
+    # non-fresh re-observation does not push the deadline
+    w.reset()
+    w.observe(0.0)
+    w.observe(0.9, fresh=False)
+    assert w.ready(1.0)
+
+
+def test_pod_batcher_window_semantics():
+    """The provisioner's pod window on the shared CoalesceWindow: seen
+    pods re-observed next tick do not push the idle deadline; fresh pods
+    do; max closes a steady trickle."""
+    from karpenter_tpu.controllers.provisioning import PodBatcher
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    b = PodBatcher(clock, idle_s=1.0, max_s=10.0)
+    pods = [Pod(name="p0"), Pod(name="p1")]
+    b.observe(pods[:1])
+    clock.step(0.5)
+    b.observe(pods[:1])  # same pod again: not an arrival
+    assert not b.ready()
+    clock.step(0.6)
+    assert b.ready()  # idle elapsed from the FIRST observation
+    b.reset()
+    b.observe(pods[:1])
+    clock.step(0.8)
+    b.observe(pods)  # fresh pod pushes the idle deadline
+    clock.step(0.5)
+    assert not b.ready()
+    clock.step(0.6)
+    assert b.ready()
+
+
+# ------------------------------------------------------ the twin proof
+def test_pipelined_vs_sequential_twin_actions():
+    """Flipping the pipelined schedule on must not change ANY decision:
+    two identically-seeded clusters — one on the strict sequential
+    order, one dispatching/advancing speculative search rounds at tick
+    boundaries — take the same actions tick for tick, and the pipelined
+    twin actually adopts speculations along the way (it is not
+    trivially identical because nothing ever overlapped)."""
+    digests = []
+    for pipelined in (False, True):
+        env = _build_env(7, 110, pipelined=pipelined)
+        op = env.operator
+        dc = op.disruption
+        dc.search_rounds = 2
+        dc.search_population = 16
+        rng = random.Random(99)
+        keys = sorted(env.kube.pods.keys())
+        for key in rng.sample(keys, len(keys) * 3 // 5):
+            env.kube.delete_pod(key)
+        states = []
+        for _ in range(12):
+            env.clock.step(65)
+            env.step(2.0)
+            states.append(
+                (
+                    tuple(sorted(
+                        name
+                        for name, cl in env.kube.node_claims.items()
+                        if cl.deleted_at is not None
+                    )),
+                    tuple(sorted(dc._pending)),
+                    tuple(sorted(
+                        (p.key(), p.node_name or "")
+                        for p in env.kube.pods.values()
+                    )),
+                )
+            )
+        digests.append(states)
+        counts = _spec_counts(env)
+        if pipelined:
+            assert counts.get("adopted", 0) > 0, counts
+        else:
+            assert not counts, counts
+        assert (
+            env.registry.counter(
+                "karpenter_consolidation_verdict_mismatch_total"
+            )
+            == 0
+        )
+    assert digests[0] == digests[1]
+
+
+def test_quiet_cluster_adopts_every_tick():
+    """Steady state — full nodes, nothing to consolidate, nothing
+    pending: the speculation dispatched at each tick's tail is adopted
+    at the next tick's slot, and the overlap histogram records the
+    device-concurrent host time."""
+    env = _build_env(3, 30)
+    op = env.operator
+    assert op.pipeline.enabled  # the production default
+    for _ in range(10):
+        env.clock.step(10)
+        env.step(1.0)
+    counts = _spec_counts(env)
+    assert counts.get("adopted", 0) >= 8, counts
+    hists = env.registry.histograms.get(
+        "karpenter_reconcile_overlap_seconds", {}
+    )
+    assert sum(h.count for h in hists.values()) == counts["adopted"]
+
+
+def test_state_change_discards_speculation():
+    """Any relevant mutation between dispatch and join — here a pod
+    landing on a candidate node — flips the fingerprint and the pass
+    recomputes synchronously; the discarded speculation is counted
+    stale and no verdict from it survives (mismatch counter stays 0)."""
+    env = _build_env(4, 40)
+    op = env.operator
+    # tick once so a speculation is in flight
+    env.clock.step(10)
+    env.step(1.0)
+    assert op.disruption._speculation is not None
+    # mutate state the search reads: delete a bound pod outright
+    bound = [p for p in env.kube.pods.values() if p.node_name]
+    env.kube.delete_pod(bound[0].key())
+    env.clock.step(10)
+    env.step(1.0)
+    counts = _spec_counts(env)
+    assert counts.get("stale", 0) >= 1, counts
+    assert (
+        env.registry.counter(
+            "karpenter_consolidation_verdict_mismatch_total"
+        )
+        == 0
+    )
+
+
+def test_sequential_mode_never_speculates():
+    env = _build_env(5, 30, pipelined=False)
+    for _ in range(5):
+        env.clock.step(10)
+        env.step(1.0)
+    assert not _spec_counts(env)
+    assert env.operator.disruption._speculation is None
+
+
+def test_sim_runner_forces_pipeline_off():
+    """The simulator's byte-compared traces record the sequential
+    schedule even when the scenario's settings ask for pipelining."""
+    from karpenter_tpu.sim.runner import SCENARIOS, ScenarioRunner
+
+    scn = SCENARIOS["steady"](4)
+    scn.settings = {
+        **scn.settings, "enable_pipelined_reconcile": True,
+    }
+    runner = ScenarioRunner(scn, seed=1, ticks=4)
+    assert runner.env.operator.pipeline.enabled is False
+
+
+@pytest.mark.sim
+def test_diurnal_interruption_storm_byte_identical(tmp_path):
+    """The pipelined-reconcile acceptance scenario's determinism half:
+    diurnal+interruption-storm stays byte-identical run/run AND
+    run/replay (the sequential schedule the sim enforces; the twin test
+    above extends the guarantee to the pipelined schedule)."""
+    from karpenter_tpu.sim.runner import replay, run_scenario
+    from karpenter_tpu.sim.trace import TraceWriter
+
+    path = str(tmp_path / "storm.jsonl")
+    w1 = TraceWriter(path)
+    _, r1 = run_scenario(
+        "diurnal+interruption-storm", seed=11, ticks=40, trace=w1
+    )
+    assert r1["invariants"]["violations"] == []
+    w2 = TraceWriter()
+    _, r2 = run_scenario(
+        "diurnal+interruption-storm", seed=11, ticks=40, trace=w2
+    )
+    assert w2.text() == open(path).read()
+    assert r2 == r1
+    w3 = TraceWriter()
+    _, replayed, recorded = replay(path, trace=w3)
+    assert recorded == r1
+    assert replayed == r1
+    assert w3.text() == open(path).read()
+
+
+# -------------------------------------------- annealing warm start
+def test_search_plan_admits_warm_masks():
+    """Warm keys ride round 0 after the structured seeds; out-of-range
+    keys are dropped defensively."""
+    plan = SearchPlan(
+        n=4, prices=[1.0] * 4, spot=[False] * 4,
+        population=64, rounds=1, seed=1,
+        warm=[(1, 3), (0, 9), (2,)],  # (0,9) out of range, (2,) too small
+    )
+    keys = plan.propose()
+    assert (1, 3) in keys
+    assert plan.warm == [(1, 3)]
+
+
+def test_warm_start_parity_and_fingerprint_gate():
+    """The cross-pass warm start satellite: a warm-started pass proposes
+    the previous pass's survivors, and its winner is acceptable by
+    exactly the same rules as the cold pass's — while a changed
+    universe fingerprint drops the warm store entirely."""
+    env = _build_env(6, 90)
+    dc = env.operator.disruption
+    dc.search_rounds = 2
+    dc.search_population = 16
+    # strand capacity so the search has real candidates
+    rng = random.Random(17)
+    keys = sorted(env.kube.pods.keys())
+    for key in rng.sample(keys, len(keys) // 2):
+        env.kube.delete_pod(key)
+    dc._budgets = dc._remaining_budgets()
+    cands = sorted(
+        (c for c in dc._candidates() if dc._consolidatable(c)),
+        key=lambda c: c.disruption_cost(),
+    )
+    assert len(cands) >= 2
+    inv = dc._pool_inventory()
+    dc._warm_store = None
+    dc._search_seq = 0
+    cold = dc._search_multi(cands, _RemovalEvaluator(dc, cands, inv))
+    assert dc._warm_store is not None
+    ufp, survivors = dc._warm_store
+    assert ufp == dc._universe_fingerprint(cands)
+    # unchanged universe: the warm masks are exactly the survivors
+    assert dc._warm_masks(cands) == survivors
+    warm = dc._search_multi(cands, _RemovalEvaluator(dc, cands, inv))
+    if survivors:
+        assert all(k in warm.seen for k in survivors)
+    # both winners (when either exists) satisfy the SAME acceptability
+    # predicate — warm seeding changes coverage, never the rules
+    for plan in (cold, warm):
+        best = plan.best()
+        if best is not None:
+            fits, price = plan.results[best.indices]
+            assert plan.acceptable(best.indices, fits, price)
+            assert cold.acceptable(best.indices, fits, price)
+    # a changed universe fingerprint yields no warm masks
+    dc._warm_store = (("bogus",), survivors)
+    assert dc._warm_masks(cands) == []
+
+
+# ------------------------------------------------- launch concurrency
+def test_launch_max_concurrency_setting_validated():
+    s = Settings(launch_max_concurrency=0)
+    with pytest.raises(ValueError, match="launch_max_concurrency"):
+        s.validate()
+    s = Settings(provision_batch_idle_s=5.0, provision_batch_max_s=1.0)
+    with pytest.raises(ValueError, match="provision_batch_max_s"):
+        s.validate()
+
+
+def test_launch_inflight_gauge_visible_during_flush():
+    """The karpenter_launch_inflight gauge reads the flush size WHILE
+    creates are in flight and returns to 0 after — a stuck CreateFleet
+    is visible while it is stuck."""
+    env = Environment()
+    env.default_node_class()
+    env.default_node_pool()
+    seen = []
+    op = env.operator
+    orig = op.cloud_provider.create
+
+    def create(claim):
+        seen.append(
+            env.registry.gauge("karpenter_launch_inflight")
+        )
+        return orig(claim)
+
+    op.cloud_provider.create = create
+    for i in range(3):
+        env.kube.put_pod(Pod(requests=Resources(cpu=2, memory="4Gi")))
+    op.provisioner.reconcile()  # opens the batch window
+    env.clock.step(2)
+    op.provisioner.reconcile()  # window closed: solve + launch
+    assert seen, "no launches happened"
+    assert all(v and v >= 1 for v in seen), seen
+    assert env.registry.gauge("karpenter_launch_inflight") == 0.0
