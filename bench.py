@@ -1677,6 +1677,42 @@ def compare_verdict(
     }
 
 
+def lint_verdict() -> dict:
+    """The static-analysis plane's verdict, attached to every --compare
+    artifact so a perf regression and a new invariant violation surface
+    in the SAME report (docs/designs/static-analysis.md).  Never raises:
+    a broken checker reports ``error`` (and fails the gate) instead of
+    killing the perf comparison."""
+    try:
+        from karpenter_tpu.analysis import (
+            PackageSnapshot,
+            RULES,
+            load_baseline,
+            run_rules,
+        )
+        from karpenter_tpu.analysis.core import default_baseline_path
+
+        snap = PackageSnapshot.load()
+        live, suppressed = run_rules(
+            snap, baseline=load_baseline(default_baseline_path(snap))
+        )
+        return {
+            "ok": not live,
+            "findings": len(live),
+            "baselined": len(suppressed),
+            "rules": len(RULES),
+            "details": [f.to_dict() for f in live[:20]],
+        }
+    except Exception as exc:  # checker down != checker clean
+        return {
+            "ok": False,
+            "findings": -1,
+            "baselined": 0,
+            "rules": 0,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+
+
 def render_verdict(verdict: dict) -> List[str]:
     """Human-readable report rows for a :func:`compare_verdict` dict."""
     rows: List[str] = []
@@ -1713,6 +1749,16 @@ def render_verdict(verdict: dict) -> List[str]:
         for metric in mal.get(side, ()):
             rows.append(
                 f"{metric:55s} MALFORMED {side} line (negative device_ms)"
+            )
+    lint = verdict.get("lint")
+    if lint is not None:
+        if lint.get("error"):
+            rows.append(f"{'lint':55s} CHECKER ERROR: {lint['error']}")
+        else:
+            status = "clean" if lint["ok"] else "VIOLATIONS"
+            rows.append(
+                f"{'lint':55s} {status}: {lint['findings']} finding(s), "
+                f"{lint['baselined']} baselined, {lint['rules']} rule(s)"
             )
     return rows
 
@@ -1761,6 +1807,10 @@ def main(
 
         prior = _load_bench_lines(compare)
         verdict = compare_verdict(_LINES, prior)
+        # the lint verdict rides every compare artifact: a perf
+        # regression and a fresh invariant violation surface in the
+        # same report (and both gate the exit code)
+        verdict["lint"] = lint_verdict()
         rows, regressed = render_verdict(verdict), verdict["regressed"]
         print(f"vs {compare}:", file=sys.stderr)
         for row in rows:
@@ -1793,6 +1843,13 @@ def main(
                 f"{COMPARE_THRESHOLD:.0%}: {', '.join(regressed)}",
                 file=sys.stderr,
             )
+            rc = 1
+        if not verdict["lint"]["ok"]:
+            reason = (
+                verdict["lint"].get("error")
+                or f"{verdict['lint']['findings']} non-baselined finding(s)"
+            )
+            print(f"lint gate failed: {reason}", file=sys.stderr)
             rc = 1
         return rc
     return 0

@@ -54,6 +54,15 @@ def main(argv=None) -> int:
         from karpenter_tpu.sim.cli import main as sim_main
 
         return sim_main(argv[1:], allow_reexec=True)
+    if argv and argv[0] == "lint":
+        # whole-program static analysis: the rule engine + the
+        # lock-discipline / determinism-reachability / tracer-safety
+        # analyzers over the package's parsed AST (analysis/,
+        # docs/designs/static-analysis.md).  Exit 0 clean, 1 findings,
+        # 2 internal error.
+        from karpenter_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     if argv and argv[0] == "store-server":
         # shared cluster-store server mode: own the one durable KubeStore
         # that --store-address controllers (and their Lease election)

@@ -1,0 +1,47 @@
+"""Whole-program static analysis plane (docs/designs/static-analysis.md).
+
+Every correctness guarantee this repo leans on — byte-identical sim
+replay, identical twin-run actions under pipelining, zero verdict
+mismatches, honest transfer accounting — is machine-checked here.  The
+subsystem has four parts:
+
+- **Rule engine** (core.py): rules are registered classes over a shared
+  parsed-AST snapshot of the package (`PackageSnapshot`), findings are
+  structured records with stable fingerprints, per-rule allowlists live
+  in ONE declarative table (allowlists.py), and a baseline file can
+  suppress known findings without deleting the signal.  The CLI is
+  ``python -m karpenter_tpu lint [--json] [--rule NAME]``.
+- **Lock-discipline analyzer** (locks.py): discovers the package's lock
+  attributes, flags blocking operations reachable inside a held-lock
+  region, and proves there is no inconsistent acquisition order between
+  any two locks in the store/pipeline/operator layers.
+- **Determinism-reachability analyzer** (reachability.py): builds an
+  intra-package call graph and proves nothing reachable from the
+  byte-compared surfaces (sim trace digests, ledger lines, SLO report,
+  twin-run adoption) can reach a tainted source — wall clock, unseeded
+  random, os.environ — outside the sanctioned-sink list.
+- **Tracer-safety analyzer** (tracer.py): every ``jax.jit`` callable is
+  discovered from its decorator/binding and must be dispatched through
+  the device observatory's counted seam, with no host-side mutation,
+  ``time.*`` or ``print`` inside traced bodies.
+
+The 11 legacy lint rules (tests/test_lint.py's original suite) are
+ported onto the engine in rules_legacy.py with their allowlists intact.
+"""
+
+from karpenter_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    PackageSnapshot,
+    Rule,
+    RULES,
+    load_baseline,
+    register,
+    run_rules,
+    to_report,
+)
+
+# registering imports: each module's import populates RULES
+from karpenter_tpu.analysis import rules_legacy  # noqa: F401,E402
+from karpenter_tpu.analysis import locks  # noqa: F401,E402
+from karpenter_tpu.analysis import reachability  # noqa: F401,E402
+from karpenter_tpu.analysis import tracer  # noqa: F401,E402

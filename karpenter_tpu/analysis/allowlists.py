@@ -1,0 +1,176 @@
+"""THE declarative allowlist table: one place where every sanctioned
+exception to every rule lives, each with the argument for its existence.
+
+Entry types are rule-defined:
+
+- path strings ``"karpenter_tpu/utils/clock.py"`` (file-scoped),
+- ``(file, qualified name)`` tuples (call-site / region scoped),
+- ``"LockA|LockB"`` pair ids (lock-order),
+- ``"root:<rel_in_pkg>:<qual>"`` strings (extra determinism roots — the
+  teeth harness hook),
+- bare names (doc-vocabulary extensions, used only by synthetic tests).
+
+Adding an entry here is a REVIEWED act: the PR that adds one must say
+why the exception is sound (see docs/designs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+# ---------------------------------------------------------------- legacy
+# rule 3: the genuinely-wall-clock spot — the Clock abstraction itself is
+# the one place allowed to read the wall (time.monotonic/perf_counter
+# remain free: host-side durations no simulated clock can compress).
+_WALL_CLOCK = frozenset({"karpenter_tpu/utils/clock.py"})
+
+# rule 4: the sanctioned scheduler.update call sites in controllers/ —
+# the provisioner's one-per-solve refresh, the deprovisioner's explicit
+# sequential-simulation fallback, and the batched evaluator's
+# once-per-pass full-cluster sync.
+_SCHEDULER_UPDATE = frozenset(
+    {
+        ("karpenter_tpu/controllers/provisioning.py", "Provisioner.provision"),
+        ("karpenter_tpu/controllers/disruption.py",
+         "DisruptionController._simulate"),
+        ("karpenter_tpu/controllers/disruption.py",
+         "_RemovalEvaluator._sync_scheduler"),
+    }
+)
+
+# rule 7: the sanctioned full-tensorize sites — the wrapper itself, the
+# cold build / resident-miss rebuild, the direct compile+pack+decode
+# kept for tests, and the consolidation base's rebuild fallback.
+_FULL_TENSORIZE = frozenset(
+    {
+        ("karpenter_tpu/scheduling/solver.py",
+         "TensorScheduler._compile_tensor"),
+        ("karpenter_tpu/scheduling/solver.py", "TensorScheduler._solve"),
+        ("karpenter_tpu/scheduling/solver.py",
+         "TensorScheduler._solve_tensor"),
+        ("karpenter_tpu/scheduling/solver.py",
+         "TensorScheduler._build_removal_base"),
+    }
+)
+
+# rule 8: the sanctioned sequential-descent sites — the lazy per-element
+# fallback, the winner's authoritative re-derivation, and the
+# consolidation pass entry points (multi -> descent fallback).
+_SEQUENTIAL_DESCENT = frozenset(
+    {
+        ("karpenter_tpu/controllers/disruption.py",
+         "_RemovalEvaluator.result"),
+        ("karpenter_tpu/controllers/disruption.py",
+         "_RemovalEvaluator.vnode_for"),
+        ("karpenter_tpu/controllers/disruption.py",
+         "DisruptionController._consolidate"),
+        ("karpenter_tpu/controllers/disruption.py",
+         "DisruptionController._consolidate_multi"),
+    }
+)
+
+# rule 9: the counted-upload seam is the one sanctioned raw device_put.
+_DEVICE_PUT = frozenset(
+    {("karpenter_tpu/obs/device.py", "DeviceObservatory.put")}
+)
+
+# rule 11: the one sanctioned pool constructor for the controller layer.
+_THREAD_SEAM = frozenset(
+    {("karpenter_tpu/pipeline.py", "run_concurrently")}
+)
+
+# ------------------------------------------------------- lock discipline
+# Cross-class lock aliases the AST cannot see: _Subscriber.cond is
+# constructed OVER the VersionedStore's lock (store_server.py — offers
+# happen under the store lock, the sender waits on the same lock), so
+# holding one IS holding the other; without the alias every
+# subscribe-under-lock would read as a lock-order edge.
+LOCK_ALIASES: Dict[str, str] = {
+    "_Subscriber.cond": "VersionedStore.lock",
+}
+
+# lock-order scan scope: the layers whose locks interleave across
+# threads (store plane, pipeline/operator, controllers, batcher).
+LOCK_ORDER_LAYERS = (
+    "service/",
+    "state/",
+    "pipeline.py",
+    "operator.py",
+    "controllers/",
+    "batcher/",
+    "utils/leader.py",
+)
+
+# lock-blocking sanctioned regions, each with its argument:
+_LOCK_BLOCKING = frozenset(
+    {
+        # The RPC lock EXISTS to serialize the one shared connection:
+        # one in-flight request per socket is the framing protocol's
+        # invariant, so the send/recv pair must sit inside it.  Nothing
+        # else ever takes this lock.
+        ("karpenter_tpu/state/remote.py", "RemoteKubeStore._rpc"),
+        # Lease operations serialize END-TO-END by design (the
+        # base_rv race documented at the _lease_mutex definition):
+        # holding the dedicated mutex across flush+RPC is the
+        # correctness mechanism, and only lease ops contend on it.
+        ("karpenter_tpu/state/remote.py",
+         "RemoteKubeStore.try_acquire_lease"),
+        ("karpenter_tpu/state/remote.py", "RemoteKubeStore.renew_lease"),
+        ("karpenter_tpu/state/remote.py", "RemoteKubeStore.release_lease"),
+        # The solver sidecar client: same one-in-flight-RPC-per-
+        # connection design as RemoteKubeStore._rpc.
+        ("karpenter_tpu/service/client.py", "RemoteSolver._call"),
+        # A bin snapshot references LIVE objects, so it must be rendered
+        # before the store lock drops (store_server.py documents the
+        # contract; the JSON tree path encodes outside).  The watcher
+        # condition shares the store lock, so the coalesced-resync build
+        # (_resync_payload_locked) sits under the same region.
+        ("karpenter_tpu/service/store_server.py", "StoreServer.serve_watch"),
+        # The ledger's JSONL sink writes one SMALL event per emit under
+        # the ring lock — the lock is what keeps sink lines in seq
+        # order; payloads are single events, never snapshot-sized.
+        ("karpenter_tpu/obs/events.py", "EventLedger.emit"),
+    }
+)
+
+_LOCK_ORDER = frozenset()
+
+# ------------------------------------------------- determinism analyzer
+# The byte-compared surfaces (package-relative so synthetic trees keep
+# the vocabulary): per-tick digests, ledger lines, the SLO report, the
+# cluster event ledger, and the pipelined twin-run adoption seam.
+DETERMINISM_ROOTS = (
+    "sim/trace.py:TraceWriter.digest",
+    "sim/trace.py:TraceWriter.ledger",
+    "sim/trace.py:TraceWriter.report",
+    "sim/report.py:build_report",
+    "obs/events.py:EventLedger.emit",
+    "controllers/disruption.py:DisruptionController._take_speculation",
+    "controllers/disruption.py:DisruptionController._pass_fingerprint",
+)
+
+# sanctioned sinks, each with its argument:
+_DETERMINISM = frozenset(
+    {
+        # THE sanctioned wall-clock: determinism holds because the
+        # simulator injects a FakeClock here; replay tests prove the
+        # bytes (docs/designs/simulation.md).
+        "karpenter_tpu/utils/clock.py",
+    }
+)
+
+# --------------------------------------------------- tracer-safety seam
+_TRACER_SAFETY = frozenset()
+
+ALLOWLISTS: Dict[str, frozenset] = {
+    "wall-clock": _WALL_CLOCK,
+    "scheduler-update": _SCHEDULER_UPDATE,
+    "full-tensorize": _FULL_TENSORIZE,
+    "sequential-descent": _SEQUENTIAL_DESCENT,
+    "device-put": _DEVICE_PUT,
+    "thread-seam": _THREAD_SEAM,
+    "lock-blocking": _LOCK_BLOCKING,
+    "lock-order": _LOCK_ORDER,
+    "determinism-reachability": _DETERMINISM,
+    "tracer-safety": _TRACER_SAFETY,
+}

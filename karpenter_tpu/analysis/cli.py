@@ -1,0 +1,120 @@
+"""``python -m karpenter_tpu lint`` — the static-analysis CLI.
+
+Exit codes: 0 clean (no non-baselined findings), 1 findings, 2 internal
+error (the analyzer itself broke — CI must distinguish "violations"
+from "the checker is down").
+
+``--json`` emits the stable, sorted report schema (core.to_report) so
+CI diffs are deterministic; ``--profile`` adds per-rule wall timings (to
+stderr in text mode, under ``timings_s`` in JSON mode) so a slow rule
+cannot silently balloon tier-1 time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu lint",
+        description="whole-program static analysis over the package "
+        "(docs/designs/static-analysis.md)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the stable machine-readable report on stdout",
+    )
+    parser.add_argument(
+        "--rule", action="append", default=[], metavar="NAME",
+        help="run only this rule (repeatable); default: all registered",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog (name, title, guarded guarantee)",
+    )
+    parser.add_argument(
+        "--profile", action="store_true",
+        help="print per-rule wall timings",
+    )
+    parser.add_argument(
+        "--root", default="", metavar="DIR",
+        help="package directory to lint (default: the installed "
+        "karpenter_tpu package)",
+    )
+    parser.add_argument(
+        "--baseline", default="", metavar="FILE",
+        help="suppression file (default: <package>/analysis/"
+        "baseline.json)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        from karpenter_tpu.analysis import (
+            PackageSnapshot,
+            RULES,
+            load_baseline,
+            run_rules,
+            to_report,
+        )
+        from karpenter_tpu.analysis.core import default_baseline_path
+
+        if args.list_rules:
+            for name in sorted(RULES):
+                rule = RULES[name]
+                print(f"{name:28s} {rule.title}")
+                print(f"{'':28s}   guards: {rule.guards}")
+            return 0
+
+        snap = PackageSnapshot.load(
+            pathlib.Path(args.root) if args.root else None
+        )
+        baseline_path = (
+            pathlib.Path(args.baseline)
+            if args.baseline
+            else default_baseline_path(snap)
+        )
+        baseline = load_baseline(baseline_path)
+        timings = {} if args.profile else None
+        live, suppressed = run_rules(
+            snap,
+            rule_names=args.rule or None,
+            baseline=baseline,
+            timings=timings,
+        )
+    except Exception as exc:  # the checker itself broke: exit 2
+        print(f"lint internal error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    rule_names = args.rule or sorted(RULES)
+    if args.json:
+        print(
+            json.dumps(
+                to_report(snap, live, suppressed, rule_names, timings),
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        for f in live:
+            print(f.render())
+        if suppressed:
+            print(f"({len(suppressed)} baselined finding(s) suppressed)")
+        print(
+            f"lint: {len(live)} finding(s), {len(suppressed)} baselined, "
+            f"{len(rule_names)} rule(s)"
+        )
+        if timings is not None:
+            for name, dt in sorted(
+                timings.items(), key=lambda kv: -kv[1]
+            ):
+                print(f"  {name:28s} {dt * 1000:8.1f} ms", file=sys.stderr)
+    return 1 if live else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,328 @@
+"""Tracer-safety analyzer for ``jax.jit`` entry points.
+
+Generalizes legacy rule 9's named-callsite fence to DECORATOR-DRIVEN
+discovery: every jit-produced callable in the package is found from its
+binding —
+
+- ``@jax.jit`` / ``@jit`` decorated defs,
+- ``@partial(jax.jit, ...)`` / ``@functools.partial(jax.jit, ...)``,
+- name bindings ``f = jax.jit(...)`` (module-level or local),
+
+and three properties are checked:
+
+1. **Seamed dispatch**: a discovered jit callable must not be CALLED
+   directly anywhere in the package outside the device observatory's
+   counted seam (obs/device.py) — every dispatch routes through
+   ``OBSERVATORY.dispatch(name, fn, ...)`` so compile/transfer
+   accounting cannot rot.  Passing the callable as an argument (the
+   dispatch pattern) is fine; calling it from inside ANOTHER traced body
+   is device-side composition and also fine.
+2. **No host mutation of traced parameters**: ``np.<mutator>(param,
+   ...)``, in-place ndarray methods on a parameter, or subscript
+   assignment to a parameter inside a traced body — the classic
+   TracerArrayConversionError / silent-constant-folding bug class.
+3. **No bare ``time.*`` or ``print`` in traced bodies**: both run at
+   TRACE time, not run time — a timestamp or log that looks per-call
+   but fires once per compile is a lie in any byte-compared artifact.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from karpenter_tpu.analysis.core import (
+    Finding,
+    PackageSnapshot,
+    Rule,
+    ScopedVisitor,
+    register,
+)
+
+_NP_MUTATORS = frozenset(
+    {"put", "place", "copyto", "putmask", "fill_diagonal"}
+)
+# NOTE deliberately no in-place ndarray METHOD check (param.sort() etc):
+# inside a traced body the parameters are tracers, whose .sort() is the
+# functional jax.numpy method returning a new array — flagging it would
+# be a false positive by construction.  Host mutation enters through
+# np.* mutators and subscript assignment, both checked below.
+
+# the sanctioned seam file (package-relative)
+_SEAM_FILE = "obs/device.py"
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    """``jax.jit`` / ``jit`` expression?"""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit" and (
+            isinstance(node.value, ast.Name) and node.value.id == "jax"
+        )
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call(node: ast.expr) -> bool:
+    """``jax.jit(...)`` or ``partial(jax.jit, ...)`` expression?"""
+    if not isinstance(node, ast.Call):
+        return False
+    if _is_jax_jit(node.func):
+        return True
+    f = node.func
+    is_partial = (isinstance(f, ast.Name) and f.id == "partial") or (
+        isinstance(f, ast.Attribute) and f.attr == "partial"
+    )
+    return is_partial and any(_is_jax_jit(a) for a in node.args)
+
+
+def discover_jit(
+    tree: ast.Module,
+) -> Tuple[Dict[str, ast.AST], Set[str], Dict[ast.AST, Set[str]]]:
+    """(decorated defs by name, module-wide bound names, per-function
+    local bound names).
+
+    Scoping matters: ``fn = jax.jit(step)`` inside one method must only
+    fence calls of ``fn`` within THAT function — a global match would
+    flag every unrelated ``fn()`` in the package.  Attribute bindings
+    (``self._step_fn = jax.jit(...)``) are object-scoped and therefore
+    module-wide by attribute name."""
+    defs: Dict[str, ast.AST] = {}
+    bound: Set[str] = set()
+    local: Dict[ast.AST, Set[str]] = {}
+
+    # names handed to jax.jit as the wrapped FUNCTION (``jax.jit(step,
+    # ...)``): their defs are traced bodies even without a decorator —
+    # the factory pattern mesh.py/resident.py use
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and _is_jax_jit(node.func)
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+        ):
+            wrapped.add(node.args[0].id)
+
+    def scan_assign(node: ast.Assign, fn_scope) -> None:
+        if not _jit_call(node.value):
+            return
+        for target in node.targets:
+            if isinstance(target, ast.Attribute):
+                bound.add(target.attr)
+            elif isinstance(target, ast.Name):
+                if fn_scope is None:
+                    bound.add(target.id)
+                else:
+                    local.setdefault(fn_scope, set()).add(target.id)
+
+    def walk(node, fn_scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if child.name in wrapped or any(
+                    _is_jax_jit(d) or _jit_call(d)
+                    for d in child.decorator_list
+                ):
+                    if fn_scope is None:
+                        defs[child.name] = child
+                    else:
+                        # a jit def nested inside a factory is only
+                        # callable from that factory: fence its name
+                        # locally, not across the package — and never
+                        # touch defs[child.name], which may hold a
+                        # SAME-NAMED module-level jit def whose body and
+                        # call sites must stay covered
+                        local.setdefault(fn_scope, set()).add(child.name)
+                        defs[f"{fn_scope.name}.{child.name}"] = child
+                walk(child, child)
+            elif isinstance(child, ast.Assign):
+                scan_assign(child, fn_scope)
+                walk(child, fn_scope)
+            else:
+                walk(child, fn_scope)
+
+    walk(tree, None)
+    return defs, bound, local
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = {a.arg for a in args.args + args.kwonlyargs + args.posonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+@register
+class TracerSafetyRule(Rule):
+    """jit bodies are pure traced compute; dispatch takes the seam."""
+
+    name = "tracer-safety"
+    title = "jit callables seam-dispatched; traced bodies stay pure"
+    guards = "transfer/compile accounting + no trace-time host effects"
+
+    def check(self, snap, allowlist) -> List[Finding]:
+        out: List[Finding] = []
+        # pass 1: discover every jit callable and lint its body
+        jit_names: Set[str] = set()
+        jit_def_spans: Dict[str, List[Tuple[int, int]]] = {}
+        locals_by_rel: Dict[str, Dict[ast.AST, Set[str]]] = {}
+        for info in snap.in_package():
+            defs, bound, local = discover_jit(info.tree)
+            jit_names.update(defs)
+            jit_names.update(bound)
+            locals_by_rel[info.rel] = local
+            for name, fn in defs.items():
+                jit_def_spans.setdefault(info.rel, []).append(
+                    (fn.lineno, max(fn.lineno, fn.end_lineno or fn.lineno))
+                )
+                out.extend(self._lint_traced_body(info.rel, name, fn))
+        # pass 2: every direct call of a jit name must take the seam
+        for info in snap.in_package():
+            if info.rel_in_pkg == _SEAM_FILE:
+                continue
+            out.extend(
+                self._lint_call_sites(
+                    info, jit_names, jit_def_spans.get(info.rel, []),
+                    allowlist,
+                )
+            )
+            # function-local jit bindings: fence calls within their own
+            # function only
+            for fn_node, names in locals_by_rel[info.rel].items():
+                out.extend(
+                    self._lint_local_calls(info, fn_node, names, allowlist)
+                )
+        return out
+
+    def _lint_local_calls(
+        self, info, fn_node, names: Set[str], allowlist
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        rel = info.rel
+        for node in ast.walk(fn_node):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in names
+            ):
+                qual = fn_node.name
+                if (rel, qual) in allowlist:
+                    continue
+                out.append(
+                    self.finding(
+                        rel, node.lineno,
+                        f"{qual}: direct call of locally-jitted "
+                        f"{node.func.id}(...) bypasses the counted seam "
+                        "— route it through OBSERVATORY.dispatch, or "
+                        "consciously allowlist this site",
+                    )
+                )
+        return out
+
+    # ------------------------------------------------------- traced bodies
+    def _lint_traced_body(self, rel: str, name: str, fn) -> List[Finding]:
+        out: List[Finding] = []
+        params = _param_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id == "print":
+                    out.append(
+                        self.finding(
+                            rel, node.lineno,
+                            f"print(...) inside traced body {name}: runs "
+                            "at trace time, once per compile — not per "
+                            "call",
+                        )
+                    )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "time"
+                ):
+                    out.append(
+                        self.finding(
+                            rel, node.lineno,
+                            f"time.{f.attr}(...) inside traced body "
+                            f"{name}: trace-time host clock, constant-"
+                            "folded into the compiled program",
+                        )
+                    )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy")
+                    and f.attr in _NP_MUTATORS
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                ):
+                    out.append(
+                        self.finding(
+                            rel, node.lineno,
+                            f"np.{f.attr}({node.args[0].id}, ...) mutates "
+                            f"a traced parameter of {name} host-side — "
+                            "use jnp functional updates (.at[].set)",
+                        )
+                    )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    if (
+                        isinstance(target, ast.Subscript)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id in params
+                    ):
+                        out.append(
+                            self.finding(
+                                rel, target.lineno,
+                                f"{target.value.id}[...] = ... assigns "
+                                f"into a traced parameter of {name} — "
+                                "tracers are immutable; use .at[].set",
+                            )
+                        )
+        return out
+
+    # --------------------------------------------------------- call sites
+    def _lint_call_sites(
+        self, info, jit_names: Set[str], def_spans, allowlist
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        rel = info.rel
+        rule = self
+
+        def inside_jit(line: int) -> bool:
+            return any(lo <= line <= hi for lo, hi in def_spans)
+
+        class V(ScopedVisitor):
+            def on_call(self, node):
+                f = node.func
+                name = None
+                if isinstance(f, ast.Name) and f.id in jit_names:
+                    name = f.id
+                elif isinstance(f, ast.Attribute) and f.attr in jit_names:
+                    name = f.attr
+                if name is None:
+                    return
+                if inside_jit(node.lineno):
+                    return  # device-side composition inside a traced body
+                if (rel, self.qual) in allowlist:
+                    return
+                out.append(
+                    rule.finding(
+                        rel, node.lineno,
+                        f"{self.qual or '<module>'}: direct call of jit "
+                        f"callable {name}(...) bypasses the counted seam "
+                        "— route it through OBSERVATORY.dispatch("
+                        f"'{name}', {name}, ...), or consciously "
+                        "allowlist this site",
+                    )
+                )
+
+        V().visit(info.tree)
+        return out

@@ -396,7 +396,10 @@ def fetch_bundled(res: "PackResult"):
     # not carry a bundle field at all
     buf = getattr(res, "bundle", None)
     if buf is None:
-        buf = bundle_outputs(res.take, res.leftover, res.node_cfg, res.node_used)
+        buf = OBSERVATORY.dispatch(
+            "bundle_outputs", bundle_outputs,
+            res.take, res.leftover, res.node_cfg, res.node_used,
+        )
     return unbundle_outputs(np.asarray(buf), res.take, res.node_used.shape)
 
 

@@ -89,6 +89,7 @@ class SolverServer(socketserver.ThreadingTCPServer):
         return encode({"status": "error", "error": f"unknown method {method}"}, {})
 
     def _pack(self, header: dict, arrays: dict) -> bytes:
+        from karpenter_tpu.obs.device import OBSERVATORY
         from karpenter_tpu.ops.packer import fetch_bundled, pack_kernel
 
         missing = [n for n in PACK_ARG_ORDER if n not in arrays]
@@ -99,7 +100,11 @@ class SolverServer(socketserver.ThreadingTCPServer):
         args = [arrays[n] for n in PACK_ARG_ORDER]
         # next0 travels as a 0-d array; the kernel wants a scalar
         args[_NEXT0_IDX] = np.int32(args[_NEXT0_IDX])
-        result = pack_kernel(
+        # the sidecar owns the devices, so ITS process observatory is
+        # where this dispatch's compile/transfer accounting belongs —
+        # the wire arrays are numpy, so the seam counts the real upload
+        result = OBSERVATORY.dispatch(
+            "pack_kernel", pack_kernel,
             *args,
             k_slots=int(header["k_slots"]),
             objective=header.get("objective", "nodes"),

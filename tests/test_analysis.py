@@ -1,0 +1,671 @@
+"""Unit tests for the static-analysis plane itself (karpenter_tpu/analysis/):
+the engine's structured findings and stable JSON, the call graph's
+resolution rules, the lock model, per-analyzer positive/negative/
+allowlisted/baselined behavior on synthetic trees, and the pinned
+regressions for the real violations the analyzers surfaced (the
+uncounted jit dispatches in fetch_bundled and the solver sidecar)."""
+
+from __future__ import annotations
+
+import ast
+import json
+import sys
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.analysis import (
+    Finding,
+    PackageSnapshot,
+    RULES,
+    load_baseline,
+    run_rules,
+    to_report,
+)
+from karpenter_tpu.analysis.allowlists import ALLOWLISTS
+from karpenter_tpu.analysis.graph import CallGraph
+from karpenter_tpu.analysis.locks import build_lock_model
+
+
+def forge(tmp_path, files: dict, pkg_name: str = "forged") -> PackageSnapshot:
+    pkg = tmp_path / pkg_name
+    for rel, src in files.items():
+        path = pkg / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return PackageSnapshot(pkg)
+
+
+# ----------------------------------------------------------------- engine
+class TestEngine:
+    def test_snapshot_indexes_modules(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {"__init__.py": "", "a.py": "x = 1\n", "sub/b.py": "y = 2\n"},
+        )
+        assert sorted(m.rel_in_pkg for m in snap.in_package()) == [
+            "__init__.py", "a.py", "sub/b.py",
+        ]
+        assert snap.modules["forged/sub/b.py"].name == "forged.sub.b"
+        assert list(snap.in_package("sub/"))[0].rel == "forged/sub/b.py"
+
+    def test_parse_error_is_a_finding_not_a_crash(self, tmp_path):
+        snap = forge(tmp_path, {"bad.py": "def broken(:\n"})
+        live, _ = run_rules(snap, rule_names=["wall-clock"])
+        assert len(live) == 1 and live[0].rule == "parse"
+
+    def test_fingerprint_survives_line_drift(self):
+        a = Finding(rule="r", file="f.py", line=3, message="m")
+        b = Finding(rule="r", file="f.py", line=30, message="m")
+        c = Finding(rule="r", file="f.py", line=3, message="other")
+        assert a.fingerprint == b.fingerprint != c.fingerprint
+
+    def test_json_report_is_stable_and_sorted(self, tmp_path):
+        """The CI-diffable contract: same tree -> byte-identical JSON,
+        findings sorted, no wall-clock field without --profile."""
+        files = {
+            "x.py": "import time\nb = time.sleep(1)\na = time.time()\n"
+        }
+        reports = []
+        for _ in range(2):
+            snap = forge(tmp_path, files)
+            live, supp = run_rules(
+                snap, rule_names=["wall-clock"],
+                allowlists={"wall-clock": frozenset()},
+            )
+            reports.append(
+                json.dumps(
+                    to_report(snap, live, supp, ["wall-clock"]),
+                    sort_keys=True,
+                )
+            )
+        assert reports[0] == reports[1]
+        report = json.loads(reports[0])
+        assert report["version"] == 1
+        assert "timings_s" not in report
+        lines = [f["line"] for f in report["findings"]]
+        assert lines == sorted(lines) and len(lines) == 2
+        assert set(report["findings"][0]) == {
+            "rule", "file", "line", "message", "fingerprint",
+        }
+
+    def test_identical_findings_get_distinct_fingerprints(self, tmp_path):
+        """Two byte-identical violations in one file must not share a
+        fingerprint — baselining the known one cannot silently suppress
+        a fresh duplicate (review finding)."""
+        snap = forge(
+            tmp_path,
+            {"x.py": "import time\nx = time.time()\nx = time.time()\n"},
+        )
+        live, _ = run_rules(
+            snap, rule_names=["wall-clock"],
+            allowlists={"wall-clock": frozenset()},
+        )
+        assert len(live) == 2
+        assert live[0].fingerprint != live[1].fingerprint
+        live2, suppressed = run_rules(
+            snap, rule_names=["wall-clock"],
+            allowlists={"wall-clock": frozenset()},
+            baseline={live[0].fingerprint: "known"},
+        )
+        assert len(live2) == 1 and len(suppressed) == 1
+
+    def test_allowlist_table_names_only_registered_rules(self):
+        assert set(ALLOWLISTS) <= set(RULES)
+
+    def test_cli_baseline_file_suppresses(self, tmp_path):
+        from karpenter_tpu.analysis.cli import main as lint_main
+
+        pkg = tmp_path / "forged"
+        pkg.mkdir()
+        (pkg / "x.py").write_text("import time\na = time.time()\n")
+        assert lint_main(
+            ["--root", str(pkg), "--rule", "wall-clock"]
+        ) == 1
+        snap = PackageSnapshot(pkg)
+        live, _ = run_rules(
+            snap, rule_names=["wall-clock"],
+            allowlists={"wall-clock": frozenset()},
+        )
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(
+            json.dumps(
+                {
+                    "version": 1,
+                    "suppressions": [
+                        {"fingerprint": live[0].fingerprint, "note": "known"}
+                    ],
+                }
+            )
+        )
+        assert load_baseline(baseline) == {live[0].fingerprint: "known"}
+        assert lint_main(
+            [
+                "--root", str(pkg), "--rule", "wall-clock",
+                "--baseline", str(baseline),
+            ]
+        ) == 0
+
+
+# ------------------------------------------------------------ call graph
+class TestCallGraph:
+    def test_self_super_and_import_resolution(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "base.py": "class Base:\n    def ping(self):\n        pass\n",
+                "x.py": (
+                    "from forged.base import Base\n"
+                    "from forged.util import helper\n"
+                    "class C(Base):\n"
+                    "    def a(self):\n"
+                    "        self.b()\n"
+                    "        super().ping()\n"
+                    "        helper()\n"
+                    "    def b(self):\n"
+                    "        pass\n"
+                ),
+                "util.py": "def helper():\n    pass\n",
+            },
+        )
+        g = CallGraph(snap)
+        callees = g.defs["forged/x.py:C.a"].callees
+        assert "forged/x.py:C.b" in callees
+        assert "forged/base.py:Base.ping" in callees
+        assert "forged/util.py:helper" in callees
+
+    def test_inherited_method_resolves_through_base(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "x.py": (
+                    "class Base:\n"
+                    "    def work(self):\n"
+                    "        pass\n"
+                    "class C(Base):\n"
+                    "    def a(self):\n"
+                    "        self.work()\n"
+                ),
+            },
+        )
+        g = CallGraph(snap)
+        assert "forged/x.py:Base.work" in g.defs["forged/x.py:C.a"].callees
+
+    def test_relative_import_in_package_init_resolves(self, tmp_path):
+        """``from .sub import f`` inside an __init__.py resolves against
+        the package itself, not its parent (review finding: the stripped
+        ``.__init__`` suffix shifted the level arithmetic one up)."""
+        snap = forge(
+            tmp_path,
+            {
+                "__init__.py": (
+                    "from .util import helper\n"
+                    "def top():\n"
+                    "    return helper()\n"
+                ),
+                "util.py": "def helper():\n    pass\n",
+            },
+        )
+        g = CallGraph(snap)
+        assert "forged/util.py:helper" in g.defs["forged/__init__.py:top"].callees
+
+    def test_stoplisted_names_do_not_alias_globally(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "a.py": "class A:\n    def get(self):\n        pass\n",
+                "b.py": "def f(cache):\n    cache.get('k')\n",
+            },
+        )
+        g = CallGraph(snap)
+        assert g.defs["forged/b.py:f"].callees == set()
+
+
+# ------------------------------------------------------------ lock model
+class TestLockModel:
+    def test_discovery_and_condition_alias(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "x.py": (
+                    "import threading\n"
+                    "class S:\n"
+                    "    def __init__(self):\n"
+                    "        self._lk = threading.Lock()\n"
+                    "        self._cv = threading.Condition(self._lk)\n"
+                ),
+            },
+        )
+        model = build_lock_model(snap)
+        assert model.owners[("S", "_lk")] == "Lock"
+        assert model.canonical("S._cv") == "S._lk"
+
+    def test_condition_alias_means_no_self_edge(self, tmp_path):
+        """Holding the Condition IS holding the wrapped lock: nesting
+        them must not read as a lock-order edge."""
+        snap = forge(
+            tmp_path,
+            {
+                "service/x.py": (
+                    "import threading\n"
+                    "class S:\n"
+                    "    def __init__(self):\n"
+                    "        self._lk = threading.Lock()\n"
+                    "        self._cv = threading.Condition(self._lk)\n"
+                    "    def a(self):\n"
+                    "        with self._lk:\n"
+                    "            with self._cv:\n"
+                    "                pass\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["lock-order"],
+            allowlists={"lock-order": frozenset()},
+        )
+        assert not live, [f.render() for f in live]
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "service/x.py": (
+                    "import threading\n"
+                    "class S:\n"
+                    "    def __init__(self):\n"
+                    "        self._a = threading.Lock()\n"
+                    "        self._b = threading.Lock()\n"
+                    "    def one(self):\n"
+                    "        with self._a:\n"
+                    "            with self._b:\n"
+                    "                pass\n"
+                    "    def two(self):\n"
+                    "        with self._a:\n"
+                    "            with self._b:\n"
+                    "                pass\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["lock-order"],
+            allowlists={"lock-order": frozenset()},
+        )
+        assert not live, [f.render() for f in live]
+
+    def test_transitive_inversion_through_calls(self, tmp_path):
+        """The inversion hides one call deep on each side — the exact
+        shape a per-callsite rule cannot express."""
+        snap = forge(
+            tmp_path,
+            {
+                "service/x.py": (
+                    "import threading\n"
+                    "class S:\n"
+                    "    def __init__(self):\n"
+                    "        self._a = threading.Lock()\n"
+                    "        self._b = threading.Lock()\n"
+                    "    def fwd(self):\n"
+                    "        with self._a:\n"
+                    "            self._take_b()\n"
+                    "    def _take_b(self):\n"
+                    "        with self._b:\n"
+                    "            pass\n"
+                    "    def rev(self):\n"
+                    "        with self._b:\n"
+                    "            self._take_a()\n"
+                    "    def _take_a(self):\n"
+                    "        with self._a:\n"
+                    "            pass\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["lock-order"],
+            allowlists={"lock-order": frozenset()},
+        )
+        assert len(live) == 1 and "inversion" in live[0].message
+
+    def test_lock_finding_fingerprints_survive_line_drift(self, tmp_path):
+        """Lock messages must not embed line numbers: a baselined lock
+        finding survives unrelated drift in the callee file (review
+        finding: site strings carried ':line')."""
+        src = (
+            "import threading\n"
+            "from forged.service.codec import send_frame\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lk = threading.Lock()\n"
+            "    def push(self, sock, payload):\n"
+            "        with self._lk:\n"
+            "            send_frame(sock, payload)\n"
+        )
+        codec = "def send_frame(s, b):\n    s.sendall(b)\n"
+        snap1 = forge(tmp_path / "v1", {"service/x.py": src,
+                                        "service/codec.py": codec})
+        snap2 = forge(
+            tmp_path / "v2",
+            {"service/x.py": src,
+             "service/codec.py": "import io\n\n\n" + codec},
+        )
+        fps = []
+        for snap in (snap1, snap2):
+            live, _ = run_rules(
+                snap, rule_names=["lock-blocking"],
+                allowlists={"lock-blocking": frozenset()},
+            )
+            assert live
+            fps.append(sorted(f.fingerprint for f in live))
+        assert fps[0] == fps[1]
+
+    def test_multi_item_with_records_order_edges(self, tmp_path):
+        """``with self._a, self._b:`` acquires in item order — an
+        inversion against the nested form in another method must still
+        be caught (review finding: sibling items were invisible)."""
+        snap = forge(
+            tmp_path,
+            {
+                "service/x.py": (
+                    "import threading\n"
+                    "class S:\n"
+                    "    def __init__(self):\n"
+                    "        self._a = threading.Lock()\n"
+                    "        self._b = threading.Lock()\n"
+                    "    def fwd(self):\n"
+                    "        with self._a, self._b:\n"
+                    "            pass\n"
+                    "    def rev(self):\n"
+                    "        with self._b:\n"
+                    "            with self._a:\n"
+                    "                pass\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["lock-order"],
+            allowlists={"lock-order": frozenset()},
+        )
+        assert len(live) == 1 and "inversion" in live[0].message
+
+    def test_blocking_negative_outside_lock(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "service/x.py": (
+                    "import threading\n"
+                    "from forged.service.codec import send_frame\n"
+                    "class S:\n"
+                    "    def __init__(self):\n"
+                    "        self._lk = threading.Lock()\n"
+                    "    def push(self, sock, payload):\n"
+                    "        with self._lk:\n"
+                    "            n = len(payload)\n"
+                    "        send_frame(sock, payload)\n"
+                ),
+                "service/codec.py": "def send_frame(s, b):\n    s.sendall(b)\n",
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["lock-blocking"],
+            allowlists={"lock-blocking": frozenset()},
+        )
+        assert not live, [f.render() for f in live]
+
+
+# ---------------------------------------------------------- reachability
+class TestReachability:
+    def test_moved_root_is_a_finding_on_the_real_package_name(
+        self, tmp_path
+    ):
+        """A refactor that moves a byte-compared surface must not
+        silently drop it out of coverage."""
+        snap = forge(
+            tmp_path, {"x.py": "a = 1\n"}, pkg_name="karpenter_tpu"
+        )
+        live, _ = run_rules(
+            snap, rule_names=["determinism-reachability"],
+            allowlists={"determinism-reachability": frozenset()},
+        )
+        assert live and all("no longer resolves" in f.message for f in live)
+
+    def test_injected_clock_path_is_clean(self, tmp_path):
+        """Reading time through an injected clock attribute (the Clock
+        pattern) is exactly what the rule wants to see."""
+        snap = forge(
+            tmp_path,
+            {
+                "sim/trace.py": (
+                    "class TraceWriter:\n"
+                    "    def __init__(self, clock):\n"
+                    "        self.clock = clock\n"
+                    "    def digest(self, tick, env):\n"
+                    "        return {'tick': tick, 'now': self.clock.now()}\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["determinism-reachability"],
+            allowlists={"determinism-reachability": frozenset()},
+        )
+        assert not live, [f.render() for f in live]
+
+    def test_alias_cannot_hide_the_wall_clock(self, tmp_path):
+        """``import time as _time`` (the clock.py idiom) still taints."""
+        snap = forge(
+            tmp_path,
+            {
+                "sim/trace.py": (
+                    "import time as _t\n"
+                    "class TraceWriter:\n"
+                    "    def digest(self, tick, env):\n"
+                    "        return _t.time()\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["determinism-reachability"],
+            allowlists={"determinism-reachability": frozenset()},
+        )
+        assert len(live) == 1 and "wall clock" in live[0].message
+
+    def test_from_import_and_dotted_chain_cannot_hide_the_clock(
+        self, tmp_path
+    ):
+        """``from time import time`` (bare call) and
+        ``datetime.datetime.now()`` (dotted chain) both taint (review
+        finding: only `Name.attr(...)` calls were matched)."""
+        snap = forge(
+            tmp_path,
+            {
+                "sim/trace.py": (
+                    "import datetime\n"
+                    "from time import time\n"
+                    "class TraceWriter:\n"
+                    "    def digest(self, tick, env):\n"
+                    "        return (time(), datetime.datetime.now())\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["determinism-reachability"],
+            allowlists={"determinism-reachability": frozenset()},
+        )
+        msgs = "\n".join(f.message for f in live)
+        assert "wall clock time.time()" in msgs
+        assert "datetime.now()" in msgs
+
+    def test_set_iteration_feeding_root_is_tainted(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "sim/report.py": (
+                    "def build_report(reg):\n"
+                    "    out = []\n"
+                    "    for k in set(reg):\n"
+                    "        out.append(k)\n"
+                    "    return out\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["determinism-reachability"],
+            allowlists={"determinism-reachability": frozenset()},
+        )
+        assert len(live) == 1 and "set(...)" in live[0].message
+
+
+class TestTracerDiscovery:
+    def test_nested_jit_does_not_shadow_module_level_namesake(
+        self, tmp_path
+    ):
+        """A factory-local jit def named like a module-level jit def
+        must not evict the module-level one from coverage (review
+        finding: the nested walk clobbered then popped it)."""
+        snap = forge(
+            tmp_path,
+            {
+                "ops/x.py": (
+                    "import jax\n"
+                    "@jax.jit\n"
+                    "def step(x):\n"
+                    "    print('trace-time')\n"
+                    "    return x\n"
+                    "def factory():\n"
+                    "    def step(y):\n"
+                    "        return y\n"
+                    "    return jax.jit(step)\n"
+                    "def caller(x):\n"
+                    "    return step(x)\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["tracer-safety"],
+            allowlists={"tracer-safety": frozenset()},
+        )
+        msgs = "\n".join(f.message for f in live)
+        # body of the MODULE-LEVEL step still linted...
+        assert "print(...) inside traced body" in msgs
+        # ...and its direct call site still fenced
+        assert "direct call of jit callable step" in msgs
+
+    def test_traced_param_sort_is_not_flagged(self, tmp_path):
+        """``x.sort()`` on a traced parameter is the FUNCTIONAL
+        jax.numpy method (tracers are not ndarrays) — must stay clean
+        (review finding: it was flagged as in-place mutation)."""
+        snap = forge(
+            tmp_path,
+            {
+                "ops/x.py": (
+                    "import jax\n"
+                    "@jax.jit\n"
+                    "def kernel(x):\n"
+                    "    y = x.sort()\n"
+                    "    return y\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["tracer-safety"],
+            allowlists={"tracer-safety": frozenset()},
+        )
+        assert not live, [f.render() for f in live]
+
+
+# -------------------------------------------------------- runtime rules
+class TestRuntimeRules:
+    def test_import_clean_teeth(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "__init__.py": "",
+                "ok.py": "x = 1\n",
+                "broken.py": "import no_such_module_xyzzy\n",
+            },
+            pkg_name="forged_import_teeth",
+        )
+        try:
+            live, _ = run_rules(snap, rule_names=["import-clean"])
+            assert len(live) == 1
+            assert "broken" in live[0].file and "failed to import" in (
+                live[0].message
+            )
+        finally:
+            for name in list(sys.modules):
+                if name.startswith("forged_import_teeth"):
+                    del sys.modules[name]
+
+    def test_annotations_resolve_teeth(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "__init__.py": "",
+                "bad.py": "def f(x: 'Optional[int]' = None):\n    return x\n",
+            },
+            pkg_name="forged_anno_teeth",
+        )
+        try:
+            live, _ = run_rules(snap, rule_names=["annotations-resolve"])
+            assert len(live) == 1
+            assert "unresolvable annotation" in live[0].message
+        finally:
+            for name in list(sys.modules):
+                if name.startswith("forged_anno_teeth"):
+                    del sys.modules[name]
+
+
+# ------------------------------------------- pinned real-fix regressions
+class TestSeamRegressions:
+    """The violations the tracer-safety analyzer surfaced on the real
+    package, fixed and pinned: both jit dispatch sites now count into
+    the device observatory."""
+
+    def test_fetch_bundled_counts_through_the_seam(self):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.obs.device import OBSERVATORY
+        from karpenter_tpu.ops.packer import fetch_bundled
+
+        class Res:
+            take = jnp.zeros((2, 4), jnp.int32)
+            leftover = jnp.zeros(2, jnp.int32)
+            node_cfg = jnp.zeros((2,), jnp.int32)
+            node_used = jnp.zeros((2, 3), jnp.float32)
+            # no `bundle` attribute: forces the on-device bundling path
+
+        scope = OBSERVATORY.begin_scope()
+        try:
+            take, leftover, node_cfg, node_used = fetch_bundled(Res())
+        finally:
+            OBSERVATORY.end_scope(scope)
+        assert scope.dispatches.get("bundle_outputs") == 1
+        assert take.shape == (2, 4) and node_used.shape == (2, 3)
+
+    def test_sidecar_pack_counts_through_the_seam(self):
+        from karpenter_tpu.api import Pod, Resources
+        from karpenter_tpu.obs.device import OBSERVATORY
+        from karpenter_tpu.ops.tensorize import compile_problem
+        from karpenter_tpu.service import RemoteSolver, SolverServer
+        from karpenter_tpu.testing import Environment
+
+        env = Environment()
+        pool = env.default_node_pool()
+        env.default_node_class()
+        types = env.instance_types.list(
+            pool, env.kube.get_node_class("default")
+        )
+        pods = [Pod(requests=Resources(cpu=1, memory="1Gi")) for _ in range(8)]
+        prob = compile_problem(pods, [pool], {pool.name: types})
+        srv = SolverServer(port=0).start_background()
+        scope = OBSERVATORY.begin_scope()
+        try:
+            client = RemoteSolver(*srv.address)
+            client.pack_problem(prob)
+            client.close()
+        finally:
+            OBSERVATORY.end_scope(scope)
+            srv.stop()
+        # the sidecar's kernel dispatch now lands in ITS process
+        # observatory (same process here), wire arrays counted as the
+        # real host->device upload
+        assert scope.dispatches.get("pack_kernel", 0) >= 1
+        assert scope.transfer_bytes.get("pack_kernel", 0) > 0

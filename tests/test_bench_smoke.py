@@ -287,6 +287,12 @@ class TestCompare:
                     "regressed", "status"} <= set(line)
             assert line["status"] in ("compared", "new", "absent")
             assert line["regressed"] is False
+        # the lint verdict rides the same artifact (static-analysis
+        # satellite): clean package, all rules, rendered in the table
+        lint = verdict["lint"]
+        assert lint["ok"] is True and lint["findings"] == 0
+        assert lint["rules"] >= 15 and lint["details"] == []
+        assert "lint" in captured.err and "clean" in captured.err
 
     def test_compare_verdict_flags_regressions(self):
         old = [{"metric": "a_p50", "value": 100.0}]

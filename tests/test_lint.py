@@ -1,982 +1,397 @@
-"""Lint/type gate (round-2 VERDICT hygiene item): no external linter is
-baked into the image, so this enforces the checks that catch real rot:
+"""Lint gate, rebuilt on the static-analysis plane (karpenter_tpu/analysis/,
+docs/designs/static-analysis.md).
 
-1. every module under karpenter_tpu/ imports cleanly,
-2. `typing.get_type_hints` resolves on every public function/method —
-   which fails on annotations referencing names that were never imported
-   (the `Optional`-without-import bug class), and
-3. no direct `time.time()` / `time.sleep()` outside utils/clock.py — the
-   simulator's determinism contract: all time flows through the
-   injectable Clock, so a FakeClock compresses every wait and two equal
-   seeds replay byte-identically (docs/designs/simulation.md).
-4. no `scheduler.update(...)` inside loops in controllers/ — the
-   serial-simulation antipattern batched consolidation removed (each
-   per-candidate update() busts the solver's compile cache and forces a
-   full host compile per subset); the sanctioned call sites are
-   allowlisted by qualified name.
-5. every metric-name literal passed to a registry verb (.inc/.set/
-   .observe/.time/...) appears in docs/metrics.md — the doc-rot guard
-   with teeth: a new series cannot ship without regenerating the
-   reference page (and with it the /metrics HELP/TYPE catalog).
-6. every ledger event-type literal emitted via `Registry.event(...)` /
-   `EventLedger.emit(...)` appears in docs/designs/observability.md —
-   the same teeth for the decision-event taxonomy: SLOBreach,
-   AnomalyDetected, and whatever comes next cannot ship undocumented.
-7. no full-tensorize call (`compile_problem(...)` or
-   `*._compile_tensor(...)`) outside the sanctioned cold-build and
-   rebuild-fallback sites — the warm path's contract is that cluster
-   deltas apply to the device-resident tensors (ops/resident.py) as
-   scatter updates; a new call site re-tensorizing per tick silently
-   reverts the resident win and must be consciously allowlisted.
-8. no call into the sequential consolidation descent (`*._simulate(...)`
-   or `*._consolidate_multi*(...)`) outside the sanctioned sites — the
-   search's contract is that what-ifs flow through the batched
-   population/verdict kernels, with the sequential path reserved for
-   per-element fallbacks and the authoritative re-derivation of winning
-   actions; a new call site quietly walking subsets host-side reverts
-   the search promotion and must be consciously allowlisted.
-9. no raw `jax.device_put(...)` outside the device observatory's counted
-   seam (obs/device.py `DeviceObservatory.put`) — every host->device
-   upload must count into `karpenter_device_transfer_bytes_total{site}`
-   or the transfer accounting silently rots; a new upload site routes
-   through `OBSERVATORY.put(site, ...)` or is consciously allowlisted
-   by (file, qualified name).
-10. every wire frame `method`/`type` literal the store plane sends
-    through service/codec.py must appear (backticked) in
-    docs/designs/store-scale.md — the protocol-vocabulary doc-rot
-    guard: a new RPC method or pushed frame type cannot ship without
-    the design doc saying what it means, which is also what keeps the
-    mixed-version negotiation story reviewable.
+What used to be ~1000 lines of ad-hoc AST walkers is now:
+
+1. ONE parametrized runner: every registered rule runs against the real
+   package through the engine and must report zero non-baselined
+   findings — the machine-checked-invariants gate.
+2. ONE generic teeth harness: for every rule, forge a violation in a
+   synthetic tree and assert the rule fires (and that its allowlist
+   silences it).  This replaces the six copy-pasted per-rule
+   "lint has teeth" test bodies the old suite carried.
+3. The CLI smoke: ``python -m karpenter_tpu lint --json`` over the real
+   package in a fresh process — zero non-baselined findings, stable
+   schema, exit-code contract (0 clean / 1 findings / 2 internal error).
 """
 
-import ast
-import importlib
-import inspect
+from __future__ import annotations
+
+import json
 import pathlib
-import pkgutil
-import re
-import typing
+import subprocess
+import sys
 
-import karpenter_tpu
+import pytest
 
-
-def _modules():
-    for info in pkgutil.walk_packages(
-        karpenter_tpu.__path__, prefix="karpenter_tpu."
-    ):
-        yield info.name
-
-
-def test_all_modules_import():
-    for name in _modules():
-        importlib.import_module(name)
-
-
-def test_annotations_resolve():
-    failures = []
-    for name in _modules():
-        mod = importlib.import_module(name)
-        targets = []
-        for _, obj in vars(mod).items():
-            if inspect.isfunction(obj) and obj.__module__ == name:
-                targets.append(obj)
-            elif inspect.isclass(obj) and obj.__module__ == name:
-                targets.append(obj)
-                for _, m in vars(obj).items():
-                    if inspect.isfunction(m):
-                        targets.append(m)
-        for t in targets:
-            try:
-                typing.get_type_hints(t)
-            except NameError as exc:
-                failures.append(f"{name}.{getattr(t, '__qualname__', t)}: {exc}")
-            except Exception:
-                pass  # forward refs to runtime-only types are fine
-    assert not failures, "\n".join(failures)
-
-
-# the genuinely-wall-clock spots: the Clock abstraction itself is the one
-# place allowed to read the wall.  (time.monotonic/perf_counter remain
-# free — they measure host-side durations like batcher windows and solver
-# phases, which no simulated clock can compress.)
-_WALL_CLOCK_ALLOWLIST = {
-    "karpenter_tpu/utils/clock.py",
-}
-
-_WALL_CLOCK_RE = re.compile(r"\btime\.(?:time|sleep)\s*\(")
-
-
-def test_no_wall_clock_outside_clock_module():
-    """Determinism contract: `time.time()`/`time.sleep()` only inside
-    utils/clock.py (or the explicit allowlist).  Everything else takes an
-    injected Clock, so the cluster simulator can run the whole stack on a
-    FakeClock and replay a seed byte-identically."""
-    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
-    offenders = []
-    for path in sorted(pkg_root.rglob("*.py")):
-        if "__pycache__" in path.parts:
-            continue
-        rel = path.relative_to(pkg_root.parent).as_posix()
-        if rel in _WALL_CLOCK_ALLOWLIST:
-            continue
-        for lineno, line in enumerate(path.read_text().splitlines(), 1):
-            code = line.split("#", 1)[0]
-            if _WALL_CLOCK_RE.search(code):
-                offenders.append(f"{rel}:{lineno}: {line.strip()}")
-    assert not offenders, (
-        "wall-clock calls outside utils/clock.py (route through the "
-        "injected Clock, or allowlist a genuinely-wall-clock spot):\n"
-        + "\n".join(offenders)
-    )
-
-
-# the sanctioned scheduler.update call sites in controllers/: the
-# provisioner's one-per-solve refresh, the deprovisioner's sequential
-# simulation (the explicit fallback the batched path funnels through),
-# and the batched evaluator's once-per-pass full-cluster sync.  Any NEW
-# call site — especially one inside a per-candidate loop — must either
-# go through TensorScheduler.evaluate_removals or be consciously added
-# here.
-_SCHEDULER_UPDATE_ALLOWLIST = {
-    ("karpenter_tpu/controllers/provisioning.py", "Provisioner.provision"),
-    ("karpenter_tpu/controllers/disruption.py",
-     "DisruptionController._simulate"),
-    ("karpenter_tpu/controllers/disruption.py",
-     "_RemovalEvaluator._sync_scheduler"),
-}
-
-
-def scheduler_update_offenders(source: str, rel: str, allowlist):
-    """AST scan for `<...scheduler...>.update(...)` calls: every call
-    site must be allowlisted by (file, qualified name), and the report
-    marks the ones lexically inside a for/while loop — the per-candidate
-    serial-simulation pattern this rule exists to block."""
-    tree = ast.parse(source)
-    offenders = []
-
-    class Visitor(ast.NodeVisitor):
-        def __init__(self):
-            self.scope = []  # class/function name stack
-            self.loops = 0
-
-        def _scoped(self, node, push):
-            self.scope.append(push)
-            self.generic_visit(node)
-            self.scope.pop()
-
-        def visit_ClassDef(self, node):
-            self._scoped(node, node.name)
-
-        def visit_FunctionDef(self, node):
-            self._scoped(node, node.name)
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def _loop(self, node):
-            self.loops += 1
-            self.generic_visit(node)
-            self.loops -= 1
-
-        visit_For = visit_While = visit_AsyncFor = _loop
-
-        def visit_Call(self, node):
-            f = node.func
-            if isinstance(f, ast.Attribute) and f.attr == "update":
-                target = ast.unparse(f.value)
-                if "scheduler" in target.lower():
-                    qual = ".".join(self.scope)
-                    if (rel, qual) not in allowlist:
-                        where = "INSIDE A LOOP" if self.loops else "call"
-                        offenders.append(
-                            f"{rel}:{node.lineno}: {qual or '<module>'}: "
-                            f"{target}.update(...) [{where}]"
-                        )
-            self.generic_visit(node)
-
-    Visitor().visit(tree)
-    return offenders
-
-
-def test_no_scheduler_update_in_candidate_loops():
-    """Serial-simulation guard: scheduler.update() in controllers/ only at
-    the sanctioned sites — a per-candidate update loop re-compiles the
-    whole problem per subset, which is exactly what the batched
-    consolidation path (TensorScheduler.evaluate_removals) exists to
-    avoid (docs/designs/consolidation-batching.md)."""
-    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
-    offenders = []
-    for path in sorted((pkg_root / "controllers").glob("*.py")):
-        rel = path.relative_to(pkg_root.parent).as_posix()
-        offenders += scheduler_update_offenders(
-            path.read_text(), rel, _SCHEDULER_UPDATE_ALLOWLIST
-        )
-    assert not offenders, (
-        "unsanctioned scheduler.update() in controllers/ (batch the "
-        "simulations through TensorScheduler.evaluate_removals, or "
-        "allowlist a genuinely one-shot site):\n" + "\n".join(offenders)
-    )
-
-
-# the registry's recording verbs: a string literal metric name passed to
-# any of these is a published series and must be documented.  (Reading
-# verbs — counter/gauge/histogram/quantile — are deliberately included
-# too: reading an undocumented series is the same rot.)
-_REGISTRY_VERBS = frozenset(
-    {
-        "inc", "set", "observe", "time", "unset", "reset_gauge",
-        "counter", "gauge", "histogram", "quantile",
-    }
+from karpenter_tpu.analysis import (
+    PackageSnapshot,
+    RULES,
+    run_rules,
 )
 
-
-def documented_metric_names() -> set:
-    """Every metric family named in docs/metrics.md (the generated
-    reference page, tools/gen_metrics_doc.py)."""
-    doc = (
-        pathlib.Path(karpenter_tpu.__path__[0]).parent / "docs" / "metrics.md"
-    )
-    return set(re.findall(r"`(karpenter_[a-z0-9_]+)`", doc.read_text()))
+REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
-def metric_doc_offenders(source: str, rel: str, documented: set):
-    """AST scan: every `<anything>.<verb>("karpenter_...", ...)` call
-    whose first argument is a string literal must name a documented
-    metric family.  Dynamic names (f-strings, variables) are out of
-    scope — the doc generator cannot see them either."""
-    tree = ast.parse(source)
-    offenders = []
-    for node in ast.walk(tree):
-        if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in _REGISTRY_VERBS
-            and node.args
-        ):
-            continue
-        first = node.args[0]
-        if not (
-            isinstance(first, ast.Constant)
-            and isinstance(first.value, str)
-            and first.value.startswith("karpenter_")
-        ):
-            continue
-        if first.value not in documented:
-            offenders.append(
-                f"{rel}:{node.lineno}: {first.value!r} passed to "
-                f".{node.func.attr}() but absent from docs/metrics.md"
+@pytest.fixture(scope="module")
+def snap() -> PackageSnapshot:
+    return PackageSnapshot.load()
+
+
+# ------------------------------------------------------------ the gate
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_package_is_clean(snap, rule):
+    """Every rule, zero non-baselined findings on the real package —
+    each guarantee in README's machine-checked-invariants table holds."""
+    live, _suppressed = run_rules(snap, rule_names=[rule])
+    assert not live, "\n".join(f.render() for f in live)
+
+
+# ------------------------------------------------------- teeth harness
+def forge(tmp_path, files: dict, docs: dict = None) -> PackageSnapshot:
+    """Forge a synthetic package tree (and optional repo docs) and
+    snapshot it.  The package dir is named ``forged`` — scope predicates
+    match on package-relative paths, so ``controllers/x.py`` etc. scope
+    exactly like the real tree."""
+    pkg = tmp_path / "forged"
+    for rel, src in files.items():
+        path = pkg / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    for rel, text in (docs or {}).items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(text)
+    return PackageSnapshot(pkg)
+
+
+# One case per rule: (files, docs, expected message substrings, an
+# allowlist that silences every finding).  The harness asserts BOTH
+# directions — fires without the allowlist, silent with it — which is
+# what "teeth" means.
+TEETH = {
+    "wall-clock": dict(
+        files={"controllers/x.py": "import time\nnow = time.time()\n"},
+        expect=["wall-clock call"],
+        silence=frozenset({"forged/controllers/x.py"}),
+    ),
+    "scheduler-update": dict(
+        files={
+            "controllers/x.py": (
+                "class C:\n"
+                "    def scan(self, cands):\n"
+                "        for c in cands:\n"
+                "            s = self._scheduler.update(c)\n"
             )
-    return offenders
-
-
-def test_registry_metric_literals_documented():
-    """Doc-rot guard: a metric literal reaching the registry without a
-    docs/metrics.md entry means someone added a series and skipped
-    `python -m karpenter_tpu.tools.gen_metrics_doc` — which also feeds
-    the /metrics endpoint's HELP/TYPE catalog."""
-    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
-    documented = documented_metric_names()
-    offenders = []
-    for path in sorted(pkg_root.rglob("*.py")):
-        if "__pycache__" in path.parts:
-            continue
-        rel = path.relative_to(pkg_root.parent).as_posix()
-        offenders += metric_doc_offenders(
-            path.read_text(), rel, documented
-        )
-    assert not offenders, (
-        "metric literals not documented (run `python -m "
-        "karpenter_tpu.tools.gen_metrics_doc` to regenerate the page):\n"
-        + "\n".join(offenders)
-    )
-
-
-def test_metric_doc_lint_has_teeth():
-    """The checker actually fires on an undocumented literal and stays
-    quiet on a documented one and on dynamic names."""
-    documented = {"karpenter_known_total"}
-    src = (
-        "def f(reg, name):\n"
-        "    reg.inc('karpenter_known_total')\n"
-        "    reg.observe('karpenter_rogue_seconds', 1.0)\n"
-        "    reg.inc(name)\n"  # dynamic: out of scope
-    )
-    hits = metric_doc_offenders(src, "karpenter_tpu/x.py", documented)
-    assert len(hits) == 1 and "karpenter_rogue_seconds" in hits[0], hits
-
-
-# rule 6: the event-emission verbs.  `Registry.event` and
-# `EventLedger.emit` both take the event TYPE as their first positional
-# argument; a CamelCase string literal there is a published ledger event
-# and must be documented in the observability design's taxonomy.
-_EVENT_VERBS = frozenset({"event", "emit"})
-
-# event types are CamelCase identifiers (PodNominated, SLOBreach); the
-# shape filter keeps unrelated `.event(tick, ...)` / `.emit(...)` call
-# sites (ints, lowercase kinds) out of scope
-_EVENT_TYPE_RE = re.compile(r"[A-Z][A-Za-z0-9]*")
-
-
-def documented_event_types() -> set:
-    """Every backticked CamelCase identifier in the observability design
-    doc — a superset of the event taxonomy (class names in backticks are
-    harmless extras; the lint only needs emitted literals ⊆ this set)."""
-    doc = (
-        pathlib.Path(karpenter_tpu.__path__[0]).parent
-        / "docs" / "designs" / "observability.md"
-    )
-    return set(re.findall(r"`([A-Z][A-Za-z0-9]*)`", doc.read_text()))
-
-
-def event_doc_offenders(source: str, rel: str, documented: set):
-    """AST scan: every `<anything>.event("CamelCase", ...)` /
-    `<anything>.emit("CamelCase", ...)` call must name a documented event
-    type.  Dynamic types (variables, f-strings) are out of scope — the
-    doc cannot enumerate them either."""
-    tree = ast.parse(source)
-    offenders = []
-    for node in ast.walk(tree):
-        if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in _EVENT_VERBS
-            and node.args
-        ):
-            continue
-        first = node.args[0]
-        if not (
-            isinstance(first, ast.Constant)
-            and isinstance(first.value, str)
-            and _EVENT_TYPE_RE.fullmatch(first.value)
-        ):
-            continue
-        if first.value not in documented:
-            offenders.append(
-                f"{rel}:{node.lineno}: event type {first.value!r} passed to "
-                f".{node.func.attr}() but absent from "
-                "docs/designs/observability.md"
+        },
+        expect=["INSIDE A LOOP", "C.scan"],
+        silence=frozenset({("forged/controllers/x.py", "C.scan")}),
+    ),
+    "metric-doc": dict(
+        files={
+            "x.py": (
+                "def f(reg, name):\n"
+                "    reg.inc('karpenter_known_total')\n"
+                "    reg.observe('karpenter_rogue_seconds', 1.0)\n"
+                "    reg.inc(name)\n"  # dynamic: out of scope
             )
-    return offenders
-
-
-def test_ledger_event_literals_documented():
-    """Doc-rot guard for the event taxonomy: an event-type literal
-    reaching the ledger without a docs/designs/observability.md entry
-    means someone added a decision event and skipped documenting what it
-    means and where it fires."""
-    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
-    documented = documented_event_types()
-    offenders = []
-    for path in sorted(pkg_root.rglob("*.py")):
-        if "__pycache__" in path.parts:
-            continue
-        rel = path.relative_to(pkg_root.parent).as_posix()
-        offenders += event_doc_offenders(path.read_text(), rel, documented)
-    assert not offenders, (
-        "ledger event types not documented (add them to the taxonomy in "
-        "docs/designs/observability.md):\n" + "\n".join(offenders)
-    )
-
-
-def test_event_doc_lint_has_teeth():
-    """The checker fires on an undocumented CamelCase literal, stays
-    quiet on documented ones, dynamic types, and non-event `.event()`
-    overloads (the trace writer's `.event(tick, kind, data)`)."""
-    documented = {"NodeLaunched"}
-    src = (
-        "def f(reg, trace, t):\n"
-        "    reg.event('NodeLaunched', claim='x')\n"
-        "    reg.event('RogueEvent', oops=1)\n"
-        "    reg.ledger.emit('AnotherRogue')\n"
-        "    reg.event(t)\n"  # dynamic: out of scope
-        "    trace.event(3, 'pod_create', {})\n"  # int + lowercase kind
-    )
-    hits = event_doc_offenders(src, "karpenter_tpu/x.py", documented)
-    assert len(hits) == 2, hits
-    assert "RogueEvent" in hits[0] and "AnotherRogue" in hits[1], hits
-
-
-# rule 7: the sanctioned full-tensorize call sites.  The warm path's
-# contract is that cluster deltas reach the device tensors as scatter
-# updates (ops/resident.py); a full `compile_problem` / `_compile_tensor`
-# belongs only at the cold build and the rebuild fallbacks the delta
-# planner deliberately takes (catalog roll, shape change, churn past the
-# midpoint).  Any NEW call site — especially in controllers/ or a solver
-# warm path — must be consciously added here.
-_FULL_TENSORIZE_ALLOWLIST = {
-    # the wrapper itself: catalog bookkeeping + the one compile_problem
-    ("karpenter_tpu/scheduling/solver.py",
-     "TensorScheduler._compile_tensor"),
-    # cold build / resident-miss rebuild in the solve path
-    ("karpenter_tpu/scheduling/solver.py", "TensorScheduler._solve"),
-    # direct compile+pack+decode kept for tests and custom callers
-    ("karpenter_tpu/scheduling/solver.py",
-     "TensorScheduler._solve_tensor"),
-    # consolidation base: rebuild fallback when the resident layer misses
-    ("karpenter_tpu/scheduling/solver.py",
-     "TensorScheduler._build_removal_base"),
+        },
+        docs={"docs/metrics.md": "`karpenter_known_total`\n"},
+        expect=["karpenter_rogue_seconds"],
+        silence=frozenset({"karpenter_rogue_seconds"}),
+    ),
+    "event-doc": dict(
+        files={
+            "x.py": (
+                "def f(reg, trace, t):\n"
+                "    reg.event('NodeLaunched', claim='x')\n"
+                "    reg.event('RogueEvent', oops=1)\n"
+                "    reg.ledger.emit('AnotherRogue')\n"
+                "    reg.event(t)\n"  # dynamic: out of scope
+                "    trace.event(3, 'pod_create', {})\n"  # int + lowercase
+            )
+        },
+        docs={"docs/designs/observability.md": "`NodeLaunched`\n"},
+        expect=["RogueEvent", "AnotherRogue"],
+        silence=frozenset({"RogueEvent", "AnotherRogue"}),
+    ),
+    "full-tensorize": dict(
+        files={
+            "scheduling/x.py": (
+                "class S:\n"
+                "    def warm(self, pods):\n"
+                "        for batch in pods:\n"
+                "            p = self._compile_tensor(batch, [])\n"
+                "    def cold(self, pods):\n"
+                "        return compile_problem(pods, [], {})\n"
+            )
+        },
+        expect=["INSIDE A LOOP", "S.warm", "S.cold"],
+        silence=frozenset(
+            {
+                ("forged/scheduling/x.py", "S.warm"),
+                ("forged/scheduling/x.py", "S.cold"),
+            }
+        ),
+    ),
+    "sequential-descent": dict(
+        files={
+            "controllers/x.py": (
+                "class C:\n"
+                "    def scan(self, cands):\n"
+                "        for c in cands:\n"
+                "            fits, price, vn = self._simulate([c])\n"
+                "    def multi(self, ranked):\n"
+                "        return self._consolidate_multi_descent(ranked, None)\n"
+            )
+        },
+        expect=["INSIDE A LOOP", "C.scan", "_consolidate_multi_descent"],
+        silence=frozenset(
+            {
+                ("forged/controllers/x.py", "C.scan"),
+                ("forged/controllers/x.py", "C.multi"),
+            }
+        ),
+    ),
+    "device-put": dict(
+        files={
+            "ops/x.py": (
+                "class U:\n"
+                "    def upload(self, arrays):\n"
+                "        for a in arrays:\n"
+                "            d = jax.device_put(a)\n"
+                "    def pin(self, a):\n"
+                "        return device_put(a)\n"
+            )
+        },
+        expect=["INSIDE A LOOP", "U.upload", "U.pin"],
+        silence=frozenset(
+            {
+                ("forged/ops/x.py", "U.upload"),
+                ("forged/ops/x.py", "U.pin"),
+            }
+        ),
+    ),
+    "store-frame": dict(
+        files={
+            "state/remote.py": (
+                "def f(m):\n"
+                "    a = {'method': 'put', 'kind': 'Pod'}\n"
+                "    b = {'type': 'events', 'seq': 1}\n"
+                "    c = {'method': 'rogue_rpc'}\n"
+                "    d = {'type': 'rogue_frame'}\n"
+                "    e = {'method': m}\n"  # dynamic: out of scope
+            )
+        },
+        docs={"docs/designs/store-scale.md": "`put` `events`\n"},
+        expect=["rogue_rpc", "rogue_frame"],
+        silence=frozenset({"rogue_rpc", "rogue_frame"}),
+    ),
+    "thread-seam": dict(
+        files={
+            "controllers/x.py": (
+                "import threading\n"
+                "from concurrent.futures import ThreadPoolExecutor\n"
+                "class C:\n"
+                "    def fan(self, fns):\n"
+                "        self._lock = threading.Lock()\n"  # sync prims free
+                "    def spawn(self, fns):\n"
+                "        t = threading.Thread(target=fns[0])\n"
+                "    def pool(self, fns):\n"
+                "        with ThreadPoolExecutor(max_workers=4) as p:\n"
+                "            pass\n"
+            )
+        },
+        expect=["C.spawn", "Thread", "C.pool"],
+        silence=frozenset(
+            {
+                ("forged/controllers/x.py", "C.spawn"),
+                ("forged/controllers/x.py", "C.pool"),
+            }
+        ),
+    ),
+    # ---- the three whole-program analyzers (acceptance teeth)
+    "lock-blocking": dict(
+        files={
+            "service/x.py": (
+                "import threading\n"
+                "from forged.service.codec import send_frame\n"
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def push(self, sock, payload):\n"
+                "        with self._lock:\n"
+                "            self._emit(sock, payload)\n"
+                "    def _emit(self, sock, payload):\n"
+                "        send_frame(sock, payload)\n"
+            ),
+            "service/codec.py": "def send_frame(sock, b):\n    sock.sendall(b)\n",
+        },
+        expect=["send_frame", "S._lock", "S.push"],
+        silence=frozenset({("forged/service/x.py", "S.push")}),
+    ),
+    "lock-order": dict(
+        # the forged inversion: A->B in one method, B->A in another
+        files={
+            "service/x.py": (
+                "import threading\n"
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._a = threading.Lock()\n"
+                "        self._b = threading.Lock()\n"
+                "    def forward(self):\n"
+                "        with self._a:\n"
+                "            with self._b:\n"
+                "                pass\n"
+                "    def backward(self):\n"
+                "        with self._b:\n"
+                "            with self._a:\n"
+                "                pass\n"
+            )
+        },
+        expect=["lock order inversion", "S._a", "S._b"],
+        silence=frozenset({"S._a|S._b"}),
+    ),
+    "determinism-reachability": dict(
+        # the forged clock-reachable digest path: digest -> helper ->
+        # time.time(), two hops from the byte-compared root
+        files={
+            "sim/trace.py": (
+                "import time\n"
+                "def stamp():\n"
+                "    return time.time()\n"
+                "class TraceWriter:\n"
+                "    def digest(self, tick, env):\n"
+                "        return {'tick': tick, 'at': stamp()}\n"
+            )
+        },
+        expect=["wall clock time.time()", "stamp", "TraceWriter.digest"],
+        silence=frozenset({"forged/sim/trace.py"}),
+    ),
+    "tracer-safety": dict(
+        # the forged unseamed jit dispatch + an impure traced body
+        files={
+            "ops/x.py": (
+                "import jax\n"
+                "import time\n"
+                "@jax.jit\n"
+                "def kernel(x):\n"
+                "    print('tracing')\n"
+                "    t = time.time()\n"
+                "    x[0] = t\n"
+                "    return x\n"
+                "def solve(x):\n"
+                "    return kernel(x)\n"
+            )
+        },
+        expect=[
+            "print(...) inside traced body",
+            "time.time(...) inside traced body",
+            "assigns into a traced parameter",
+            "bypasses the counted seam",
+        ],
+        silence=None,  # impure bodies have no allowlist: only the call
+        # site is allowlistable (checked separately below)
+    ),
 }
 
-_FULL_TENSORIZE_NAMES = frozenset({"compile_problem", "_compile_tensor"})
+
+@pytest.mark.parametrize("rule", sorted(TEETH))
+def test_rule_has_teeth(tmp_path, rule):
+    """Generic forge-a-violation harness: the rule fires on a synthetic
+    violation and its allowlist silences it — one body for all rules,
+    replacing the six duplicated per-rule copies."""
+    case = TEETH[rule]
+    forged = forge(tmp_path, case["files"], case.get("docs"))
+    live, _ = run_rules(
+        forged, rule_names=[rule], allowlists={rule: frozenset()}
+    )
+    text = "\n".join(f.render() for f in live)
+    assert live, f"{rule}: forged violation did not fire"
+    for fragment in case["expect"]:
+        assert fragment in text, f"{rule}: {fragment!r} not in:\n{text}"
+    if case["silence"] is None:
+        return
+    silenced, _ = run_rules(
+        forged, rule_names=[rule], allowlists={rule: case["silence"]}
+    )
+    assert not silenced, "\n".join(f.render() for f in silenced)
 
 
-def full_tensorize_offenders(source: str, rel: str, allowlist):
-    """AST scan for full-tensorize calls: `compile_problem(...)` (bare or
-    attribute) and `<anything>._compile_tensor(...)`.  Every call site
-    must be allowlisted by (file, qualified name); hits lexically inside
-    a for/while loop — the per-candidate re-tensorize antipattern — are
-    called out."""
-    tree = ast.parse(source)
-    offenders = []
-
-    class Visitor(ast.NodeVisitor):
-        def __init__(self):
-            self.scope = []
-            self.loops = 0
-
-        def _scoped(self, node, push):
-            self.scope.append(push)
-            self.generic_visit(node)
-            self.scope.pop()
-
-        def visit_ClassDef(self, node):
-            self._scoped(node, node.name)
-
-        def visit_FunctionDef(self, node):
-            self._scoped(node, node.name)
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def _loop(self, node):
-            self.loops += 1
-            self.generic_visit(node)
-            self.loops -= 1
-
-        visit_For = visit_While = visit_AsyncFor = _loop
-
-        def visit_Call(self, node):
-            f = node.func
-            name = (
-                f.id if isinstance(f, ast.Name)
-                else f.attr if isinstance(f, ast.Attribute)
-                else None
+def test_tracer_safety_call_site_allowlist(tmp_path):
+    """The seam-dispatch half of tracer-safety is allowlistable by
+    (file, qualname) — the impure-body half deliberately is not."""
+    forged = forge(
+        tmp_path,
+        {
+            "ops/x.py": (
+                "import jax\n"
+                "@jax.jit\n"
+                "def kernel(x):\n"
+                "    return x\n"
+                "def solve(x):\n"
+                "    return kernel(x)\n"
             )
-            if name in _FULL_TENSORIZE_NAMES:
-                qual = ".".join(self.scope)
-                if (rel, qual) not in allowlist:
-                    where = "INSIDE A LOOP" if self.loops else "call"
-                    offenders.append(
-                        f"{rel}:{node.lineno}: {qual or '<module>'}: "
-                        f"{name}(...) [{where}]"
-                    )
-            self.generic_visit(node)
-
-    Visitor().visit(tree)
-    return offenders
-
-
-def test_no_full_tensorize_outside_sanctioned_sites():
-    """Resident-tensor guard: a full tensorize in controllers/ or
-    scheduling/ only at the sanctioned cold-build/rebuild-fallback sites
-    — warm ticks must flow through the delta path (ops/resident.py,
-    docs/designs/resident-tensors.md)."""
-    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
-    offenders = []
-    for sub in ("controllers", "scheduling"):
-        for path in sorted((pkg_root / sub).glob("*.py")):
-            rel = path.relative_to(pkg_root.parent).as_posix()
-            offenders += full_tensorize_offenders(
-                path.read_text(), rel, _FULL_TENSORIZE_ALLOWLIST
-            )
-    assert not offenders, (
-        "unsanctioned full-tensorize call (route warm updates through "
-        "the resident delta path, or consciously allowlist a "
-        "cold-build/rebuild site):\n" + "\n".join(offenders)
+        },
     )
-
-
-def test_full_tensorize_lint_has_teeth():
-    """The checker fires on bare and attribute call forms (tagging
-    in-loop hits), and stays quiet on allowlisted sites."""
-    bad = (
-        "class S:\n"
-        "    def warm(self, pods):\n"
-        "        for batch in pods:\n"
-        "            p = self._compile_tensor(batch, [])\n"
-        "    def cold(self, pods):\n"
-        "        return compile_problem(pods, [], {})\n"
+    live, _ = run_rules(
+        forged, rule_names=["tracer-safety"],
+        allowlists={"tracer-safety": frozenset()},
     )
-    hits = full_tensorize_offenders(
-        bad, "karpenter_tpu/scheduling/x.py", _FULL_TENSORIZE_ALLOWLIST
+    assert len(live) == 1 and "bypasses the counted seam" in live[0].message
+    silenced, _ = run_rules(
+        forged, rule_names=["tracer-safety"],
+        allowlists={"tracer-safety": frozenset({("forged/ops/x.py", "solve")})},
     )
-    assert len(hits) == 2, hits
-    assert "INSIDE A LOOP" in hits[0] and "S.warm" in hits[0], hits
-    assert "S.cold" in hits[1], hits
-    ok = full_tensorize_offenders(
-        bad, "karpenter_tpu/scheduling/x.py",
-        {("karpenter_tpu/scheduling/x.py", "S.warm"),
-         ("karpenter_tpu/scheduling/x.py", "S.cold")},
+    assert not silenced
+
+
+def test_baseline_suppresses_by_fingerprint(tmp_path):
+    """A baselined fingerprint moves the finding to the suppressed list
+    without deleting the signal; unrelated findings stay live."""
+    forged = forge(
+        tmp_path, {"x.py": "import time\na = time.time()\nb = time.sleep(1)\n"}
     )
-    assert not ok, ok
-
-
-# rule 8: the sanctioned sequential-descent call sites.  `_simulate` is
-# the per-subset solver round-trip the batched kernels replaced; the
-# population search may reach it only through the evaluator's lazy
-# per-element fallback (`result`) and the winner's authoritative
-# re-derivation (`vnode_for`), and the legacy drop-one descent
-# (`_consolidate_multi_descent`) stays reachable only behind
-# `use_population_search = False` via `_consolidate_multi`.  Any NEW
-# call site — especially a loop of per-subset simulations — bypasses the
-# batched search and must be consciously added here.
-_SEQUENTIAL_DESCENT_ALLOWLIST = {
-    # lazy per-element fallback: the one sanctioned batched->sequential seam
-    ("karpenter_tpu/controllers/disruption.py", "_RemovalEvaluator.result"),
-    # authoritative re-derivation of every winning action
-    ("karpenter_tpu/controllers/disruption.py",
-     "_RemovalEvaluator.vnode_for"),
-    # the consolidation pass entry points (multi -> descent fallback)
-    ("karpenter_tpu/controllers/disruption.py",
-     "DisruptionController._consolidate"),
-    ("karpenter_tpu/controllers/disruption.py",
-     "DisruptionController._consolidate_multi"),
-}
-
-_SEQUENTIAL_DESCENT_NAMES = frozenset(
-    {"_simulate", "_consolidate_multi", "_consolidate_multi_descent"}
-)
-
-
-def sequential_descent_offenders(source: str, rel: str, allowlist):
-    """AST scan for sequential-descent calls: `<anything>._simulate(...)`
-    and `<anything>._consolidate_multi[_descent](...)`.  Every call site
-    must be allowlisted by (file, qualified name); hits lexically inside
-    a for/while loop — the per-subset serial-walk antipattern — are
-    called out."""
-    tree = ast.parse(source)
-    offenders = []
-
-    class Visitor(ast.NodeVisitor):
-        def __init__(self):
-            self.scope = []
-            self.loops = 0
-
-        def _scoped(self, node, push):
-            self.scope.append(push)
-            self.generic_visit(node)
-            self.scope.pop()
-
-        def visit_ClassDef(self, node):
-            self._scoped(node, node.name)
-
-        def visit_FunctionDef(self, node):
-            self._scoped(node, node.name)
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def _loop(self, node):
-            self.loops += 1
-            self.generic_visit(node)
-            self.loops -= 1
-
-        visit_For = visit_While = visit_AsyncFor = _loop
-
-        def visit_Call(self, node):
-            f = node.func
-            name = (
-                f.id if isinstance(f, ast.Name)
-                else f.attr if isinstance(f, ast.Attribute)
-                else None
-            )
-            if name in _SEQUENTIAL_DESCENT_NAMES:
-                qual = ".".join(self.scope)
-                if (rel, qual) not in allowlist:
-                    where = "INSIDE A LOOP" if self.loops else "call"
-                    offenders.append(
-                        f"{rel}:{node.lineno}: {qual or '<module>'}: "
-                        f"{name}(...) [{where}]"
-                    )
-            self.generic_visit(node)
-
-    Visitor().visit(tree)
-    return offenders
-
-
-def test_no_sequential_descent_outside_sanctioned_sites():
-    """Batched-search guard: the sequential `_simulate` / descent is
-    reachable only from the allowlisted fallback and re-derivation sites
-    — what-if evaluations must flow through the population/verdict
-    kernels (docs/designs/consolidation-search.md fallback conditions),
-    so a future code path cannot quietly walk subsets host-side."""
-    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
-    offenders = []
-    for path in sorted(pkg_root.rglob("*.py")):
-        if "__pycache__" in path.parts:
-            continue
-        rel = path.relative_to(pkg_root.parent).as_posix()
-        offenders += sequential_descent_offenders(
-            path.read_text(), rel, _SEQUENTIAL_DESCENT_ALLOWLIST
-        )
-    assert not offenders, (
-        "unsanctioned sequential-descent call (batch the what-ifs "
-        "through evaluate_population/evaluate_removals, or consciously "
-        "allowlist a fallback/re-derivation site):\n" + "\n".join(offenders)
+    live, _ = run_rules(
+        forged, rule_names=["wall-clock"], allowlists={"wall-clock": frozenset()}
     )
-
-
-def test_sequential_descent_lint_has_teeth():
-    """The checker fires on `_simulate` and descent calls (tagging
-    in-loop hits), and stays quiet on allowlisted sites."""
-    bad = (
-        "class C:\n"
-        "    def scan(self, cands):\n"
-        "        for c in cands:\n"
-        "            fits, price, vn = self._simulate([c])\n"
-        "    def multi(self, ranked):\n"
-        "        return self._consolidate_multi_descent(ranked, None)\n"
+    assert len(live) == 2
+    baseline = {live[0].fingerprint: "known"}
+    live2, suppressed = run_rules(
+        forged, rule_names=["wall-clock"],
+        allowlists={"wall-clock": frozenset()}, baseline=baseline,
     )
-    hits = sequential_descent_offenders(
-        bad, "karpenter_tpu/controllers/x.py", _SEQUENTIAL_DESCENT_ALLOWLIST
+    assert len(live2) == 1 and len(suppressed) == 1
+    assert suppressed[0].fingerprint == live[0].fingerprint
+
+
+# ------------------------------------------------------------ CLI smoke
+def test_cli_smoke_real_package_is_clean():
+    """Tier-1 smoke (the CI gate): ``python -m karpenter_tpu lint
+    --json`` over the real package in a fresh process reports zero
+    non-baselined findings and exits 0."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "karpenter_tpu", "lint", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/local/bin:/usr/bin:/bin"},
     )
-    assert len(hits) == 2, hits
-    assert "INSIDE A LOOP" in hits[0] and "C.scan" in hits[0], hits
-    assert "_consolidate_multi_descent" in hits[1] and "C.multi" in hits[1]
-    ok = sequential_descent_offenders(
-        bad, "karpenter_tpu/controllers/x.py",
-        {("karpenter_tpu/controllers/x.py", "C.scan"),
-         ("karpenter_tpu/controllers/x.py", "C.multi")},
-    )
-    assert not ok, ok
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["version"] == 1
+    assert report["counts"]["findings"] == 0, report["findings"]
+    assert report["findings"] == []
+    # every registered rule ran
+    assert report["rules"] == sorted(RULES)
 
 
-# rule 9: the counted-upload seam.  Transfer accounting
-# (karpenter_device_transfer_bytes_total{site}) is only as complete as
-# its coverage: every EXPLICIT host->device upload must route through
-# `DeviceObservatory.put` (obs/device.py), which is therefore the one
-# sanctioned raw `device_put` call site.  Implicit uploads (numpy
-# arguments to jit calls) are counted at the dispatch seam and need no
-# allowlisting.  Any NEW raw device_put — a fresh cache, a pinned
-# tensor — must either take the seam or be consciously added here.
-_DEVICE_PUT_ALLOWLIST = {
-    ("karpenter_tpu/obs/device.py", "DeviceObservatory.put"),
-}
+def test_cli_exit_codes(tmp_path):
+    """0 clean / 1 findings / 2 internal error, and --rule filtering."""
+    from karpenter_tpu.analysis.cli import main as lint_main
 
-_DEVICE_PUT_NAMES = frozenset({"device_put"})
-
-
-def device_put_offenders(source: str, rel: str, allowlist):
-    """AST scan for `jax.device_put(...)` / `<alias>.device_put(...)` /
-    bare `device_put(...)` calls: every call site must be allowlisted by
-    (file, qualified name); hits lexically inside a for/while loop — a
-    per-item upload loop on the hot path — are called out."""
-    tree = ast.parse(source)
-    offenders = []
-
-    class Visitor(ast.NodeVisitor):
-        def __init__(self):
-            self.scope = []
-            self.loops = 0
-
-        def _scoped(self, node, push):
-            self.scope.append(push)
-            self.generic_visit(node)
-            self.scope.pop()
-
-        def visit_ClassDef(self, node):
-            self._scoped(node, node.name)
-
-        def visit_FunctionDef(self, node):
-            self._scoped(node, node.name)
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def _loop(self, node):
-            self.loops += 1
-            self.generic_visit(node)
-            self.loops -= 1
-
-        visit_For = visit_While = visit_AsyncFor = _loop
-
-        def visit_Call(self, node):
-            f = node.func
-            name = (
-                f.id if isinstance(f, ast.Name)
-                else f.attr if isinstance(f, ast.Attribute)
-                else None
-            )
-            if name in _DEVICE_PUT_NAMES:
-                qual = ".".join(self.scope)
-                if (rel, qual) not in allowlist:
-                    where = "INSIDE A LOOP" if self.loops else "call"
-                    offenders.append(
-                        f"{rel}:{node.lineno}: {qual or '<module>'}: "
-                        f"{name}(...) [{where}]"
-                    )
-            self.generic_visit(node)
-
-    Visitor().visit(tree)
-    return offenders
-
-
-def test_no_raw_device_put_outside_counted_seam():
-    """Transfer-accounting guard: raw device_put only inside the counted
-    seam (obs/device.py DeviceObservatory.put) — an upload that bypasses
-    it vanishes from karpenter_device_transfer_bytes_total{site}, and
-    the bench's transfer columns and the doctor's transfer-regression
-    rule quietly under-count."""
-    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
-    offenders = []
-    for path in sorted(pkg_root.rglob("*.py")):
-        if "__pycache__" in path.parts:
-            continue
-        rel = path.relative_to(pkg_root.parent).as_posix()
-        offenders += device_put_offenders(
-            path.read_text(), rel, _DEVICE_PUT_ALLOWLIST
-        )
-    assert not offenders, (
-        "raw device_put outside the counted seam (route the upload "
-        "through OBSERVATORY.put(site, ...), or consciously allowlist "
-        "it):\n" + "\n".join(offenders)
-    )
-
-
-def test_device_put_lint_has_teeth():
-    """The checker fires on attribute and bare call forms (tagging
-    in-loop hits), and stays quiet on allowlisted sites."""
-    bad = (
-        "class U:\n"
-        "    def upload(self, arrays):\n"
-        "        for a in arrays:\n"
-        "            d = jax.device_put(a)\n"
-        "    def pin(self, a):\n"
-        "        return device_put(a)\n"
-    )
-    hits = device_put_offenders(
-        bad, "karpenter_tpu/ops/x.py", _DEVICE_PUT_ALLOWLIST
-    )
-    assert len(hits) == 2, hits
-    assert "INSIDE A LOOP" in hits[0] and "U.upload" in hits[0], hits
-    assert "U.pin" in hits[1], hits
-    ok = device_put_offenders(
-        bad, "karpenter_tpu/ops/x.py",
-        {("karpenter_tpu/ops/x.py", "U.upload"),
-         ("karpenter_tpu/ops/x.py", "U.pin")},
-    )
-    assert not ok, ok
-
-
-def test_scheduler_update_lint_has_teeth():
-    """The checker actually fires: a synthetic per-candidate update loop
-    is flagged (and tagged as in-loop), an allowlisted site is not."""
-    bad = (
-        "class C:\n"
-        "    def scan(self, cands):\n"
-        "        for c in cands:\n"
-        "            s = self._scheduler.update(c)\n"
-    )
-    hits = scheduler_update_offenders(
-        bad, "karpenter_tpu/controllers/x.py", _SCHEDULER_UPDATE_ALLOWLIST
-    )
-    assert len(hits) == 1 and "INSIDE A LOOP" in hits[0], hits
-    ok = scheduler_update_offenders(
-        bad, "karpenter_tpu/controllers/x.py",
-        {("karpenter_tpu/controllers/x.py", "C.scan")},
-    )
-    assert not ok, ok
-
-
-# rule 10: the store plane's wire vocabulary.  A dict literal with a
-# "method" or "type" key and a string-literal value, in a store-plane
-# file, IS a wire frame construction site — the literal is part of the
-# protocol and must be documented in the store design doc's frame
-# vocabulary.  (Receiving-side comparisons reuse the same literals, so
-# guarding construction sites covers the vocabulary.)
-_STORE_FRAME_FILES = (
-    "karpenter_tpu/service/store_server.py",
-    "karpenter_tpu/state/remote.py",
-)
-
-_STORE_FRAME_KEYS = frozenset({"method", "type"})
-
-
-def documented_store_frame_literals() -> set:
-    """Every backticked lowercase token in the store design doc — a
-    superset of the frame vocabulary (prose backticks are harmless
-    extras; the lint only needs sent literals ⊆ this set)."""
-    doc = (
-        pathlib.Path(karpenter_tpu.__path__[0]).parent
-        / "docs" / "designs" / "store-scale.md"
-    )
-    return set(re.findall(r"`([a-z][a-z0-9_]*)`", doc.read_text()))
-
-
-def store_frame_offenders(source: str, rel: str, documented: set):
-    """AST scan: every dict literal carrying a ``"method"``/``"type"``
-    key with a string-literal value must name a documented frame
-    method/type.  Dynamic values (variables, f-strings) are out of
-    scope — the doc cannot enumerate them either."""
-    tree = ast.parse(source)
-    offenders = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Dict):
-            continue
-        for key, value in zip(node.keys, node.values):
-            if not (
-                isinstance(key, ast.Constant)
-                and key.value in _STORE_FRAME_KEYS
-                and isinstance(value, ast.Constant)
-                and isinstance(value.value, str)
-            ):
-                continue
-            if value.value not in documented:
-                offenders.append(
-                    f"{rel}:{value.lineno}: frame {key.value} literal "
-                    f"{value.value!r} absent from "
-                    "docs/designs/store-scale.md"
-                )
-    return offenders
-
-
-def test_store_frame_literals_documented():
-    """Doc-rot guard for the store protocol: a frame method/type literal
-    sent through service/codec.py without a docs/designs/store-scale.md
-    entry means someone grew the wire vocabulary and skipped documenting
-    it."""
-    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
-    documented = documented_store_frame_literals()
-    offenders = []
-    for rel in _STORE_FRAME_FILES:
-        path = pkg_root.parent / rel
-        offenders += store_frame_offenders(path.read_text(), rel, documented)
-    assert not offenders, (
-        "store wire frame literals not documented (add them to the "
-        "frame vocabulary in docs/designs/store-scale.md):\n"
-        + "\n".join(offenders)
-    )
-
-
-def test_store_frame_lint_has_teeth():
-    """The checker fires on undocumented method AND type literals, and
-    stays quiet on documented ones and dynamic values."""
-    documented = {"put", "events"}
-    src = (
-        "def f(m):\n"
-        "    a = {'method': 'put', 'kind': 'Pod'}\n"
-        "    b = {'type': 'events', 'seq': 1}\n"
-        "    c = {'method': 'rogue_rpc'}\n"
-        "    d = {'type': 'rogue_frame'}\n"
-        "    e = {'method': m}\n"  # dynamic: out of scope
-    )
-    hits = store_frame_offenders(src, "karpenter_tpu/x.py", documented)
-    assert len(hits) == 2, hits
-    assert "rogue_rpc" in hits[0] and "rogue_frame" in hits[1], hits
-
-# rule 11: thread construction in the controller layer is fenced to the
-# pipeline seam.  The pipelined reconcile's determinism story depends on
-# ALL controller-layer concurrency flowing through pipeline.py — the
-# run_concurrently fan-out (degrades to serial in-order under the sim's
-# workers=1 knobs) and the operator's declared dispatch/advance stages.
-# A raw ThreadPoolExecutor/Thread in controllers/ or operator.py is an
-# unscheduled side channel the twin-run and byte-identity proofs cannot
-# see; any genuinely new need must be consciously allowlisted here.
-_THREAD_SEAM_ALLOWLIST = {
-    # the seam itself: the one sanctioned pool constructor
-    ("karpenter_tpu/pipeline.py", "run_concurrently"),
-}
-
-_THREAD_CTOR_NAMES = frozenset({"Thread", "ThreadPoolExecutor"})
-
-
-def thread_ctor_offenders(source: str, rel: str, allowlist):
-    """AST scan for thread construction: ``Thread(...)`` /
-    ``ThreadPoolExecutor(...)`` bare or as an attribute
-    (``threading.Thread``, ``futures.ThreadPoolExecutor``).  Every call
-    site must be allowlisted by (file, qualified name).  Locks /
-    Events / Conditions stay free — the rule fences execution contexts,
-    not synchronization primitives."""
-    tree = ast.parse(source)
-    offenders = []
-
-    class Visitor(ast.NodeVisitor):
-        def __init__(self):
-            self.scope = []
-
-        def _scoped(self, node, push):
-            self.scope.append(push)
-            self.generic_visit(node)
-            self.scope.pop()
-
-        def visit_ClassDef(self, node):
-            self._scoped(node, node.name)
-
-        def visit_FunctionDef(self, node):
-            self._scoped(node, node.name)
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def visit_Call(self, node):
-            f = node.func
-            name = (
-                f.id if isinstance(f, ast.Name)
-                else f.attr if isinstance(f, ast.Attribute)
-                else None
-            )
-            if name in _THREAD_CTOR_NAMES:
-                qual = ".".join(self.scope)
-                if (rel, qual) not in allowlist:
-                    offenders.append(
-                        f"{rel}:{node.lineno}: {qual or '<module>'}: "
-                        f"{name}(...)"
-                    )
-            self.generic_visit(node)
-
-    Visitor().visit(tree)
-    return offenders
-
-
-def test_no_raw_threads_outside_pipeline_seam():
-    """Controller-layer concurrency flows through pipeline.py only: a
-    raw thread in controllers/ or operator.py bypasses the pipelined
-    schedule's determinism knobs (docs/designs/pipelined-reconcile.md)."""
-    pkg_root = pathlib.Path(karpenter_tpu.__path__[0])
-    scan = sorted((pkg_root / "controllers").glob("*.py"))
-    scan += [pkg_root / "operator.py", pkg_root / "pipeline.py"]
-    offenders = []
-    for path in scan:
-        rel = path.relative_to(pkg_root.parent).as_posix()
-        offenders += thread_ctor_offenders(
-            path.read_text(), rel, _THREAD_SEAM_ALLOWLIST
-        )
-    assert not offenders, (
-        "raw thread construction in the controller layer (route the "
-        "fan-out through pipeline.run_concurrently / declare a pipeline "
-        "stage, or consciously allowlist it):\n" + "\n".join(offenders)
-    )
-
-
-def test_thread_seam_lint_has_teeth():
-    """The checker fires on bare and attribute constructor forms, and
-    stays quiet on allowlisted sites and on bare synchronization
-    primitives."""
-    bad = (
-        "import threading\n"
-        "from concurrent.futures import ThreadPoolExecutor\n"
-        "class C:\n"
-        "    def fan(self, fns):\n"
-        "        self._lock = threading.Lock()\n"
-        "        t = threading.Thread(target=fns[0])\n"
-        "    def pool(self, fns):\n"
-        "        with ThreadPoolExecutor(max_workers=4) as p:\n"
-        "            pass\n"
-    )
-    hits = thread_ctor_offenders(
-        bad, "karpenter_tpu/controllers/x.py", _THREAD_SEAM_ALLOWLIST
-    )
-    assert len(hits) == 2, hits
-    assert "C.fan" in hits[0] and "Thread" in hits[0], hits
-    assert "C.pool" in hits[1], hits
-    ok = thread_ctor_offenders(
-        bad, "karpenter_tpu/controllers/x.py",
-        {("karpenter_tpu/controllers/x.py", "C.fan"),
-         ("karpenter_tpu/controllers/x.py", "C.pool")},
-    )
-    assert not ok, ok
+    pkg = tmp_path / "forged"
+    (pkg / "sub").mkdir(parents=True)
+    (pkg / "sub" / "x.py").write_text("import time\nnow = time.time()\n")
+    assert lint_main(["--root", str(pkg), "--rule", "wall-clock"]) == 1
+    assert lint_main(["--root", str(pkg), "--rule", "store-frame"]) == 0
+    # unknown rule = the checker itself is misconfigured: internal error
+    assert lint_main(["--root", str(pkg), "--rule", "no-such-rule"]) == 2
