@@ -1452,6 +1452,123 @@ def run_store_plane() -> None:
         server.stop()
 
 
+def run_sanitizer_overhead() -> None:
+    """The cost of the instrumented lock wrappers (analysis/sanitizer.py)
+    relative to bare ``threading.Lock`` — one line so enabling the
+    sanitizer in a deployment is a measured decision, and so a wrapper
+    change that silently fattens the acquire path gates in --compare.
+    Measured uncontended (the wrapper adds per-acquisition bookkeeping;
+    contention costs are the lock's own)."""
+    import threading
+
+    from karpenter_tpu.analysis import sanitizer
+
+    pairs = _n(20000)
+
+    def spin(lock):
+        def run():
+            for _ in range(pairs):
+                with lock:
+                    pass
+        return run
+
+    plain_p50, _, _ = _measure(spin(threading.Lock()))
+    assert sanitizer.current() is None, "sanitizer already enabled"
+    san = sanitizer.enable("bench-overhead")
+    try:
+        wrapped = sanitizer.make_lock("_Bench._lock")
+        p50, noise, _ = _measure(spin(wrapped))
+    finally:
+        sanitizer.disable()
+    assert not san.findings(), [f.render() for f in san.findings()]
+    _emit(
+        "sanitizer_lock_overhead_p50",
+        p50,
+        "sanitizer",
+        "lock",
+        0,
+        noise_ms=noise,
+        phases={},
+        acquire_pairs=pairs,
+        plain_ms=round(plain_p50, 2),
+        overhead_x=round(p50 / max(plain_p50, 1e-9), 2),
+    )
+
+
+def sanitizer_verdict(snap=None) -> dict:
+    """The runtime sanitizer's verdict, attached to every --compare
+    artifact next to the lint verdict: a scripted sanitized scenario
+    drives the real store plane (mutations from two threads into a
+    VersionedStore with a live subscriber), then the witness is
+    cross-validated against the static lock model.  ``ok`` = zero
+    runtime findings AND no runtime edge missing from the static graph.
+    Never raises — a broken sanitizer reports ``error`` and fails the
+    gate, exactly like lint_verdict."""
+    import threading
+
+    try:
+        from karpenter_tpu.analysis import sanitizer
+        from karpenter_tpu.analysis.allowlists import WITNESS_EDGES
+        from karpenter_tpu.analysis.core import PackageSnapshot
+        from karpenter_tpu.analysis.locks import static_order_edges
+        from karpenter_tpu.analysis.witness import cross_validate
+
+        assert sanitizer.current() is None, "sanitizer already enabled"
+        san = sanitizer.enable("bench-verdict")
+        try:
+            from karpenter_tpu.api import Pod, Resources
+            from karpenter_tpu.service.store_server import VersionedStore
+
+            store = VersionedStore()
+            with store.lock:
+                _mode, _payload, sub = store.subscribe("bench-sub", "json", 0)
+
+            def writer(tag: str):
+                for i in range(16):
+                    store.mutate(
+                        lambda i=i: store.kube.put_pod(
+                            Pod(
+                                name=f"{tag}-{i}",
+                                requests=Resources(cpu=0.1, memory="1Gi"),
+                            )
+                        )
+                    )
+
+            threads = [
+                threading.Thread(target=writer, args=(t,), name=f"bench-{t}")
+                for t in ("a", "b")
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            with store.lock:
+                store.unsubscribe(sub)
+        finally:
+            sanitizer.disable()
+        findings = san.findings()
+        witness = san.witness()
+        snap = snap or PackageSnapshot.load()
+        edges, universe = static_order_edges(snap)
+        cv = cross_validate(witness, edges, universe, WITNESS_EDGES)
+        return {
+            "ok": not findings and cv.ok,
+            "findings": len(findings),
+            "witness_fingerprint": witness.fingerprint,
+            "edges": len(witness.edges),
+            "cross_validation_ok": cv.ok,
+            "confirmed_edges": len(cv.confirmed),
+            "missing_static": len(cv.missing_static),
+            "details": [f.to_dict() for f in findings[:20]],
+        }
+    except Exception as exc:  # sanitizer down != sanitizer clean
+        return {
+            "ok": False,
+            "findings": -1,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+
+
 def _device_ms(
     kind: str, pools, inventory, pods, chain: int = 6
 ) -> Tuple[float, float]:
@@ -1677,12 +1794,13 @@ def compare_verdict(
     }
 
 
-def lint_verdict() -> dict:
+def lint_verdict(snap=None) -> dict:
     """The static-analysis plane's verdict, attached to every --compare
     artifact so a perf regression and a new invariant violation surface
     in the SAME report (docs/designs/static-analysis.md).  Never raises:
     a broken checker reports ``error`` (and fails the gate) instead of
-    killing the perf comparison."""
+    killing the perf comparison.  ``snap`` lets the compare path share
+    ONE package parse with sanitizer_verdict."""
     try:
         from karpenter_tpu.analysis import (
             PackageSnapshot,
@@ -1692,7 +1810,7 @@ def lint_verdict() -> dict:
         )
         from karpenter_tpu.analysis.core import default_baseline_path
 
-        snap = PackageSnapshot.load()
+        snap = snap or PackageSnapshot.load()
         live, suppressed = run_rules(
             snap, baseline=load_baseline(default_baseline_path(snap))
         )
@@ -1760,6 +1878,20 @@ def render_verdict(verdict: dict) -> List[str]:
                 f"{'lint':55s} {status}: {lint['findings']} finding(s), "
                 f"{lint['baselined']} baselined, {lint['rules']} rule(s)"
             )
+    san = verdict.get("sanitizer")
+    if san is not None:
+        if san.get("error"):
+            rows.append(
+                f"{'sanitizer':55s} CHECKER ERROR: {san['error']}"
+            )
+        else:
+            status = "clean" if san["ok"] else "VIOLATIONS"
+            rows.append(
+                f"{'sanitizer':55s} {status}: {san['findings']} runtime "
+                f"finding(s), {san['confirmed_edges']} edge(s) "
+                f"confirmed, {san['missing_static']} missing from the "
+                f"static model (witness {san['witness_fingerprint']})"
+            )
     return rows
 
 
@@ -1809,8 +1941,18 @@ def main(
         verdict = compare_verdict(_LINES, prior)
         # the lint verdict rides every compare artifact: a perf
         # regression and a fresh invariant violation surface in the
-        # same report (and both gate the exit code)
-        verdict["lint"] = lint_verdict()
+        # same report (and both gate the exit code); the SANITIZER
+        # verdict rides next to it — the same report carries the static
+        # AND the dynamic half of the lock plane, over ONE shared
+        # package parse (and the memoized region scan under it)
+        try:
+            from karpenter_tpu.analysis import PackageSnapshot
+
+            snap = PackageSnapshot.load()
+        except Exception:
+            snap = None  # each verdict falls back to its own parse/error
+        verdict["lint"] = lint_verdict(snap)
+        verdict["sanitizer"] = sanitizer_verdict(snap)
         rows, regressed = render_verdict(verdict), verdict["regressed"]
         print(f"vs {compare}:", file=sys.stderr)
         for row in rows:
@@ -1850,6 +1992,14 @@ def main(
                 or f"{verdict['lint']['findings']} non-baselined finding(s)"
             )
             print(f"lint gate failed: {reason}", file=sys.stderr)
+            rc = 1
+        if not verdict["sanitizer"]["ok"]:
+            reason = verdict["sanitizer"].get("error") or (
+                f"{verdict['sanitizer']['findings']} runtime finding(s), "
+                f"{verdict['sanitizer']['missing_static']} runtime "
+                "edge(s) missing from the static model"
+            )
+            print(f"sanitizer gate failed: {reason}", file=sys.stderr)
             rc = 1
         return rc
     return 0
@@ -1901,6 +2051,7 @@ def _run_all() -> None:
     run_consolidation_search()
     run_pipelined_tick()
     run_store_plane()
+    run_sanitizer_overhead()
 
     pools, inventory, pods = build_multipool_spot()
     _run_scheduler_config(

@@ -157,6 +157,19 @@ def main(argv=None) -> int:
         print(json.dumps(settings.__dict__, default=str, indent=2))
         return 0
 
+    # runtime concurrency sanitizer: enabled BEFORE any store/provider
+    # construction so every seam-built lock is wrapped into the witness
+    # (docs/designs/static-analysis.md §runtime sanitizer).  Production
+    # default off; on, the process carries the lock-order/lockset
+    # recorder, the operator arms the deadlock watchdog when
+    # lock_watchdog_stall_s > 0, and shutdown leaves a witness artifact.
+    sanitizer_mod = None
+    if settings.enable_lock_sanitizer:
+        from karpenter_tpu.analysis import sanitizer as sanitizer_mod
+
+        sanitizer_mod.enable("operator")
+        log.info("lock sanitizer enabled (witness on shutdown)")
+
     from karpenter_tpu.cloud.fake.backend import generate_catalog
     from karpenter_tpu.utils.clock import Clock
 
@@ -270,6 +283,19 @@ def main(argv=None) -> int:
         kube.close()
     if server is not None:
         server.shutdown()
+    if sanitizer_mod is not None:
+        import os
+
+        san = sanitizer_mod.disable()
+        witness = san.witness()
+        directory = settings.flight_dir or "."
+        os.makedirs(directory, exist_ok=True)
+        path = witness.dump(os.path.join(directory, "witness.json"))
+        log.info(
+            "lock witness %s -> %s (%d finding(s), %d edge(s))",
+            witness.fingerprint, path, len(witness.findings),
+            len(witness.edges),
+        )
     if operator.tracer.enabled:
         # pprof-style hot-path table on shutdown (settings.md:18's
         # ENABLE_PROFILING analogue); a JSON snapshot lands next to the

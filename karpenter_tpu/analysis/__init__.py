@@ -29,19 +29,45 @@ The 11 legacy lint rules (tests/test_lint.py's original suite) are
 ported onto the engine in rules_legacy.py with their allowlists intact.
 """
 
-from karpenter_tpu.analysis.core import (  # noqa: F401
-    Finding,
-    PackageSnapshot,
-    Rule,
-    RULES,
-    load_baseline,
-    register,
-    run_rules,
-    to_report,
+# The package body imports NOTHING eagerly: every production module now
+# imports analysis.sanitizer (the lock construction seam), which runs
+# this __init__ — pulling the whole rule engine in eagerly would tax
+# every process start and plant a circular-import trap for any future
+# rule module that imports production code.  The engine surface loads on
+# first attribute access (PEP 562) instead.
+
+_CORE_EXPORTS = frozenset(
+    {
+        "Finding",
+        "PackageSnapshot",
+        "Rule",
+        "RULES",
+        "load_baseline",
+        "register",
+        "run_rules",
+        "to_report",
+    }
 )
 
-# registering imports: each module's import populates RULES
-from karpenter_tpu.analysis import rules_legacy  # noqa: F401,E402
-from karpenter_tpu.analysis import locks  # noqa: F401,E402
-from karpenter_tpu.analysis import reachability  # noqa: F401,E402
-from karpenter_tpu.analysis import tracer  # noqa: F401,E402
+
+def load_rules() -> None:
+    """Import every rule module (idempotent): RULES is complete after.
+    Called by __getattr__ below and by core.run_rules, so a direct
+    ``from karpenter_tpu.analysis.core import run_rules`` can never run
+    against a half-registered catalog."""
+    from karpenter_tpu.analysis import (  # noqa: F401
+        locks,
+        reachability,
+        rules_legacy,
+        settings_flow,
+        tracer,
+    )
+
+
+def __getattr__(name: str):
+    if name in _CORE_EXPORTS:
+        load_rules()
+        from karpenter_tpu.analysis import core
+
+        return getattr(core, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -50,6 +50,15 @@ def main(argv=None) -> int:
         help="suppression file (default: <package>/analysis/"
         "baseline.json)",
     )
+    parser.add_argument(
+        "--witness", default="", metavar="FILE",
+        help="runtime witness artifact (a sanitized run's serialized "
+        "lock graph, analysis/witness.py): merge its runtime edges "
+        "into the static order graph and report both directions — a "
+        "runtime edge the static model never predicted is a FINDING "
+        "(static-model incompleteness), a static edge never exercised "
+        "is an informational coverage gap",
+    )
     args = parser.parse_args(argv)
 
     try:
@@ -85,6 +94,11 @@ def main(argv=None) -> int:
             baseline=baseline,
             timings=timings,
         )
+        witness_section = None
+        if args.witness:
+            witness_section, live = _cross_validate(
+                snap, pathlib.Path(args.witness), live
+            )
     except Exception as exc:  # the checker itself broke: exit 2
         print(f"lint internal error: {type(exc).__name__}: {exc}",
               file=sys.stderr)
@@ -92,13 +106,10 @@ def main(argv=None) -> int:
 
     rule_names = args.rule or sorted(RULES)
     if args.json:
-        print(
-            json.dumps(
-                to_report(snap, live, suppressed, rule_names, timings),
-                indent=2,
-                sort_keys=True,
-            )
-        )
+        report = to_report(snap, live, suppressed, rule_names, timings)
+        if witness_section is not None:
+            report["witness"] = witness_section
+        print(json.dumps(report, indent=2, sort_keys=True))
     else:
         for f in live:
             print(f.render())
@@ -108,12 +119,62 @@ def main(argv=None) -> int:
             f"lint: {len(live)} finding(s), {len(suppressed)} baselined, "
             f"{len(rule_names)} rule(s)"
         )
+        if witness_section is not None:
+            cv = witness_section["cross_validation"]
+            print(
+                f"witness {witness_section['fingerprint']} "
+                f"({witness_section['scenario']}): "
+                f"{len(cv['confirmed'])} edge(s) confirmed, "
+                f"{len(cv['missing_static'])} missing from the static "
+                f"model, {len(cv['unexercised_static'])} static edge(s) "
+                f"unexercised (coverage gap), "
+                f"{len(cv['unmodeled'])} out-of-layer"
+            )
         if timings is not None:
             for name, dt in sorted(
                 timings.items(), key=lambda kv: -kv[1]
             ):
                 print(f"  {name:28s} {dt * 1000:8.1f} ms", file=sys.stderr)
     return 1 if live else 0
+
+
+def _cross_validate(snap, witness_path, live):
+    """Merge a witness into the static order graph.  Runtime-only edges
+    (minus allowlists.WITNESS_EDGES) become live ``witness-gap``
+    findings; everything else lands in the report's informational
+    ``witness`` section."""
+    from karpenter_tpu.analysis.allowlists import WITNESS_EDGES
+    from karpenter_tpu.analysis.core import Finding
+    from karpenter_tpu.analysis.locks import static_order_edges
+    from karpenter_tpu.analysis.witness import Witness, cross_validate
+
+    witness = Witness.load(witness_path)
+    edges, universe = static_order_edges(snap)
+    cv = cross_validate(witness, edges, universe, WITNESS_EDGES)
+    for entry in cv.missing_static:
+        site = entry["sites"][0] if entry["sites"] else "?"
+        live.append(
+            Finding(
+                rule="witness-gap",
+                file=site.split(":", 1)[0],
+                line=0,
+                message=(
+                    f"runtime lock-order edge {entry['outer']} -> "
+                    f"{entry['inner']} (witnessed at {site}) is absent "
+                    "from the static order graph — the static model is "
+                    "incomplete for this path (or a seam lock name "
+                    "drifted); fix the resolution or allowlist the "
+                    "edge in WITNESS_EDGES with an argument"
+                ),
+            )
+        )
+    section = {
+        "scenario": witness.scenario,
+        "fingerprint": witness.fingerprint,
+        "findings_in_witness": len(witness.findings),
+        "cross_validation": cv.to_dict(),
+    }
+    return section, sorted(live)
 
 
 if __name__ == "__main__":  # pragma: no cover

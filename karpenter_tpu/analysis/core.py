@@ -253,15 +253,33 @@ def run_rules(
     sorted findings into (live, baselined).  ``timings`` — when a dict
     is passed — receives per-rule wall seconds (the ``--profile``
     surface; never part of the deterministic JSON)."""
+    from karpenter_tpu.analysis import load_rules
+
+    load_rules()  # the registry must be complete, however we were imported
     if allowlists is None:
         from karpenter_tpu.analysis.allowlists import ALLOWLISTS
 
         allowlists = ALLOWLISTS
     baseline = baseline or {}
+    all_rules = rule_names is None or not rule_names
     names = list(rule_names) if rule_names else sorted(RULES)
     unknown = [n for n in names if n not in RULES]
     if unknown:
         raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+    if timings is not None and any(
+        n in ("lock-blocking", "lock-order") for n in names
+    ):
+        # --profile attribution fix: the lock rules share ONE memoized
+        # region scan (locks.region_scan), so whichever rule ran first
+        # used to absorb the whole scan's wall time and the others read
+        # as free — profile numbers did not reflect real cost.  Warm the
+        # shared scan OUTSIDE the per-rule timers and report it as its
+        # own line; per-rule numbers are then each rule's marginal cost.
+        from karpenter_tpu.analysis.locks import region_scan
+
+        t0 = time.perf_counter()
+        region_scan(snap).scan_regions()
+        timings["shared-scan"] = time.perf_counter() - t0
     findings: List[Finding] = list(snap.parse_errors)
     for name in names:
         rule = RULES[name]()
@@ -282,6 +300,31 @@ def run_rules(
         counts[key] = n + 1
         stamped.append(replace(f, occurrence=n) if n else f)
     findings = stamped
+    if baseline and all_rules:
+        # stale-baseline hygiene: a suppression whose fingerprint matches
+        # no current finding is itself a finding — otherwise a fixed
+        # violation's entry rots silently (and keeps reviewers trusting a
+        # suppression list that no longer suppresses anything).  Checked
+        # against the occurrence-stamped fingerprints (what baselines
+        # store), and only when the FULL rule set ran: a --rule subset
+        # cannot judge entries owned by rules it did not run.
+        matched = {f.fingerprint for f in findings}
+        stale = [
+            Finding(
+                rule="stale-baseline",
+                file=f"{snap.package}/analysis/{BASELINE_NAME}",
+                line=1,
+                message=(
+                    f"baseline entry {fp} ({baseline[fp] or 'no note'}) "
+                    "matches no current finding — the suppressed "
+                    "violation is gone; delete the entry"
+                ),
+            )
+            for fp in sorted(baseline)
+            if fp not in matched
+        ]
+        if stale:
+            findings = sorted(findings + stale)
     live = [f for f in findings if f.fingerprint not in baseline]
     suppressed = [f for f in findings if f.fingerprint in baseline]
     return live, suppressed

@@ -43,7 +43,17 @@ from karpenter_tpu.analysis.core import (
 )
 from karpenter_tpu.analysis.graph import CallGraph, call_graph
 
-LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+# lock constructors: the raw stdlib forms plus the sanitizer seam
+# (analysis/sanitizer.py make_*) the package routes construction
+# through — the kind is what Condition-aliasing keys on
+LOCK_CTORS: Dict[str, str] = {
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+    "make_lock": "Lock",
+    "make_rlock": "RLock",
+    "make_condition": "Condition",
+}
 
 # blocking-call detectors: called name -> why it must not run under a
 # lock.  Name-based on purpose — the package's own seams (send_frame,
@@ -101,6 +111,9 @@ class LockModel:
     by_attr: Dict[str, Set[str]] = field(default_factory=dict)
     # canonical id -> canonical id (Condition-over-lock aliases)
     aliases: Dict[str, str] = field(default_factory=dict)
+    # (class name, attr) -> package-relative defining file (the
+    # cross-validation universe filter keys on the defining layer)
+    files: Dict[Tuple[str, str], str] = field(default_factory=dict)
 
     def canonical(self, lock_id: str) -> str:
         seen = set()
@@ -129,20 +142,36 @@ class LockModel:
         return f"?.{attr}"
 
 
+def class_own_nodes(cls_node: ast.ClassDef):
+    """Walk one class's OWN subtree, excluding nested ClassDefs — those
+    are visited as classes in their own right by the caller's outer
+    walk; descending into them here would attribute an inner class's
+    lock assignments to the outer class (a phantom ``Outer.attr``
+    identity next to the real ``Inner.attr``)."""
+    stack = list(cls_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
 def build_lock_model(snap: PackageSnapshot, extra_aliases=None) -> LockModel:
     model = LockModel()
     for info in snap.in_package():
         for node in ast.walk(info.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
-            for sub in ast.walk(node):
+            for sub in class_own_nodes(node):
                 if not (
                     isinstance(sub, ast.Assign)
                     and isinstance(sub.value, ast.Call)
                 ):
                     continue
                 ctor = call_name(sub.value)
-                if ctor not in LOCK_CTORS:
+                kind = LOCK_CTORS.get(ctor or "")
+                if kind is None:
                     continue
                 for target in sub.targets:
                     attr = None
@@ -156,11 +185,17 @@ def build_lock_model(snap: PackageSnapshot, extra_aliases=None) -> LockModel:
                         attr = target.id
                     if attr is None:
                         continue
-                    model.owners[(node.name, attr)] = ctor
+                    model.owners[(node.name, attr)] = kind
                     model.by_attr.setdefault(attr, set()).add(node.name)
-                    # Condition(self.X): alias onto the wrapped lock
-                    if ctor == "Condition" and sub.value.args:
-                        arg = sub.value.args[0]
+                    model.files[(node.name, attr)] = info.rel_in_pkg
+                    # Condition(self.X) / make_condition(name, self.X):
+                    # alias onto the wrapped lock
+                    wrap_idx = 1 if ctor == "make_condition" else 0
+                    if (
+                        kind == "Condition"
+                        and len(sub.value.args) > wrap_idx
+                    ):
+                        arg = sub.value.args[wrap_idx]
                         if (
                             isinstance(arg, ast.Attribute)
                             and isinstance(arg.value, ast.Name)
@@ -198,14 +233,13 @@ class _RegionScan:
         for key, d in graph.defs.items():
             facts = _DefFacts()
             callees: Set[str] = set()
+            local_types = self._local_types(d)
             for node in ast.walk(d.node):
                 if isinstance(node, ast.Call):
                     hit = _blocking_reason(node)
                     if hit:
                         facts.blocking.append((hit[0], hit[1], node.lineno))
-                    callees.update(
-                        graph.resolve_call(node, d.module, d.cls, strict=True)
-                    )
+                    callees.update(self._resolve(node, d, local_types))
                 elif isinstance(node, ast.With):
                     for item in node.items:
                         lock = model.resolve(item.context_expr, d.cls)
@@ -214,17 +248,63 @@ class _RegionScan:
             self.facts[key] = facts
             self.strict_callees[key] = callees
 
-    def region_calls(self, body: List[ast.stmt], d) -> Set[str]:
+    def _local_types(self, d) -> Dict[str, str]:
+        """Constructor-based local type inference: ``bucket =
+        _Bucket(...)`` binds bucket's class for the rest of the def, so
+        ``bucket.add(...)`` resolves even though ``add`` is a stoplisted
+        generic name — the runtime witness caught exactly this hole (the
+        Batcher._lock -> _Bucket._cv edge was invisible statically).  A
+        name rebound to DIFFERENT classes in one def is dropped as
+        ambiguous."""
+        out: Dict[str, str] = {}
+        ambiguous: Set[str] = set()
+        for node in ast.walk(d.node):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+            ):
+                continue
+            cls = node.value.func.id
+            if cls not in self.graph.classes.classes:
+                continue
+            var = node.targets[0].id
+            if var in out and out[var] != cls:
+                ambiguous.add(var)
+            out[var] = cls
+        for var in ambiguous:
+            del out[var]
+        return out
+
+    def _resolve(self, node: ast.Call, d,
+                 local_types: Dict[str, str]) -> List[str]:
+        """Strict resolution plus the local constructor-type fallback."""
+        got = self.graph.resolve_call(node, d.module, d.cls, strict=True)
+        if got:
+            return got
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id in local_types
+        ):
+            return self.graph.classes.method(
+                local_types[f.value.id], f.attr
+            )
+        return []
+
+    def region_calls(self, body: List[ast.stmt], d,
+                     local_types: Optional[Dict[str, str]] = None) -> Set[str]:
         """Callee def keys for calls lexically inside a with-body."""
+        if local_types is None:
+            local_types = self._local_types(d)
         out: Set[str] = set()
         for stmt in body:
             for node in ast.walk(stmt):
                 if isinstance(node, ast.Call):
-                    out.update(
-                        self.graph.resolve_call(
-                            node, d.module, d.cls, strict=True
-                        )
-                    )
+                    out.update(self._resolve(node, d, local_types))
         return out
 
     def closure(self, keys: Set[str]) -> Dict[str, List[str]]:
@@ -434,4 +514,135 @@ class LockOrderRule(Rule):
                     "— pick one global order or merge the locks",
                 )
             )
+        return out
+
+
+# ---------------------------------------------- static<->dynamic surface
+def static_order_edges(
+    snap: PackageSnapshot,
+) -> Tuple[frozenset, frozenset]:
+    """(edges, universe) for witness cross-validation (witness.py):
+    every nested-acquisition edge the static model predicts within
+    ``LOCK_ORDER_LAYERS`` — ALL of them, not just inverted pairs — plus
+    the universe of layer-scoped canonical lock ids.  A runtime edge
+    between universe locks that is absent here means the static model's
+    resolution has a hole (or a seam lock name drifted)."""
+    from karpenter_tpu.analysis.allowlists import LOCK_ORDER_LAYERS
+
+    scan = region_scan(snap)
+    edges: Set[Tuple[str, str]] = set()
+    for d, lock, _line, _blocking, region_edges in scan.scan_regions():
+        if not _layer(d.module.rel_in_pkg, LOCK_ORDER_LAYERS):
+            continue
+        for inner, _site, _path in region_edges:
+            if inner.startswith("?.") or lock.startswith("?."):
+                continue
+            edges.add((lock, inner))
+    universe = frozenset(
+        scan.model.canonical(f"{cls}.{attr}")
+        for (cls, attr), rel in scan.model.files.items()
+        if _layer(rel, LOCK_ORDER_LAYERS)
+    )
+    return frozenset(edges), universe
+
+
+@register
+class LockSeamRule(Rule):
+    """Raw ``threading.Lock/RLock/Condition`` construction is fenced to
+    the sanitizer seam (analysis/sanitizer.py make_lock/make_rlock/
+    make_condition) — a raw lock is invisible to the runtime witness,
+    so a sanitized suite proves nothing about it.  The rule also checks
+    the seam's ``name`` argument against the assignment's static
+    identity (``Class.attr``): the witness and the static model must
+    speak the same vocabulary or cross-validation silently rots."""
+
+    name = "lock-seam"
+    title = "locks constructed via the sanitizer seam, names = Class.attr"
+    guards = "runtime witness coverage + static<->dynamic name agreement"
+
+    _RAW = frozenset({"Lock", "RLock", "Condition"})
+    _SEAM = frozenset({"make_lock", "make_rlock", "make_condition"})
+
+    def check(self, snap, allowlist) -> List[Finding]:
+        out: List[Finding] = []
+        for info in snap.in_package():
+            # names imported straight off threading (`from threading
+            # import Lock`): a bare `Lock()` built through them is just
+            # as raw as `threading.Lock()` — the fence must not be
+            # bypassable by import style
+            from_threading = {
+                (alias.asname or alias.name): alias.name
+                for imp in ast.walk(info.tree)
+                if isinstance(imp, ast.ImportFrom)
+                and imp.module == "threading"
+                for alias in imp.names
+            }
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for sub in class_own_nodes(node):
+                    if not (
+                        isinstance(sub, ast.Assign)
+                        and isinstance(sub.value, ast.Call)
+                    ):
+                        continue
+                    ctor = call_name(sub.value)
+                    target = sub.targets[0]
+                    attr = None
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        attr = target.attr
+                    if attr is None:
+                        continue
+                    f = sub.value.func
+                    raw_kind = None
+                    if ctor in self._RAW and (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "threading"
+                    ):
+                        raw_kind = ctor
+                    elif (
+                        isinstance(f, ast.Name)
+                        and from_threading.get(f.id) in self._RAW
+                    ):
+                        raw_kind = from_threading[f.id]
+                    if raw_kind is not None:
+                        if (info.rel, f"{node.name}.{attr}") in allowlist:
+                            continue
+                        out.append(
+                            self.finding(
+                                info.rel, sub.lineno,
+                                f"{node.name}.{attr} = threading."
+                                f"{raw_kind}() constructed raw — route "
+                                "through analysis.sanitizer."
+                                f"make_{raw_kind.lower()}(...) so "
+                                "sanitized runs can witness it, or "
+                                "consciously allowlist it",
+                            )
+                        )
+                    elif ctor in self._SEAM:
+                        args = sub.value.args
+                        want = f"{node.name}.{attr}"
+                        got = (
+                            args[0].value
+                            if args
+                            and isinstance(args[0], ast.Constant)
+                            and isinstance(args[0].value, str)
+                            else None
+                        )
+                        if got != want:
+                            out.append(
+                                self.finding(
+                                    info.rel, sub.lineno,
+                                    f"{want} = {ctor}({got!r}) — the "
+                                    "seam name must be the lock's "
+                                    f"static identity {want!r} "
+                                    "(witness<->static cross-validation "
+                                    "matches on it)",
+                                )
+                            )
         return out

@@ -1,0 +1,153 @@
+"""The settings-flow rule: every ``Settings`` field must actually FLOW.
+
+The dead-twin-knob bug class (caught by hand in the PR 12/13 reviews):
+a field lands on the dataclass, gets validated, maybe even documented —
+and is never read by any layer, or never exposed through the chart, so
+operators "configure" a knob that changes nothing.  Machine-checked:
+
+1. **read somewhere**: the field name is read as an attribute (or via a
+   ``getattr`` string literal) somewhere in the package OUTSIDE
+   api/settings.py itself (reads inside ``validate()`` don't make a
+   knob live);
+2. **chart-exposed**: the field appears in ``deploy/chart/values.yaml``
+   under ``settings:`` AND in the configmap template, so the rendered
+   ``settings.json`` can actually carry it (tests/test_deploy.py proves
+   the rendered payload loads — this rule proves the key EXISTS to
+   render).
+
+Read detection is deliberately name-based and over-approximating: any
+``x.field_name`` counts, whoever ``x`` is.  A false "read" keeps the
+rule quiet, which is the safe failure direction for a doc-rot class of
+check.  The allowlist names fields exempt from the READ requirement
+(reference-parity knobs retained for config compatibility), each with
+its argument in allowlists.py; chart presence is never exempt — an
+accepted field costs one values.yaml line.
+
+Synthetic trees without an ``api/settings.py`` (or without chart files)
+skip the corresponding half — the teeth harness forges both.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from karpenter_tpu.analysis.core import (
+    Finding,
+    PackageSnapshot,
+    Rule,
+    register,
+)
+
+SETTINGS_REL = "api/settings.py"
+
+
+def settings_fields(snap: PackageSnapshot) -> List[tuple]:
+    """[(field name, line)] of the Settings dataclass, public fields
+    only, declaration order."""
+    info = next(
+        (m for m in snap.in_package(SETTINGS_REL)), None
+    )
+    if info is None:
+        return []
+    out: List[tuple] = []
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Settings":
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and not stmt.target.id.startswith("_")
+                ):
+                    out.append((stmt.target.id, stmt.lineno))
+    return out
+
+
+def _attribute_reads(snap: PackageSnapshot) -> Set[str]:
+    """Every attribute name read (or getattr'd by literal) anywhere in
+    the package outside the settings module."""
+    reads: Set[str] = set()
+    for info in snap.in_package():
+        if info.rel_in_pkg == SETTINGS_REL:
+            continue
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                reads.add(node.attr)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                reads.add(node.args[1].value)
+    return reads
+
+
+def _settings_block(values_text: str) -> str:
+    """The ``settings:`` mapping of values.yaml — keys are matched
+    INSIDE this block only, so a Settings field named like some other
+    chart key (``replicas``, ``port``) cannot satisfy the presence
+    check by accident."""
+    m = re.search(
+        r"^settings:\s*\n((?:[ \t]+.*\n?|\n)*)", values_text, re.M
+    )
+    return m.group(1) if m else ""
+
+
+@register
+class SettingsFlowRule(Rule):
+    """Every Settings field is read in the package and chart-exposed."""
+
+    name = "settings-flow"
+    title = "every Settings field read in-package and chart-exposed"
+    guards = "no dead twin knobs (a configured setting always flows)"
+
+    def check(self, snap, allowlist) -> List[Finding]:
+        fields = settings_fields(snap)
+        if not fields:
+            return []
+        reads = _attribute_reads(snap)
+        values_text = _settings_block(
+            snap.doc_text("deploy", "chart", "values.yaml")
+        )
+        configmap_text = snap.doc_text(
+            "deploy", "chart", "templates", "configmap.yaml"
+        )
+        out: List[Finding] = []
+        rel = f"{snap.package}/{SETTINGS_REL}"
+        for fname, line in fields:
+            if fname not in reads and fname not in allowlist:
+                out.append(
+                    self.finding(
+                        rel, line,
+                        f"Settings.{fname} is never read in the package "
+                        "— a dead twin knob: configuring it changes "
+                        "nothing.  Wire it or allowlist it with an "
+                        "argument",
+                    )
+                )
+            if values_text and not re.search(
+                rf"^\s+{re.escape(fname)}:", values_text, re.M
+            ):
+                out.append(
+                    self.finding(
+                        rel, line,
+                        f"Settings.{fname} missing from deploy/chart/"
+                        "values.yaml — the chart cannot set it",
+                    )
+                )
+            if configmap_text and f'"{fname}"' not in configmap_text:
+                out.append(
+                    self.finding(
+                        rel, line,
+                        f"Settings.{fname} missing from the configmap "
+                        "template — the rendered settings.json cannot "
+                        "carry it",
+                    )
+                )
+        return out

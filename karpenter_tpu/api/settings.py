@@ -99,6 +99,18 @@ class Settings:
     # --watch-queue-batches / --events-cap, chart store.* values).
     store_codec: str = "auto"
     store_events_cap: int = 4096
+    # runtime concurrency sanitizer (analysis/sanitizer.py): wrap every
+    # seam-constructed lock in the lock-order/lockset witness.  OFF in
+    # production by default — the sanitized test suites are the normal
+    # consumer; enabling in a deployment buys the deadlock watchdog and
+    # a witness artifact on shutdown at measured per-acquisition cost
+    # (the sanitizer_lock_overhead bench line)
+    enable_lock_sanitizer: bool = False
+    # deadlock watchdog (sanitizer.LockWatchdog): when the sanitizer is
+    # enabled and EVERY currently-held lock has been held longer than
+    # this many seconds, dump the live lock graph + a flight record.
+    # 0 disables the watchdog thread entirely
+    lock_watchdog_stall_s: float = 0.0
 
     # legacy names accepted on ingest (file and env) so a configmap or
     # environment written before the provision_batch_* rename keeps
@@ -205,3 +217,10 @@ class Settings:
             raise ValueError("store_codec must be 'auto' or 'json'")
         if self.store_events_cap < 1:
             raise ValueError("store_events_cap must be >= 1")
+        if self.lock_watchdog_stall_s < 0:
+            raise ValueError("lock_watchdog_stall_s must be >= 0")
+        if self.lock_watchdog_stall_s and not self.enable_lock_sanitizer:
+            raise ValueError(
+                "lock_watchdog_stall_s needs enable_lock_sanitizer (the "
+                "watchdog reads the sanitizer's holder table)"
+            )

@@ -19,6 +19,7 @@ import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
+from karpenter_tpu.analysis.sanitizer import make_condition, make_lock
 
 CREATE_FLEET_WINDOWS = (0.035, 1.0, 1000)
 DESCRIBE_WINDOWS = (0.1, 1.0, 500)
@@ -117,7 +118,7 @@ class Batcher:
         if registry is None:
             from karpenter_tpu.metrics.registry import REGISTRY as registry
         self.registry = registry
-        self._lock = threading.Lock()
+        self._lock = make_lock("Batcher._lock")
         self._buckets: Dict[Hashable, _Bucket] = {}
 
     def submit(self, request: Any) -> Future:
@@ -151,7 +152,7 @@ class _Bucket:
         self.key = key
         self.items: List[Tuple[Any, Future]] = []
         self.closed = False
-        self._cv = threading.Condition()
+        self._cv = make_condition("_Bucket._cv")
         self._window = CoalesceWindow(parent.idle_s, parent.max_s)
         self._window.observe(time.monotonic())
 

@@ -10,6 +10,7 @@ import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 DEFAULT_TTL = 60.0
 INSTANCE_TYPES_ZONES_TTL = 300.0
@@ -33,7 +34,7 @@ class TTLCache:
         self._items: Dict[Any, Tuple[float, Any]] = {}
         # launches fan out over a thread pool (provisioning.py _launch), so
         # every provider cache on that path sees concurrent access
-        self._lock = threading.Lock()
+        self._lock = make_lock("TTLCache._lock")
 
     def get(self, key) -> Optional[Any]:
         with self._lock:

@@ -15,6 +15,7 @@ import threading
 
 from karpenter_tpu.cache.ttl import TTLCache, UNAVAILABLE_OFFERINGS_TTL
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 
 class UnavailableOfferings:
@@ -25,7 +26,7 @@ class UnavailableOfferings:
         # unsynchronized += can lose updates (or regress the counter),
         # silently skipping the seqnum-keyed instance-type cache
         # invalidation downstream
-        self._seq_lock = threading.Lock()
+        self._seq_lock = make_lock("UnavailableOfferings._seq_lock")
 
     @staticmethod
     def _key(capacity_type: str, instance_type: str, zone: str) -> str:

@@ -23,6 +23,7 @@ from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 from karpenter_tpu.api import labels as L
 from karpenter_tpu.api.objects import BlockDeviceMapping
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.analysis.sanitizer import make_lock, make_rlock
 
 
 class CloudAPIError(Exception):
@@ -256,7 +257,7 @@ class _CallRecorder:
         self.calls: Dict[str, List[tuple]] = {}
         self._error_seq: Dict[str, List[Exception]] = {}
         self._error_at: Dict[str, Dict[int, Exception]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("_CallRecorder._lock")
         self.chaos: Optional[ChaosEngine] = None  # wired by FakeCloud
         # observers called with (api, args) at every API entry, BEFORE any
         # injected error fires — the cluster simulator's trace recorder
@@ -342,7 +343,7 @@ class FakeCloud:
         self.chaos = ChaosEngine(clock)
         self.recorder.chaos = self.chaos
         self._seq = itertools.count(1)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("FakeCloud._lock")
 
     # ------------------------------------------------------------------ setup
     def with_default_topology(self) -> "FakeCloud":

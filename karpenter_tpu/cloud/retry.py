@@ -41,6 +41,7 @@ import threading
 from typing import Dict
 
 from karpenter_tpu.cloud.fake.backend import CloudAPIError
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 log = logging.getLogger(__name__)
 
@@ -153,7 +154,7 @@ class RetryingCloud:
         self.failure_threshold = settings.cloud_circuit_failure_threshold
         self.reset_timeout = settings.cloud_circuit_reset_timeout
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("RetryingCloud._lock")
         self._budget = self.budget_per_tick
         self._circuits: Dict[str, _Circuit] = {}
 

@@ -55,6 +55,7 @@ from karpenter_tpu.scheduling.solver import RemovalCandidate, TensorScheduler
 from karpenter_tpu.state.cluster import Cluster, StateNode
 from karpenter_tpu.state.kube import KubeStore
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.analysis.sanitizer import note_access
 
 log = logging.getLogger(__name__)
 
@@ -604,6 +605,11 @@ class DisruptionController:
         spec.pending_keys = keys
         spec.pending = ev.dispatch_masks(cands, keys)
         spec.t_enqueued = perf_counter()
+        # Eraser lockset annotation (analysis/sanitizer.py): the
+        # speculation slot is single-threaded BY DESIGN (dispatch/
+        # advance run on the tick thread); a future threaded
+        # pipeline touching it unprotected becomes an rt-race
+        note_access("DisruptionController._speculation")
         self._speculation = spec
 
     def reconcile_advance(self) -> None:
@@ -657,6 +663,7 @@ class DisruptionController:
         speculative verdict is discarded and the pass recomputes
         synchronously, which is what keeps pipelining on/off
         action-identical tick for tick."""
+        note_access("DisruptionController._speculation")
         spec = self._speculation
         if spec is None:
             return None

@@ -20,6 +20,7 @@ from karpenter_tpu.errors import NodeClaimNotFoundError
 from karpenter_tpu.metrics.registry import REGISTRY, Registry
 from karpenter_tpu.state.kube import KubeStore, Node
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 log = logging.getLogger(__name__)
 
@@ -40,7 +41,7 @@ class TerminationController:
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.registry = registry
-        self._mark_lock = threading.Lock()
+        self._mark_lock = make_lock("TerminationController._mark_lock")
 
     # -------------------------------------------------------------- external
     def mark_for_deletion(self, claim: NodeClaim, reason: str = "") -> None:

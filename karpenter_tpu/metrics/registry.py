@@ -15,6 +15,7 @@ import bisect
 import threading
 from collections import defaultdict, deque
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 # per-series sample window kept for test/debug inspection; count/sum run
 # unbounded so dump() stays exact while memory stays O(1) per series
@@ -93,7 +94,7 @@ def _key(labels: Optional[Mapping[str, str]]) -> Tuple:
 
 class Registry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("Registry._lock")
         self.counters: Dict[str, Dict[Tuple, float]] = defaultdict(
             lambda: defaultdict(float)
         )

@@ -64,6 +64,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from karpenter_tpu.utils.trace import TRACER
+from karpenter_tpu.analysis.sanitizer import make_lock, note_access
 
 
 def _sig_part(v) -> tuple:
@@ -169,7 +170,7 @@ class DeviceScope:
 class DeviceObservatory:
     def __init__(self):
         self.enabled = True
-        self._lock = threading.Lock()
+        self._lock = make_lock("DeviceObservatory._lock")
         self.total = DeviceScope()
         self._scopes: List[DeviceScope] = []
         # warm-tick bookkeeping: the operator bumps the tick; a compile
@@ -292,6 +293,7 @@ class DeviceObservatory:
         if not self.enabled:
             return
         with self._lock:
+            note_access("DeviceObservatory._resident_sources")
             self._resident_sources[owner] = dict(footprint)
 
     def _merged_resident(self) -> Dict[str, int]:
@@ -305,6 +307,8 @@ class DeviceObservatory:
 
     def resident_footprint(self) -> Dict[str, int]:
         with self._lock:
+            note_access("DeviceObservatory._resident_sources",
+                        write=False)
             return self._merged_resident()
 
     def count_resident_update(self, kind: str) -> None:

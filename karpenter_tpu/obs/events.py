@@ -55,6 +55,7 @@ from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.obs.context import current_trace_id
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 log = logging.getLogger(__name__)
 
@@ -122,7 +123,7 @@ class EventLedger:
     ):
         self.clock = clock or Clock()
         self.registry = registry
-        self._lock = threading.Lock()
+        self._lock = make_lock("EventLedger._lock")
         self._ring: deque = deque(maxlen=capacity)
         self._seq = 0
         self._sink = open(sink_path, "a") if sink_path else None

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.metrics.registry import Registry
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 FLIGHT_VERSION = 1
 
@@ -78,7 +79,7 @@ class FlightRecorder:
         self.registry = registry
         self.ledger = ledger
         self.tracer = tracer
-        self._lock = threading.Lock()
+        self._lock = make_lock("FlightRecorder._lock")
         self._ring: deque = deque(maxlen=max(1, capacity))
         self._led_cursor = 0
         self._counters: Dict[Tuple[str, Tuple], float] = {}

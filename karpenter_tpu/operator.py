@@ -130,6 +130,23 @@ class Operator:
             self.clock, registry, ledger=self.ledger, tracer=self.tracer,
             capacity=self.settings.flight_ticks,
         )
+        # deadlock watchdog (analysis/sanitizer.py LockWatchdog): armed
+        # only when the runtime lock sanitizer is active (the entrypoint
+        # enables it from Settings.enable_lock_sanitizer BEFORE the
+        # stores are built, so their locks are wrapped) — it reads the
+        # sanitizer's live holder table and dumps the lock graph plus a
+        # flight record when every holder wedges past the stall bound
+        self.watchdog = None
+        if self.settings.lock_watchdog_stall_s > 0:
+            from karpenter_tpu.analysis import sanitizer as _sanitizer
+
+            san = _sanitizer.current()
+            if san is not None:
+                self.watchdog = _sanitizer.LockWatchdog(
+                    san,
+                    self.settings.lock_watchdog_stall_s,
+                    self._on_lock_stall,
+                )
         # device observatory (obs/device.py): compile/transfer/resident
         # telemetry behind the dispatch boundary.  Process-global like
         # the tracer; the diagnosis tail exports its per-tick deltas into
@@ -490,6 +507,30 @@ class Operator:
                 ", ".join(breaches), path,
             )
 
+    def _on_lock_stall(self, report: dict) -> None:
+        """Watchdog callback (runs on the watchdog thread): persist the
+        live lock graph next to a flight dump so the postmortem has both
+        WHO holds what and what the ticks around the stall looked
+        like."""
+        from karpenter_tpu.analysis import sanitizer as _sanitizer
+
+        log.error(
+            "lock watchdog: every held lock stalled past %.1fs: %s",
+            report["stall_s"],
+            ", ".join(
+                f"{h['lock']}@{h['thread']}({h['held_s']}s)"
+                for h in report["holds"]
+            ),
+        )
+        directory = self.settings.flight_dir or "."
+        os.makedirs(directory, exist_ok=True)
+        san = _sanitizer.current()
+        if san is not None:
+            san.witness().dump(
+                os.path.join(directory, "witness-lock-stall.json")
+            )
+        self.dump_flight("lock_stall", directory=directory)
+
     def request_flight_dump(self, trigger: str) -> None:
         """Ask for a flight dump at the end of the current/next tick.
         Safe to call from a signal handler (a single attribute write);
@@ -523,12 +564,18 @@ class Operator:
         if self.elector is not None:
             # keep the lease fresh through ticks longer than its duration
             self.elector.start_background_renewal(self._stop)
-        while not self._stop.is_set():
-            try:
-                self.reconcile_once()
-            except Exception:
-                log.exception("reconcile tick failed; continuing")
-            self.clock.sleep(interval_s)
+        if self.watchdog is not None:
+            self.watchdog.start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    self.reconcile_once()
+                except Exception:
+                    log.exception("reconcile tick failed; continuing")
+                self.clock.sleep(interval_s)
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.stop()
 
     def stop(self) -> None:
         self._stop.set()

@@ -39,6 +39,7 @@ import logging
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
+from karpenter_tpu.analysis.sanitizer import note_blocking
 
 log = logging.getLogger(__name__)
 
@@ -146,6 +147,10 @@ def run_concurrently(calls: List[Callable[[], object]],
 
     if max_workers <= 1 or len(calls) <= 1:
         return [outcome(fn) for fn in calls]
+    # runtime blocking witness (analysis/sanitizer.py): joining a
+    # fan-out while holding a lock is the convoy class the static
+    # lock-blocking rule fences; sanitized runs observe it here
+    note_blocking("run_concurrently")
     with ThreadPoolExecutor(
         max_workers=min(max_workers, len(calls))
     ) as pool:

@@ -18,6 +18,7 @@ from karpenter_tpu.ops.packer import pad_problem
 from karpenter_tpu.ops.tensorize import CompiledProblem
 from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
 from karpenter_tpu.service.server import PACK_ARG_ORDER, PACK_RESULT_FIELDS
+from karpenter_tpu.analysis.sanitizer import make_lock, note_blocking
 
 
 class RemotePackResult(NamedTuple):
@@ -47,7 +48,7 @@ class RemoteSolver:
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
         self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("RemoteSolver._lock")
 
     # ------------------------------------------------------------- transport
     def _connect(self) -> socket.socket:
@@ -64,6 +65,7 @@ class RemoteSolver:
         return self._sock
 
     def _call(self, meta: dict, arrays: dict) -> Tuple[dict, dict]:
+        note_blocking("_rpc")  # runtime blocking witness (sanitizer.py)
         with self._lock:  # one in-flight request per connection
             sock = self._connect()
             try:

@@ -19,6 +19,7 @@ from typing import Dict, Tuple
 
 import numpy as np
 
+from karpenter_tpu.analysis.sanitizer import note_blocking
 from karpenter_tpu.state.binwire import (
     BIN_VERSION,
     decode_value,
@@ -83,6 +84,10 @@ _BIN_MAGIC = 0xB5
 
 
 def encode_payload(header: dict, codec: str = CODEC_JSON) -> bytes:
+    # payload-sized encode: sanctioned under VersionedStore.lock only
+    # (bin snapshots reference live objects — the serve_watch contract);
+    # any other lock held here is a runtime finding
+    note_blocking("encode_payload")
     if codec == CODEC_BIN:
         return bytes((_BIN_MAGIC, BIN_VERSION)) + encode_value(header)
     return encode(header, {})
@@ -111,10 +116,15 @@ def decode_payload(payload: bytes, codec: str = CODEC_JSON) -> dict:
 
 
 def send_frame(sock: socket.socket, payload: bytes) -> None:
+    # runtime blocking witness (analysis/sanitizer.py): socket frame I/O
+    # under a held lock is the convoy class the static lock-blocking
+    # rule fences; sanitized runs OBSERVE it here.  No-op in production.
+    note_blocking("send_frame")
     sock.sendall(struct.pack(">Q", len(payload)) + payload)
 
 
 def recv_frame(sock: socket.socket) -> bytes:
+    note_blocking("recv_frame")
     size_raw = _recv_exact(sock, 8)
     (size,) = struct.unpack(">Q", size_raw)
     if size > MAX_FRAME:

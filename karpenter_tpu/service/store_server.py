@@ -70,6 +70,12 @@ from typing import Deque, Dict, List, Optional, Tuple
 from karpenter_tpu.metrics.registry import Registry
 from karpenter_tpu.obs.context import trace_context
 from karpenter_tpu.obs.events import EventLedger
+from karpenter_tpu.analysis.sanitizer import (
+    make_condition,
+    make_lock,
+    make_rlock,
+    note_access,
+)
 from karpenter_tpu.service.codec import (
     CODEC_BIN,
     CODEC_JSON,
@@ -198,7 +204,7 @@ class _Subscriber:
         self.identity = identity
         self.codec = codec
         self.cap = max(1, cap)
-        self.cond = threading.Condition(lock)
+        self.cond = make_condition("_Subscriber.cond", lock)
         self.batches: Deque[_Batch] = deque()
         self.delivered_seq = 0
         self.pending_resync = False
@@ -212,6 +218,7 @@ class _Subscriber:
 
     def offer(self, batch: _Batch) -> None:
         # store lock held by the caller (mutate/commit)
+        note_access("_Subscriber.batches")  # lockset witness
         if self.pending_resync:
             return  # already coalesced; the resync frame covers this too
         if len(self.batches) >= self.cap:
@@ -246,7 +253,7 @@ class VersionedStore:
         events_cap: int = EVENTS_CAP,
     ):
         self.kube = kube or KubeStore()
-        self.lock = threading.RLock()
+        self.lock = make_rlock("VersionedStore.lock")
         self.rv = 0
         self.rvs: Dict[Tuple[str, str], int] = {}
         # per-lease CAS sequence, SEPARATE from the broadcast rv space:
@@ -362,6 +369,7 @@ class VersionedStore:
                     native["obj"] = obj
                 bin_events.append(Raw(encode_value(native)))
         batch = _Batch(self.log_seq, metas, json_events, bin_events)
+        note_access("VersionedStore.replay_log")  # lockset witness
         self.replay_log.append(batch)
         self._log_events += len(metas)
         while (
@@ -739,7 +747,7 @@ class StoreServer(socketserver.ThreadingTCPServer):
         # in-process stop must behave the same, or clients talk to a
         # zombie serving pre-stop state)
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("StoreServer._conns_lock")
         # follower plumbing (read replicas)
         self._primary_seq = 0
         self._primary_epoch = ""
@@ -1176,6 +1184,7 @@ class StoreServer(socketserver.ThreadingTCPServer):
                         if isinstance(out, dict):  # JSON: encode unlocked
                             pending_dict, out = out, None
                     else:
+                        note_access("_Subscriber.batches")
                         batches = list(sub.batches)
                         sub.batches.clear()
                         sub.delivered_seq = batches[-1].seq

@@ -34,6 +34,7 @@ import json
 import threading
 from typing import Any, Dict, IO, List, Optional, Tuple
 
+from karpenter_tpu.analysis.sanitizer import make_lock
 from karpenter_tpu.cloud.fake.backend import (
     FakeImage,
     FakeInstance,
@@ -83,7 +84,7 @@ class TraceWriter:
         self.path = path
         self._fh: Optional[IO[str]] = open(path, "w") if path else None
         self._lines: List[str] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("TraceWriter._lock")
         self.tick = -1  # set by the runner; -1 = before the first tick
 
     # ------------------------------------------------------------- writing

@@ -15,6 +15,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from karpenter_tpu.analysis.sanitizer import make_lock, note_access
 from karpenter_tpu.api import (
     NodeClaim,
     NodeClass,
@@ -97,7 +98,7 @@ class KubeStore:
         # surface touched concurrently by competing replicas, so its
         # compare-and-swap runs under a lock
         self.leases: Dict[str, "Lease"] = {}
-        self._lease_lock = threading.Lock()
+        self._lease_lock = make_lock("KubeStore._lease_lock")
 
     # -- watch hooks ---------------------------------------------------------
     def watch(self, fn: Callable[[str, str, object], None]) -> None:
@@ -274,6 +275,7 @@ class KubeStore:
 
         acquired = None
         with self._lease_lock:
+            note_access("KubeStore.leases")  # lockset witness
             lease = self.leases.get(name)
             if (
                 lease is not None

@@ -45,6 +45,12 @@ from typing import Dict, Optional, Tuple
 
 from karpenter_tpu.metrics.registry import Registry
 from karpenter_tpu.obs.context import current_trace_id
+from karpenter_tpu.analysis.sanitizer import (
+    make_lock,
+    make_rlock,
+    note_access,
+    note_blocking,
+)
 from karpenter_tpu.service.codec import (
     CODEC_BIN,
     CODEC_JSON,
@@ -122,9 +128,9 @@ class RemoteKubeStore(KubeStore):
         self.request_timeout = request_timeout
         self._sock: Optional[socket.socket] = None
         self._sock_codec = CODEC_JSON  # negotiated per RPC connection
-        self._rpc_lock = threading.Lock()  # one in-flight RPC per conn
-        self._mirror_lock = threading.RLock()  # mirror + rv bookkeeping
-        self._lease_mutex = threading.Lock()  # lease ops end-to-end
+        self._rpc_lock = make_lock("RemoteKubeStore._rpc_lock")  # one in-flight RPC per conn
+        self._mirror_lock = make_rlock("RemoteKubeStore._mirror_lock")  # mirror + rv bookkeeping
+        self._lease_mutex = make_lock("RemoteKubeStore._lease_mutex")  # lease ops end-to-end
         self._rvs: Dict[Tuple[str, str], int] = {}
         self._shadow: Dict[Tuple[str, str], str] = {}
         self._lease_rvs: Dict[str, int] = {}
@@ -224,6 +230,11 @@ class RemoteKubeStore(KubeStore):
         Mutations here are idempotent re-applied (puts/deletes/lease CAS);
         a retried record_event may at worst duplicate an event line."""
         header = dict(header, identity=self.identity)
+        # runtime blocking witness: a store round trip issued while some
+        # OTHER lock is held (the lease mutex is the one sanctioned
+        # case) is the convoy the static lock-blocking rule predicts —
+        # sanitized runs observe it here.  No-op in production.
+        note_blocking("_rpc")
         # trace-context propagation (obs/context.py): the tick's trace ID
         # rides the RPC header so the StoreServer records its handling
         # span under the CLIENT's timeline — one trace spans both
@@ -425,6 +436,10 @@ class RemoteKubeStore(KubeStore):
     def _adopt(self, kind: str, key: str, obj_wire, rv: int) -> None:
         _cls, attr, _key_fn = STORE_KINDS[kind]
         with self._mirror_lock:
+            # lockset witness: the mirror is written from the watch
+            # thread AND from controller-thread RPC responses — the
+            # mirror lock must be their common lockset
+            note_access("RemoteKubeStore.mirror")
             store_dict = getattr(self, attr)
             if obj_wire is None:
                 store_dict.pop(key, None)

@@ -123,6 +123,11 @@ def render_template(text: str, values: dict, name: str = "") -> str:
         v = _lookup(values, m.group(1))
         if isinstance(v, bool):  # JSON/YAML booleans, not Python's True
             return "true" if v else "false"
+        if isinstance(v, (dict, list)):
+            # a values entry written as a natural YAML map/list (tags,
+            # slo_rules) renders as JSON, not Python repr — the settings
+            # payload must stay parseable either way
+            return json.dumps(v)
         return str(v)
 
     out = _EXPR.sub(sub, text)

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import threading
 import time as _time
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 
 class Clock:
@@ -24,7 +25,7 @@ class FakeClock(Clock):
 
     def __init__(self, start: float = 1_700_000_000.0):
         self._now = start
-        self._lock = threading.Lock()
+        self._lock = make_lock("FakeClock._lock")
 
     def now(self) -> float:
         return self._now

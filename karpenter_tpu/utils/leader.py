@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 import threading
 from dataclasses import dataclass
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 log = logging.getLogger(__name__)
 
@@ -82,7 +83,7 @@ class LeaderElector:
         self.identity = identity
         self.lease_name = lease_name
         self.lease_duration_s = lease_duration_s
-        self._lock = threading.Lock()
+        self._lock = make_lock("LeaderElector._lock")
         self._leading = False
         # clock timestamp of the last successful acquire/renew; the
         # still_leading() fence compares it against the lease duration

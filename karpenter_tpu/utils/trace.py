@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
 from karpenter_tpu.obs.context import current_trace_id
+from karpenter_tpu.analysis.sanitizer import make_lock
 
 # bounded history: enough for several reconcile ticks of every controller
 RING_SIZE = 4096
@@ -72,7 +73,7 @@ class Tracer:
         # when set (and enabled), device_trace additionally captures the
         # XLA timeline for wrapped dispatches
         self.profile_dir = profile_dir
-        self._lock = threading.Lock()
+        self._lock = make_lock("Tracer._lock")
         self._ring: deque = deque(maxlen=RING_SIZE)
         self._stats: Dict[str, SpanStat] = {}
         self._local = threading.local()

@@ -293,6 +293,15 @@ class TestCompare:
         assert lint["ok"] is True and lint["findings"] == 0
         assert lint["rules"] >= 15 and lint["details"] == []
         assert "lint" in captured.err and "clean" in captured.err
+        # ...and the SANITIZER verdict rides next to it (runtime half of
+        # the lock plane): scripted sanitized store scenario, zero
+        # findings, witness cross-validated against the static model
+        san = verdict["sanitizer"]
+        assert san["ok"] is True and san["findings"] == 0
+        assert san["cross_validation_ok"] is True
+        assert san["missing_static"] == 0
+        assert len(san["witness_fingerprint"]) == 16
+        assert "sanitizer" in captured.err
 
     def test_compare_verdict_flags_regressions(self):
         old = [{"metric": "a_p50", "value": 100.0}]

@@ -268,6 +268,48 @@ TEETH = {
         expect=["wall clock time.time()", "stamp", "TraceWriter.digest"],
         silence=frozenset({"forged/sim/trace.py"}),
     ),
+    "settings-flow": dict(
+        # three fields: "wired" is read + charted (clean), "dead_knob"
+        # is charted but never read (fires, allowlistable), "uncharted"
+        # is read but missing from values.yaml + configmap (fires —
+        # silenced here only via the chart docs, so the silence set
+        # covers dead_knob alone and the forged chart carries uncharted)
+        files={
+            "api/settings.py": (
+                "class Settings:\n"
+                "    wired: bool = True\n"
+                "    dead_knob: int = 0\n"
+                "    def validate(self):\n"
+                "        return self.dead_knob\n"  # reads here don't count
+            ),
+            "operator.py": (
+                "def run(settings):\n"
+                "    return settings.wired\n"
+            ),
+        },
+        docs={
+            "deploy/chart/values.yaml": (
+                "settings:\n  wired: \"true\"\n  dead_knob: \"0\"\n"
+            ),
+            "deploy/chart/templates/configmap.yaml": (
+                '{ "wired": x, "dead_knob": y }\n'
+            ),
+        },
+        expect=["dead_knob", "never read", "dead twin knob"],
+        silence=frozenset({"dead_knob"}),
+    ),
+    "lock-seam": dict(
+        files={
+            "service/x.py": (
+                "import threading\n"
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+            ),
+        },
+        expect=["S._lock", "constructed raw", "make_lock"],
+        silence=frozenset({("forged/service/x.py", "S._lock")}),
+    ),
     "tracer-safety": dict(
         # the forged unseamed jit dispatch + an impure traced body
         files={
@@ -344,6 +386,205 @@ def test_tracer_safety_call_site_allowlist(tmp_path):
         allowlists={"tracer-safety": frozenset({("forged/ops/x.py", "solve")})},
     )
     assert not silenced
+
+
+def test_lock_seam_name_must_match_static_identity(tmp_path):
+    """The seam's name argument is the witness<->static vocabulary; a
+    drifted name is a finding and is deliberately NOT allowlistable
+    (like impure traced bodies) — there is no sound reason for a lock
+    to lie about its identity."""
+    forged = forge(
+        tmp_path,
+        {
+            "service/x.py": (
+                "from forged.analysis.sanitizer import make_lock\n"
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._lock = make_lock('Wrong.name')\n"
+            ),
+        },
+    )
+    live, _ = run_rules(
+        forged, rule_names=["lock-seam"],
+        allowlists={"lock-seam": frozenset()},
+    )
+    assert len(live) == 1 and "static identity 'S._lock'" in live[0].message
+    # the correct name is clean
+    ok = forge(
+        tmp_path / "ok",
+        {
+            "service/x.py": (
+                "from forged.analysis.sanitizer import make_lock\n"
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._lock = make_lock('S._lock')\n"
+            ),
+        },
+    )
+    clean, _ = run_rules(
+        ok, rule_names=["lock-seam"], allowlists={"lock-seam": frozenset()}
+    )
+    assert not clean, "\n".join(f.render() for f in clean)
+
+
+def test_lock_seam_catches_from_threading_import_form(tmp_path):
+    """`from threading import Lock; self._l = Lock()` is just as raw as
+    `threading.Lock()` — the fence is not bypassable by import style."""
+    forged = forge(
+        tmp_path,
+        {
+            "service/x.py": (
+                "from threading import Lock, RLock as RL\n"
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._l = Lock()\n"
+                "        self._r = RL()\n"
+            ),
+        },
+    )
+    live, _ = run_rules(
+        forged, rule_names=["lock-seam"],
+        allowlists={"lock-seam": frozenset()},
+    )
+    messages = "\n".join(f.message for f in live)
+    assert len(live) == 2, messages
+    assert "S._l" in messages and "S._r" in messages
+
+
+def test_lock_seam_nested_class_attributed_once(tmp_path):
+    """A nested class's lock belongs to the INNER class: no phantom
+    Outer.attr identity, no bogus name-mismatch, no double finding."""
+    forged = forge(
+        tmp_path,
+        {
+            "service/x.py": (
+                "import threading\n"
+                "class Outer:\n"
+                "    class Inner:\n"
+                "        def __init__(self):\n"
+                "            self._lock = make_lock('Inner._lock')\n"
+                "            self._raw = threading.Lock()\n"
+            ),
+        },
+    )
+    live, _ = run_rules(
+        forged, rule_names=["lock-seam"],
+        allowlists={"lock-seam": frozenset()},
+    )
+    # exactly ONE finding: the raw construction, attributed to Inner;
+    # the correctly-named seam lock is clean
+    assert len(live) == 1, "\n".join(f.render() for f in live)
+    assert "Inner._raw" in live[0].message
+    from karpenter_tpu.analysis.locks import build_lock_model
+
+    model = build_lock_model(forged)
+    assert ("Inner", "_lock") in model.owners
+    assert ("Outer", "_lock") not in model.owners
+
+
+def test_settings_flow_scoped_to_the_settings_block(tmp_path):
+    """A Settings field named like some OTHER chart key (`replicas`)
+    must not satisfy the values.yaml presence check by accident."""
+    forged = forge(
+        tmp_path,
+        {
+            "api/settings.py": (
+                "class Settings:\n"
+                "    replicas: int = 1\n"
+            ),
+            "operator.py": "def run(s):\n    return s.replicas\n",
+        },
+        docs={
+            "deploy/chart/values.yaml": (
+                "replicas: 1\nsettings:\n  cluster_name: \"\"\n"
+            ),
+            "deploy/chart/templates/configmap.yaml": '{ "replicas": x }\n',
+        },
+    )
+    live, _ = run_rules(
+        forged, rule_names=["settings-flow"],
+        allowlists={"settings-flow": frozenset()},
+    )
+    assert len(live) == 1, "\n".join(f.render() for f in live)
+    assert "missing from deploy/chart/values.yaml" in live[0].message
+
+
+def test_stale_baseline_entry_is_a_finding(tmp_path):
+    """Stale-baseline hygiene: a suppression whose fingerprint matches
+    no current finding is itself reported, so fixed violations cannot
+    leave their entries rotting; a LIVE entry stays a plain
+    suppression, and a --rule subset never judges entries it cannot
+    see."""
+    forged = forge(
+        tmp_path, {"sub/x.py": "import time\nnow = time.time()\n"}
+    )
+    live, _ = run_rules(
+        forged, rule_names=["wall-clock"],
+        allowlists={"wall-clock": frozenset()},
+    )
+    (violation,) = live
+    baseline = {
+        violation.fingerprint: "known debt",
+        "deadbeefdeadbeef": "fixed long ago",
+    }
+    # full rule set: the matching entry suppresses, the stale one fires
+    live2, suppressed = run_rules(forged, baseline=baseline)
+    stale = [f for f in live2 if f.rule == "stale-baseline"]
+    assert len(stale) == 1
+    assert "deadbeefdeadbeef" in stale[0].message
+    assert "fixed long ago" in stale[0].message
+    assert [s.fingerprint for s in suppressed] == [violation.fingerprint]
+    # rule subset: entries owned by other rules are not judged
+    live3, _ = run_rules(
+        forged, rule_names=["wall-clock"],
+        allowlists={"wall-clock": frozenset()}, baseline=baseline,
+    )
+    assert not [f for f in live3 if f.rule == "stale-baseline"]
+
+
+def test_profile_timings_share_one_region_scan(tmp_path, monkeypatch):
+    """--profile attribution (the PR-14 memoization note): the two lock
+    rules share ONE region scan; under --profile the scan is warmed
+    OUTSIDE the per-rule timers and reported as its own `shared-scan`
+    line, so the scan is built exactly once and neither rule's number
+    silently absorbs it."""
+    from karpenter_tpu.analysis import locks as locks_mod
+
+    builds = []
+    orig_init = locks_mod._RegionScan.__init__
+
+    def counting_init(self, *args, **kwargs):
+        builds.append(1)
+        orig_init(self, *args, **kwargs)
+
+    monkeypatch.setattr(locks_mod._RegionScan, "__init__", counting_init)
+    forged = forge(
+        tmp_path,
+        {
+            "service/x.py": (
+                "import threading\n"
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._a = threading.Lock()\n"
+                "        self._b = threading.Lock()\n"
+                "    def f(self):\n"
+                "        with self._a:\n"
+                "            with self._b:\n"
+                "                pass\n"
+            ),
+        },
+    )
+    timings = {}
+    run_rules(
+        forged, rule_names=["lock-blocking", "lock-order"],
+        allowlists={
+            "lock-blocking": frozenset(), "lock-order": frozenset(),
+        },
+        timings=timings,
+    )
+    assert sum(builds) == 1, "the shared region scan built more than once"
+    assert "shared-scan" in timings
+    assert {"lock-blocking", "lock-order"} <= set(timings)
 
 
 def test_baseline_suppresses_by_fingerprint(tmp_path):

@@ -1,0 +1,727 @@
+"""Runtime concurrency sanitizer suite (analysis/sanitizer.py +
+analysis/witness.py, docs/designs/static-analysis.md §runtime sanitizer).
+
+Four layers, mirroring how test_lint.py gates the static plane:
+
+1. **Forged-violation teeth**: a scripted two-thread lock inversion, a
+   blocking op under a non-sanctioned lock, and an unprotected shared
+   write must each produce EXACTLY the expected finding — and the
+   matching clean scripts produce zero.  "The detector actually fires"
+   is the property everything else leans on.
+2. **Witness artifact**: two runs of the same seeded scenario serialize
+   to identical bytes (the Findings-style determinism contract), and
+   the artifact round-trips.
+3. **Static<->dynamic cross-validation**: a witnessed edge the static
+   model predicts is confirmed; a fabricated runtime-only edge between
+   in-layer locks becomes a ``witness-gap`` finding (static-model
+   incompleteness); out-of-layer edges stay informational.  The
+   ``Batcher._lock -> _Bucket._cv`` case is pinned: the runtime witness
+   caught that hole and the constructor-local type inference in
+   locks.py now closes it.
+4. **Sanitized smoke of the threaded suites** (the tier-1 CI surface):
+   a short FakeCloud API-storm hammer, a store-plane writer storm with
+   a live subscriber, an election mini-storm, and a pipelined operator
+   run all complete under the wrappers with zero findings, and their
+   merged witness shows no runtime edge missing from the static model.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from karpenter_tpu.analysis import sanitizer
+from karpenter_tpu.analysis.allowlists import WITNESS_EDGES
+from karpenter_tpu.analysis.witness import Witness, cross_validate
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_isolated():
+    """Every test starts and ends with no active sanitizer — a leaked
+    enable would silently wrap every later test's locks."""
+    sanitizer.disable()
+    yield
+    sanitizer.disable()
+
+
+def run_thread(fn, name):
+    t = threading.Thread(target=fn, name=name)
+    t.start()
+    t.join()
+
+
+# --------------------------------------------------------------- teeth
+class TestForgedViolations:
+    def test_scripted_lock_inversion_fires_exactly_once(self):
+        san = sanitizer.enable("forged-inversion")
+        a = sanitizer.make_lock("Forged._a")
+        b = sanitizer.make_lock("Forged._b")
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        def backward():
+            with b:
+                with a:
+                    pass
+
+        run_thread(forward, "fwd")
+        run_thread(backward, "bwd")
+        findings = san.findings()
+        assert len(findings) == 1, [f.render() for f in findings]
+        (f,) = findings
+        assert f.rule == "rt-lock-order"
+        assert "Forged._a -> Forged._b" in f.message
+        assert "Forged._b -> Forged._a" in f.message
+
+    def test_consistent_order_produces_zero_findings(self):
+        san = sanitizer.enable("forged-clean")
+        a = sanitizer.make_lock("Forged._a")
+        b = sanitizer.make_lock("Forged._b")
+
+        def nested():
+            with a:
+                with b:
+                    pass
+
+        run_thread(nested, "t1")
+        run_thread(nested, "t2")
+        assert san.findings() == []
+        # ...but the edge IS witnessed
+        assert san.witness().edge_pairs() == {("Forged._a", "Forged._b")}
+
+    def test_blocking_under_lock_fires(self):
+        san = sanitizer.enable("forged-blocking")
+        lock = sanitizer.make_lock("Forged._lock")
+        with lock:
+            sanitizer.note_blocking("send_frame")
+        findings = san.findings()
+        assert len(findings) == 1
+        assert findings[0].rule == "rt-lock-blocking"
+        assert "send_frame" in findings[0].message
+        assert "Forged._lock" in findings[0].message
+
+    def test_blocking_under_sanctioned_lock_is_silent(self):
+        """The one-in-flight-RPC pattern: the lock EXISTS to serialize
+        the blocking op (allowlists.SANITIZER_BLOCKING_LOCKS)."""
+        san = sanitizer.enable("forged-sanctioned")
+        lock = sanitizer.make_lock("RemoteKubeStore._rpc_lock")
+        with lock:
+            sanitizer.note_blocking("send_frame")
+        assert san.findings() == []
+        # still witnessed, marked allowed — the artifact keeps the signal
+        (obs,) = san.witness().blocking
+        assert obs["allowed"] is True
+
+    def test_sanctioned_lock_does_not_launder_an_unsanctioned_one(self):
+        """all-held-locks semantics: holding a one-in-flight RPC lock
+        (sanctioned) must not mask the unsanctioned outer lock also
+        held across the blocking op."""
+        san = sanitizer.enable("forged-launder")
+        outer = sanitizer.make_lock("Forged._outer")
+        rpc = sanitizer.make_lock("RemoteKubeStore._rpc_lock")
+        with outer:
+            with rpc:
+                sanitizer.note_blocking("send_frame")
+        findings = san.findings()
+        assert len(findings) == 1
+        assert "Forged._outer" in findings[0].message
+
+    def test_foreign_release_is_a_loud_anomaly(self):
+        """A lock released by a thread that never acquired it (legal
+        ownership handoff for threading.Lock) must surface as a finding
+        instead of silently corrupting the witness's holder table."""
+        san = sanitizer.enable("forged-handoff")
+        lock = sanitizer.make_lock("Forged._lock")
+        lock.acquire()
+
+        def releaser():
+            lock.release()
+
+        run_thread(releaser, "releaser")
+        findings = san.findings()
+        assert len(findings) == 1
+        assert findings[0].rule == "rt-foreign-release"
+        assert "Forged._lock" in findings[0].message
+
+    def test_blocking_with_no_lock_held_is_free(self):
+        san = sanitizer.enable("forged-free")
+        sanitizer.note_blocking("send_frame")
+        assert san.findings() == []
+        assert san.witness().blocking == []
+
+    def test_unprotected_shared_write_fires_exactly_once(self):
+        san = sanitizer.enable("forged-race")
+
+        def touch():
+            sanitizer.note_access("Forged.shared")
+
+        run_thread(touch, "w1")
+        run_thread(touch, "w2")
+        run_thread(touch, "w3")  # more touches: still ONE finding
+        findings = san.findings()
+        assert len(findings) == 1
+        assert findings[0].rule == "rt-race"
+        assert "Forged.shared" in findings[0].message
+
+    def test_lock_protected_shared_write_is_silent(self):
+        san = sanitizer.enable("forged-protected")
+        lock = sanitizer.make_lock("Forged._lock")
+
+        def touch():
+            with lock:
+                sanitizer.note_access("Forged.shared")
+
+        run_thread(touch, "w1")
+        run_thread(touch, "w2")
+        assert san.findings() == []
+        (field,) = san.witness().fields
+        assert field["state"] == "shared-modified"
+        assert field["lockset"] == ["Forged._lock"]
+
+    def test_single_thread_unprotected_writes_are_the_init_pattern(self):
+        """Eraser's exclusive state: one thread initializing without
+        locks is normal object construction, not a race."""
+        san = sanitizer.enable("forged-init")
+        for _ in range(5):
+            sanitizer.note_access("Forged.shared")
+        assert san.findings() == []
+
+    def test_read_only_sharing_is_not_a_race(self):
+        san = sanitizer.enable("forged-readonly")
+        sanitizer.note_access("Forged.shared")  # writer initializes
+
+        def read():
+            sanitizer.note_access("Forged.shared", write=False)
+
+        run_thread(read, "r1")
+        run_thread(read, "r2")
+        assert san.findings() == []
+        (field,) = san.witness().fields
+        assert field["state"] == "shared"
+
+    def test_rlock_reentrancy_records_no_self_edges(self):
+        san = sanitizer.enable("forged-reentrant")
+        lock = sanitizer.make_rlock("Forged._rlock")
+        with lock:
+            with lock:
+                with lock:
+                    pass
+        assert san.findings() == []
+        assert san.witness().edges == []
+
+    def test_condition_aliases_onto_its_wrapped_lock(self):
+        """make_condition over a sanitized lock IS that lock for the
+        witness (the _Subscriber.cond == VersionedStore.lock
+        relationship), and wait() releases the hold — a waiter is not a
+        holder."""
+        san = sanitizer.enable("forged-cond")
+        lock = sanitizer.make_rlock("Forged.lock")
+        cond = sanitizer.make_condition("Forged.cond", lock)
+        other = sanitizer.make_lock("Forged._other")
+        done = threading.Event()
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=0.01)  # releases the hold while waiting
+            done.set()
+
+        run_thread(waiter, "waiter")
+        assert done.is_set()
+        with other:
+            with lock:
+                pass
+        # the only witnessed lock names are the ALIASED pair + other:
+        # "Forged.cond" never appears as its own lock
+        assert "Forged.cond" not in san.witness().locks
+        assert san.findings() == []
+
+
+# ------------------------------------------------------------- artifact
+class TestWitnessArtifact:
+    def _scripted_run(self):
+        san = sanitizer.enable("seeded-scenario")
+        a = sanitizer.make_lock("Forged._a")
+        b = sanitizer.make_lock("Forged._b")
+
+        def forward():
+            with a:
+                with b:
+                    sanitizer.note_access("Forged.slots")
+
+        def backward():
+            with b:
+                with a:
+                    sanitizer.note_blocking("send_frame")
+
+        run_thread(forward, "t1")
+        run_thread(backward, "t2")
+        witness = san.witness()
+        sanitizer.disable()
+        return witness
+
+    def test_same_seeded_scenario_serializes_identically(self):
+        w1 = self._scripted_run()
+        w2 = self._scripted_run()
+        assert w1.dumps() == w2.dumps()
+        assert w1.fingerprint == w2.fingerprint
+
+    def test_round_trip_preserves_everything(self, tmp_path):
+        w = self._scripted_run()
+        path = tmp_path / "witness.json"
+        w.dump(path)
+        loaded = Witness.load(path)
+        assert loaded.to_dict() == w.to_dict()
+        assert loaded.fingerprint == w.fingerprint
+        # findings ride the artifact (the inversion forged above)
+        assert any(
+            f["rule"] == "rt-lock-order" for f in loaded.findings
+        )
+
+    def test_unknown_version_refuses(self):
+        with pytest.raises(ValueError, match="version"):
+            Witness.from_dict({"version": 99})
+
+
+# ----------------------------------------------------- cross-validation
+@pytest.fixture(scope="module")
+def static_model():
+    from karpenter_tpu.analysis.core import PackageSnapshot
+    from karpenter_tpu.analysis.locks import static_order_edges
+
+    snap = PackageSnapshot.load()
+    edges, universe = static_order_edges(snap)
+    return snap, edges, universe
+
+
+class TestCrossValidation:
+    def test_witnessed_batcher_edge_is_confirmed(self, static_model):
+        """The edge the runtime witness originally caught MISSING from
+        the static model (bucket.add resolves through a stoplisted
+        generic name) — pinned as confirmed now that the region scan
+        does constructor-local type inference."""
+        _snap, edges, universe = static_model
+        assert ("Batcher._lock", "_Bucket._cv") in edges
+        san = sanitizer.enable("batcher")
+        from karpenter_tpu.batcher.core import Batcher
+
+        b = Batcher(
+            executor=lambda reqs: [r * 2 for r in reqs],
+            idle_s=0.002, max_s=0.05, name="sanitized",
+        )
+        assert b.call(21) == 42
+        sanitizer.disable()
+        cv = cross_validate(san.witness(), edges, universe, WITNESS_EDGES)
+        assert "Batcher._lock|_Bucket._cv" in cv.confirmed
+        assert cv.ok, cv.missing_static
+
+    def test_fabricated_runtime_edge_is_a_missing_static_finding(
+        self, static_model
+    ):
+        _snap, edges, universe = static_model
+        w = Witness(
+            scenario="fabricated",
+            locks=["RemoteKubeStore._rpc_lock", "VersionedStore.lock"],
+            edges=[{
+                "outer": "RemoteKubeStore._rpc_lock",
+                "inner": "VersionedStore.lock",
+                "sites": ["karpenter_tpu/state/remote.py:_rpc"],
+            }],
+        )
+        assert ("RemoteKubeStore._rpc_lock", "VersionedStore.lock") \
+            not in edges
+        cv = cross_validate(w, edges, universe, WITNESS_EDGES)
+        assert not cv.ok
+        assert len(cv.missing_static) == 1
+        # ...and the allowlist silences it (the sanctioned-edge path)
+        cv2 = cross_validate(
+            w, edges, universe,
+            {"RemoteKubeStore._rpc_lock|VersionedStore.lock"},
+        )
+        assert cv2.ok
+
+    def test_out_of_layer_edge_is_informational(self, static_model):
+        """Registry._lock lives in metrics/ — outside LOCK_ORDER_LAYERS
+        — so an edge into it is unmodeled, never a finding (the static
+        rule deliberately scopes it out)."""
+        _snap, edges, universe = static_model
+        w = Witness(
+            scenario="unmodeled",
+            edges=[{
+                "outer": "VersionedStore.lock",
+                "inner": "Registry._lock",
+                "sites": ["karpenter_tpu/service/store_server.py:_commit"],
+            }],
+        )
+        cv = cross_validate(w, edges, universe, WITNESS_EDGES)
+        assert cv.ok
+        assert len(cv.unmodeled) == 1
+
+    def test_unexercised_static_edges_are_coverage_gaps(
+        self, static_model
+    ):
+        _snap, edges, universe = static_model
+        cv = cross_validate(
+            Witness(scenario="empty"), edges, universe, WITNESS_EDGES
+        )
+        assert cv.ok  # an empty witness proves nothing — and fails nothing
+        assert len(cv.unexercised_static) == len(edges)
+
+    def test_cli_witness_flag(self, tmp_path):
+        """``lint --witness`` merges the artifact: a clean witness keeps
+        exit 0 and reports the section; a fabricated runtime-only edge
+        exits 1 with a witness-gap finding."""
+        import json
+
+        from karpenter_tpu.analysis.cli import main as lint_main
+
+        clean = Witness(scenario="clean")
+        p_clean = tmp_path / "clean.json"
+        p_clean.write_text(clean.dumps())
+        assert lint_main(
+            ["--rule", "lock-order", "--witness", str(p_clean)]
+        ) == 0
+
+        bad = Witness(
+            scenario="gap",
+            edges=[{
+                "outer": "RemoteKubeStore._rpc_lock",
+                "inner": "VersionedStore.lock",
+                "sites": ["karpenter_tpu/state/remote.py:_rpc"],
+            }],
+        )
+        p_bad = tmp_path / "gap.json"
+        p_bad.write_text(bad.dumps())
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = lint_main(
+                ["--rule", "lock-order", "--json",
+                 "--witness", str(p_bad)]
+            )
+        assert rc == 1
+        report = json.loads(buf.getvalue())
+        assert report["witness"]["cross_validation"]["ok"] is False
+        assert any(
+            f["rule"] == "witness-gap" for f in report["findings"]
+        )
+
+
+# ------------------------------------------------- sanitized smoke (CI)
+class TestSanitizedSuites:
+    """Short sanitized versions of the threaded suites' shapes — the
+    tier-1 smoke the CI satellite wires.  Each must complete with ZERO
+    sanitizer findings, and the witnesses must cross-validate clean
+    against the static model (no runtime edge the analyzer never
+    predicted)."""
+
+    def _assert_clean(self, san, static_model):
+        findings = san.findings()
+        assert findings == [], "\n".join(f.render() for f in findings)
+        _snap, edges, universe = static_model
+        cv = cross_validate(san.witness(), edges, universe, WITNESS_EDGES)
+        assert cv.ok, cv.missing_static
+
+    def test_sanitized_api_storm_hammer(self, static_model):
+        """The test_race.py FakeCloud hammer shape, shortened, under the
+        wrappers."""
+        import random
+
+        san = sanitizer.enable("api-storm")
+        from karpenter_tpu.api.objects import SelectorTerm
+        from karpenter_tpu.cloud.fake.backend import (
+            FakeCloud,
+            FakeLaunchTemplate,
+            generate_catalog,
+        )
+        from karpenter_tpu.utils.clock import FakeClock
+
+        cloud = FakeCloud(
+            FakeClock(), shapes=generate_catalog()[:10]
+        ).with_default_topology()
+        term = [SelectorTerm.of(Name="*")]
+
+        def attack(i):
+            rng = random.Random(i)
+            for n in range(40):
+                op = rng.randrange(5)
+                if op == 0:
+                    cloud.create_launch_template(
+                        FakeLaunchTemplate(name=f"lt-{i}-{n % 4}")
+                    )
+                elif op == 1:
+                    cloud.describe_launch_templates()
+                    cloud.describe_subnets(term)
+                elif op == 2:
+                    insts, _ = cloud.create_fleet(
+                        overrides=[{
+                            "instance_type":
+                                cloud.describe_instance_types()[0].name,
+                            "zone": "zone-a",
+                            "subnet_id": "subnet-0",
+                        }],
+                        capacity_type="on-demand",
+                    )
+                    if insts and rng.random() < 0.5:
+                        cloud.terminate_instances([insts[0].id])
+                elif op == 3:
+                    cloud.describe_instances()
+                else:
+                    cloud.get_products()
+
+        threads = [
+            threading.Thread(target=attack, args=(i,), name=f"storm-{i}")
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert cloud.recorder.count("CreateFleet") > 0
+        sanitizer.disable()
+        self._assert_clean(san, static_model)
+
+    def test_sanitized_store_plane_writer_storm(self, static_model):
+        """The store-fleet-chaos shape in miniature: two writer threads
+        mutating one VersionedStore with a live subscriber draining
+        (offer under the store lock, the sender's drain under the
+        aliased condition)."""
+        san = sanitizer.enable("store-plane")
+        from karpenter_tpu.api import Pod, Resources
+        from karpenter_tpu.service.store_server import VersionedStore
+
+        store = VersionedStore()
+        with store.lock:
+            _mode, _payload, sub = store.subscribe("smoke-sub", "json", 0)
+        drained = []
+        stop = threading.Event()
+
+        def sender():
+            while True:
+                with sub.cond:
+                    while not (sub.batches or sub.closed):
+                        if stop.is_set():
+                            return
+                        sub.cond.wait(0.01)
+                    if sub.closed:
+                        return
+                    batches = list(sub.batches)
+                    sub.batches.clear()
+                drained.extend(b.seq for b in batches)
+
+        def writer(tag):
+            for i in range(24):
+                store.mutate(
+                    lambda i=i: store.kube.put_pod(
+                        Pod(
+                            name=f"{tag}-{i}",
+                            requests=Resources(cpu=0.1, memory="1Gi"),
+                        )
+                    )
+                )
+
+        snd = threading.Thread(target=sender, name="sender")
+        snd.start()
+        ws = [
+            threading.Thread(target=writer, args=(t,), name=f"writer-{t}")
+            for t in ("a", "b")
+        ]
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join()
+        stop.set()
+        snd.join()
+        with store.lock:
+            store.unsubscribe(sub)
+        assert len(store.kube.pods) == 48
+        sanitizer.disable()
+        self._assert_clean(san, static_model)
+        # the lockset witness saw the subscriber queue from BOTH sides
+        # with the store lock as the common lockset
+        fields = {f["field"]: f for f in san.witness().fields}
+        batches = fields["_Subscriber.batches"]
+        assert batches["state"] == "shared-modified"
+        assert batches["lockset"] == ["VersionedStore.lock"]
+
+    def test_sanitized_election_mini_storm(self, static_model):
+        """The election-storm shape: competing electors CAS-ing one
+        lease map from threads."""
+        san = sanitizer.enable("election")
+        from karpenter_tpu.state.kube import KubeStore
+        from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.utils.leader import LeaderElector
+
+        kube = KubeStore()
+        clock = FakeClock()
+        electors = [
+            LeaderElector(kube, clock, identity=f"replica-{i}")
+            for i in range(3)
+        ]
+
+        def contend(e):
+            for _ in range(30):
+                e.acquire_or_renew()
+
+        threads = [
+            threading.Thread(
+                target=contend, args=(e,), name=e.identity
+            )
+            for e in electors
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # single-writer invariant held: exactly one holder
+        assert sum(1 for e in electors if e.leading) == 1
+        sanitizer.disable()
+        self._assert_clean(san, static_model)
+        fields = {f["field"]: f for f in san.witness().fields}
+        assert fields["KubeStore.leases"]["lockset"] == [
+            "KubeStore._lease_lock"
+        ]
+
+    def test_sanitized_pipeline_twin_smoke(self, static_model):
+        """One pipelined operator run under the wrappers (the
+        test_pipeline twin shape, shortened): the speculative stages,
+        launch fan-out, and observatory seams all execute sanitized
+        with zero findings."""
+        san = sanitizer.enable("pipeline-twin")
+        from karpenter_tpu.api import Pod, Resources, Settings
+        from karpenter_tpu.testing import Environment
+
+        env = Environment(
+            settings=Settings(
+                cluster_name="sanitized",
+                enable_pipelined_reconcile=True,
+            )
+        )
+        env.default_node_class()
+        env.default_node_pool()
+        for i in range(4):
+            env.kube.put_pod(
+                Pod(
+                    name=f"pod-{i}",
+                    requests=Resources(cpu=0.25, memory="1Gi"),
+                )
+            )
+        for _ in range(6):
+            env.step(1.0)
+        assert not env.kube.pending_pods()
+        sanitizer.disable()
+        self._assert_clean(san, static_model)
+
+
+# ------------------------------------------------------------- watchdog
+class TestWatchdog:
+    def test_fires_when_every_holder_stalls(self):
+        san = sanitizer.enable("watchdog")
+        lock = sanitizer.make_lock("Forged._lock")
+        reports = []
+        dog = sanitizer.LockWatchdog(
+            san, stall_s=5.0, on_stall=reports.append
+        )
+        import time as _time
+
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                entered.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder, name="holder")
+        t.start()
+        entered.wait(2.0)
+        now = _time.monotonic()
+        assert dog.check(now=now) is None  # just acquired: not stalled
+        report = dog.check(now=now + 10.0)  # every holder past the bound
+        assert report is not None
+        assert report["holds"][0]["lock"] == "Forged._lock"
+        assert report["holds"][0]["thread"] == "holder"
+        # same episode: reported once, not per poll
+        assert dog.check(now=now + 11.0) is None
+        assert reports == [report]
+        release.set()
+        t.join()
+        assert dog.check(now=now + 20.0) is None  # nothing held
+
+    def test_one_fresh_holder_disarms(self):
+        """A long critical section among HEALTHY ones is not a
+        deadlock: the watchdog fires only when EVERY holder stalls."""
+        san = sanitizer.enable("watchdog-partial")
+        import time as _time
+
+        # simulate holds directly: one ancient, one fresh
+        now = _time.monotonic()
+        with san._mu:
+            san._holds[(1, "Forged._a")] = ("t1", now - 100.0)
+            san._holds[(2, "Forged._b")] = ("t2", now)
+        dog = sanitizer.LockWatchdog(
+            san, stall_s=5.0, on_stall=lambda r: None
+        )
+        assert dog.check(now=now) is None
+
+    def test_watchdog_needs_sanitizer_setting(self):
+        from karpenter_tpu.api import Settings
+
+        with pytest.raises(ValueError, match="enable_lock_sanitizer"):
+            Settings(
+                cluster_name="x", lock_watchdog_stall_s=5.0
+            ).validate()
+        Settings(
+            cluster_name="x",
+            enable_lock_sanitizer=True,
+            lock_watchdog_stall_s=5.0,
+        ).validate()
+
+
+# -------------------------------------------------- production plumbing
+class TestOperatorWiring:
+    def test_operator_arms_watchdog_only_with_sanitizer(self):
+        from karpenter_tpu.api import Settings
+        from karpenter_tpu.testing import Environment
+
+        # sanitizer off: no watchdog even with the stall bound set...
+        env = Environment(settings=Settings(cluster_name="t"))
+        assert env.operator.watchdog is None
+        # ...sanitizer on + stall bound: armed
+        sanitizer.enable("operator-wiring")
+        try:
+            env2 = Environment(
+                settings=Settings(
+                    cluster_name="t",
+                    enable_lock_sanitizer=True,
+                    lock_watchdog_stall_s=30.0,
+                )
+            )
+            assert env2.operator.watchdog is not None
+            assert env2.operator.watchdog.stall_s == 30.0
+        finally:
+            sanitizer.disable()
+
+    def test_disabled_seam_returns_stdlib_objects(self):
+        """Production default: the seam hands out the stdlib classes
+        themselves — zero wrapper overhead exists to measure."""
+        assert sanitizer.current() is None
+        lock = sanitizer.make_lock("X._lock")
+        assert type(lock) is type(threading.Lock())
+        rlock = sanitizer.make_rlock("X._rlock")
+        assert type(rlock) is type(threading.RLock())
+        cond = sanitizer.make_condition("X._cv")
+        assert isinstance(cond, threading.Condition)
+
+    def test_enable_twice_refuses(self):
+        sanitizer.enable("first")
+        try:
+            with pytest.raises(RuntimeError, match="already enabled"):
+                sanitizer.enable("second")
+        finally:
+            sanitizer.disable()
