@@ -1154,6 +1154,73 @@ def run_pipelined_tick() -> None:
 # ---------------------------------------------------------------------------
 
 
+LOAD_HARNESS_TICKS = 850
+LOAD_HARNESS_SEED = 23
+# acceptance floor: generation + invariant checking must stay under this
+# share of the measured tick wall on the million-event run — the harness
+# must observe the operator, not compete with it
+LOAD_HARNESS_MAX_FRACTION = 0.20
+
+
+def run_load_harness() -> None:
+    """The load harness's throughput line (docs/designs/load-harness.md):
+    one full `million-events` corpus run — a columnar event tape
+    materializing ~1.05M pod events (SCALE=1.0) through the real
+    operator with the VECTORIZED invariant plane checking every tick —
+    timed end to end.  The line's value is the total measured tick wall
+    (the sum of the generate/apply/reconcile/invariants phase spans from
+    ``karpenter_sim_phase_seconds``), and ``harness_fraction`` is the
+    share of it spent in the harness's own phases (generate +
+    invariants).  Acceptance floors (full scale only): >= 1M events
+    applied, zero invariant violations, and harness_fraction <
+    LOAD_HARNESS_MAX_FRACTION — generation and checking must stay a
+    rounding error against the operator under test.  ``--compare``
+    treats the first appearance as ``status: new`` (never gates), then
+    gates p50 growth like every other line."""
+    from karpenter_tpu.sim.runner import run_scenario
+
+    ticks = max(12, _n(LOAD_HARNESS_TICKS))
+    t0 = time.perf_counter()
+    runner, report = run_scenario("million-events", LOAD_HARNESS_SEED, ticks)
+    wall_s = time.perf_counter() - t0
+    assert not report["invariants"]["violations"], (
+        report["invariants"]["violations"]
+    )
+    events_total = sum(runner.event_counts.values())
+    vector_ticks = runner.env.registry.counter(
+        "karpenter_load_vector_checked_ticks_total"
+    )
+    assert vector_ticks > 0, "million-events must check on the vector plane"
+    totals: Dict[str, float] = {}
+    for labels, h in runner.env.registry.histograms.get(
+        "karpenter_sim_phase_seconds", {}
+    ).items():
+        totals[labels[0][1] if labels else ""] = h.total
+    phase_total_s = sum(totals.values())
+    harness_s = totals.get("generate", 0.0) + totals.get("invariants", 0.0)
+    fraction = harness_s / phase_total_s if phase_total_s > 0 else 0.0
+    if SCALE >= 1.0:
+        # the tentpole's acceptance criteria, enforced where the number
+        # is produced: a million pod events, harness under 20% of wall
+        assert events_total >= 1_000_000, events_total
+        assert fraction < LOAD_HARNESS_MAX_FRACTION, fraction
+    _emit(
+        "load_harness_1m_events",
+        phase_total_s * 1000.0,
+        "load",
+        "tape",
+        int(report["nodes"]["churn"]),
+        phases=totals,
+        events_total=events_total,
+        events_per_sec=round(events_total / wall_s, 1) if wall_s else None,
+        harness_ms=round(harness_s * 1000.0, 2),
+        harness_fraction=round(fraction, 4),
+        vector_checked_ticks=int(vector_ticks),
+        ticks=ticks,
+        wall_ms=round(wall_s * 1000.0, 2),
+    )
+
+
 def run_store_plane() -> None:
     """The fleet-scale store plane (docs/designs/store-scale.md), benched
     the way solves are benched: two lines.
@@ -2050,6 +2117,7 @@ def _run_all() -> None:
     run_consolidation_sweep()
     run_consolidation_search()
     run_pipelined_tick()
+    run_load_harness()
     run_store_plane()
     run_sanitizer_overhead()
 

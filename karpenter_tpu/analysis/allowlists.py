@@ -147,6 +147,11 @@ DETERMINISM_ROOTS = (
     "obs/events.py:EventLedger.emit",
     "controllers/disruption.py:DisruptionController._take_speculation",
     "controllers/disruption.py:DisruptionController._pass_fingerprint",
+    # the columnar event tape's identity hash: a tape must replay
+    # byte-identical to its per-event twin, so everything reachable from
+    # the digest (column builds, the counter RNG, per-tick
+    # materialization) is a byte-compared surface
+    "load/generators.py:EventTape.digest",
 )
 
 # sanctioned sinks, each with its argument:

@@ -56,7 +56,34 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "kind",
         "scenario events the simulator applied (pod_create, pod_delete, "
         "instance_kill, spot_interruption, chaos, az_down/az_up, "
-        "image_roll, pool_update)",
+        "image_roll, image_deprecate, price_shock, pool_update)",
+    ),
+    "karpenter_sim_phase_seconds": (
+        "histogram",
+        "phase",
+        "host wall time of one sim-tick phase (generate = workload/tape "
+        "event materialization, apply = event application, reconcile = "
+        "kubelet + operator, invariants = the per-tick invariant suite); "
+        "feeds the --profile sim_phases section and the bench's "
+        "harness-overhead fraction ((generate+invariants)/total must stay "
+        "under 20% on the million-events anchor) — wall clock, so never "
+        "part of the byte-compared trace/report surface",
+    ),
+    "karpenter_sim_time_to_settle_seconds": (
+        "gauge",
+        "(none)",
+        "last simulated moment the cluster had pending pods, relative to "
+        "run start — the scale anchors' acceptance signal; exceeding the "
+        "scenario's settle_budget_s raises a settle-budget invariant "
+        "violation",
+    ),
+    "karpenter_load_vector_checked_ticks_total": (
+        "counter",
+        "(none)",
+        "ticks whose invariant suite ran on the vectorized plane "
+        "(load/invariants.py VectorInvariantChecker) instead of the "
+        "scalar one — cross-validation tests prove both planes emit "
+        "byte-identical violations",
     ),
     "karpenter_sim_ticks_total": (
         "counter",

@@ -107,6 +107,12 @@ class Registry:
         # already holds a registry can emit decision events without new
         # constructor plumbing; None = events are dropped (bare tests)
         self.ledger = None
+        # streaming sketch taps (load/sketch.py): histogram families a
+        # consumer wants summarized over the WHOLE stream, not the
+        # _Hist sample window — the sim runner attaches one for
+        # time-to-schedule so the fleet report's p99.9 stays exact-ish
+        # at millions of observations
+        self._sketches: Dict[str, List[object]] = {}
 
     # ------------------------------------------------------------- recording
     def inc(self, name: str, labels: Optional[Mapping[str, str]] = None, by: float = 1.0):
@@ -120,6 +126,15 @@ class Registry:
     def observe(self, name: str, value: float, labels: Optional[Mapping[str, str]] = None):
         with self._lock:
             self.histograms[name][_key(labels)].observe(value)
+            for sketch in self._sketches.get(name, ()):
+                sketch.observe(value)
+
+    def attach_sketch(self, name: str, sketch) -> None:
+        """Feed every observation of histogram family `name` (all label
+        sets) into `sketch` as well (anything with an ``observe(float)``
+        method, e.g. load/sketch.py's QuantileSketch)."""
+        with self._lock:
+            self._sketches.setdefault(name, []).append(sketch)
 
     def event(self, type_: str, **attrs) -> None:
         """Emit a cluster event through the attached ledger (no-op when

@@ -75,6 +75,10 @@ def main(argv=None, allow_reexec: bool = False) -> int:
     from karpenter_tpu.sim.runner import SCENARIOS, replay, run_scenario
     from karpenter_tpu.sim.trace import TraceWriter, read_trace
 
+    # the load-harness corpus registers its scenarios on import (the
+    # entry points below also trigger this, but --list needs it NOW)
+    import karpenter_tpu.load.corpus  # noqa: F401
+
     if args.list:
         for name, factory in sorted(SCENARIOS.items()):
             print(f"{name}: {factory(200).description}")

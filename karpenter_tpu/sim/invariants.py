@@ -40,6 +40,13 @@ from typing import Dict, List, Optional
 from karpenter_tpu.controllers.disruption import remaining_disruption_budgets
 from karpenter_tpu.controllers.garbagecollection import MIN_INSTANCE_AGE
 
+# pods carrying GANG_LABEL form an atomic gang (a multi-host TPU slice):
+# at the end of any tick, either zero members or ALL of them (per
+# GANG_SIZE_LABEL) must be placed — bound or holding a nomination.
+# A partial slice is a wedged slice.
+GANG_LABEL = "sim/gang"
+GANG_SIZE_LABEL = "sim/gang-size"
+
 # reasons that consume pool.disruption.budgets; everything else a
 # "Disrupting" event can carry (interruption kinds, consolidation
 # rollback) is involuntary or corrective and budget-exempt
@@ -82,6 +89,9 @@ class InvariantChecker:
         # schedule active, interruption/kill/AZ event applied); deadline
         # and leak windows measure from here, not from absolute creation
         self.quiet_since: float = env.clock.now()
+        # gang membership (GANG_LABEL pods), maintained from the same
+        # watch: key -> (gang name, declared size)
+        self._gang_pods: Dict[str, tuple] = {}
         # a pod evicted (consolidation, drain) or re-pended by a node
         # deletion starts a FRESH scheduling wait — without re-arming, a
         # long-lived pod evicted late in a long run would instantly
@@ -89,12 +99,22 @@ class InvariantChecker:
         env.kube.watch(self._on_kube_event)
 
     def _on_kube_event(self, kind: str, verb: str, obj) -> None:
-        if kind != "Pod" or verb not in ("put", "evict"):
+        if kind != "Pod":
             return
+        key = obj.key()
+        if verb == "delete":
+            self._gang_pods.pop(key, None)
+            return
+        if verb not in ("put", "evict"):
+            return
+        gang = getattr(obj, "labels", {}).get(GANG_LABEL)
+        if gang:
+            size = int(obj.labels.get(GANG_SIZE_LABEL, "0") or "0")
+            self._gang_pods[key] = (gang, size)
         if getattr(obj, "phase", None) != "Pending" or obj.node_name:
             return
-        if obj.key() in self.pod_created:
-            self.pod_created[obj.key()] = self.env.clock.now()
+        if key in self.pod_created:
+            self.pod_created[key] = self.env.clock.now()
 
     # ----------------------------------------------------------- wiring
     def attach(self, operator) -> None:
@@ -212,7 +232,10 @@ class InvariantChecker:
         running = {
             i.id for i in cloud.instances.values() if i.state == "running"
         }
-        for iid in running - claimed:
+        # sorted: violation order must not depend on set iteration order
+        # (the vectorized plane in load/invariants.py emits the same
+        # strings in the same order — cross-plane parity is tested)
+        for iid in sorted(running - claimed):
             since = self._unclaimed_since.setdefault(iid, now)
             age = now - max(since, self.quiet_since)
             if age > MIN_INSTANCE_AGE + self.leak_slack_s:
@@ -225,9 +248,10 @@ class InvariantChecker:
             if iid in claimed or iid not in running:
                 del self._unclaimed_since[iid]
 
-        # scheduling deadline, armed once the weather is quiet
+        # scheduling deadline, armed once the weather is quiet (sorted,
+        # same cross-plane parity rule as the leak window above)
         pending = {p.key() for p in kube.pending_pods()}
-        for key in pending:
+        for key in sorted(pending):
             created = self.pod_created.get(key)
             if created is None:
                 continue
@@ -241,6 +265,38 @@ class InvariantChecker:
         for key in list(self.pod_created):
             if key not in kube.pods:
                 del self.pod_created[key]
+
+        self._check_gangs()
+
+    def _check_gangs(self) -> None:
+        """Gang atomicity: every gang must end the tick with zero or ALL
+        members placed (bound to a node, or holding a nomination the
+        kubelet will bind next step).  Shared verbatim by the vectorized
+        plane — gangs are few, so there is nothing to vectorize."""
+        if not self._gang_pods:
+            return
+        kube = self.env.kube
+        cluster = self.env.cluster
+        tally: Dict[str, List[int]] = {}
+        for key, (gang, size) in sorted(self._gang_pods.items()):
+            pod = kube.pods.get(key)
+            if pod is None:
+                continue
+            placed = bool(pod.node_name) or (
+                cluster.nominated_node(key) is not None
+            )
+            t = tally.setdefault(gang, [0, 0, size])
+            t[0] += 1
+            t[1] += int(placed)
+            t[2] = max(t[2], size)
+        for gang, (total, placed, size) in sorted(tally.items()):
+            want = max(size, total)
+            if 0 < placed < want:
+                self._fail(
+                    "gang-atomic",
+                    f"gang {gang}: {placed}/{want} members placed "
+                    "(slices land all-or-nothing)",
+                )
 
     def check_final(self, controller_names) -> None:
         env = self.env

@@ -141,6 +141,29 @@ def _device_section(runner) -> dict:
     return runner.device_scope.device_section(resident=resident)
 
 
+def _fleet_section(runner) -> dict:
+    """Fleet-level SLOs for the load harness — deterministic (simulated
+    clock only; see build_report's inline note)."""
+    env = runner.env
+    sim_seconds = env.clock.now() - runner.t0
+    pod_hours = runner.pod_seconds / 3600.0
+    cost_total = sum(runner.cost_by_ct.values())
+    disruptions = sum(runner.disruptions_by_reason.values())
+    return {
+        "tts": runner.tts_sketch.section(),
+        "pod_hours": round(pod_hours, 6),
+        "cost_per_pod_hour": round(
+            cost_total / pod_hours if pod_hours > 0 else 0.0, 6
+        ),
+        "disruptions_per_hour": round(
+            disruptions / (sim_seconds / 3600.0) if sim_seconds > 0 else 0.0,
+            6,
+        ),
+        "time_to_settle_s": runner.time_to_settle_s,
+        "settle_budget_s": runner.scenario.settle_budget_s,
+    }
+
+
 def build_report(runner) -> dict:
     env = runner.env
     registry = env.registry
@@ -265,6 +288,15 @@ def build_report(runner) -> dict:
             "checked_ticks": runner.checker.checked_ticks,
             "violations": [str(v) for v in runner.checker.violations],
         },
+        # fleet-level section (load harness): streaming-sketch tts
+        # percentiles over EVERY observation (the histogram window
+        # saturates at 1024 samples — useless at a million events),
+        # cost per scheduled pod-hour, disruption rate, settle time.
+        # Everything here is a function of the simulated clock, so it
+        # is part of the byte-compared run/run and run/replay surface;
+        # the HARNESS-OVERHEAD fraction is wall clock and lives in
+        # `wall_profile` instead.
+        "fleet": _fleet_section(runner),
         # scenario-declared SLO rules (obs/slo.py), evaluated by the real
         # engine each tick: breach/recovery counts, final status, and
         # total simulated time spent breached — deterministic, so replays
@@ -290,7 +322,7 @@ def wall_profile(registry) -> dict:
     sched = registry.histogram(
         "karpenter_provisioner_scheduling_duration_seconds"
     )
-    return {
+    out = {
         "wall_clock": True,
         "solver_phases": dict(sorted(phases.items())),
         "scheduling_duration_s": {
@@ -299,3 +331,30 @@ def wall_profile(registry) -> dict:
             "solves": len(sched),
         },
     }
+    # sim harness phase split (generate / apply / reconcile /
+    # invariants, observed per tick by the scenario runner): before
+    # this, --profile attributed the whole tick to reconcile.  The
+    # harness fraction is generation + invariant checking as a share of
+    # the measured tick — the load-harness overhead claim, measurable
+    # straight from the CLI.
+    sim_phases = {}
+    for labels, h in registry.histograms.get(
+        "karpenter_sim_phase_seconds", {}
+    ).items():
+        phase = labels[0][1] if labels else ""
+        sim_phases[phase] = {
+            "count": h.count,
+            "total_s": round(h.total, 6),
+            "p50_s": round(percentile(list(h.samples), 0.5), 6),
+        }
+    if sim_phases:
+        total = sum(p["total_s"] for p in sim_phases.values())
+        harness = sum(
+            sim_phases.get(p, {"total_s": 0.0})["total_s"]
+            for p in ("generate", "invariants")
+        )
+        out["sim_phases"] = dict(sorted(sim_phases.items()))
+        out["harness_fraction"] = round(
+            harness / total if total > 0 else 0.0, 4
+        )
+    return out

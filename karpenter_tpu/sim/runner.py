@@ -28,11 +28,12 @@ Determinism contract (the trace must be byte-identical for equal seeds):
 from __future__ import annotations
 
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu.api import Pod, Resources, Settings
-from karpenter_tpu.api.objects import reset_name_sequences
+from karpenter_tpu.api.objects import PodAffinityTerm, reset_name_sequences
 from karpenter_tpu.obs.device import OBSERVATORY, DeviceScope
 from karpenter_tpu.cloud.fake.backend import (
     CloudAPIError,
@@ -114,6 +115,18 @@ class Scenario:
     # byte-identically.  Empty = the engine idles.
     slo_rules: List[SLORule] = field(default_factory=list)
     description: str = ""
+    # columnar traffic plane (load/generators.py): (seed, ticks) ->
+    # EventTape, built by the RUNNER (tapes are seed-bound, scenarios
+    # are not) and appended to `workloads` as a TapeWorkload.  Replay
+    # mode skips the build — recorded events need no generator.
+    tape_factory: Optional[Callable[[int, int], object]] = None
+    # time-to-settle budget: the last simulated moment with pending pods
+    # must come within this many simulated seconds of t0 (the scale
+    # anchors' acceptance criterion); breach -> "settle-budget" violation
+    settle_budget_s: Optional[float] = None
+    # check invariants on the vectorized plane (load/invariants.py) —
+    # byte-identical violations/traces, array-ops cost
+    vector_invariants: bool = False
 
 
 class SimView:
@@ -194,9 +207,23 @@ class ScenarioRunner:
         self.env.cloud.chaos.reseed(seed + 1)
         self.rng = random.Random(seed)
         self.view = SimView(self)
-        self.checker = InvariantChecker(
-            self.env, deadline_s=scenario.schedule_deadline_s
-        )
+        self._workloads: List[Workload] = list(scenario.workloads)
+        if scenario.tape_factory is not None and tape is None:
+            from karpenter_tpu.load.generators import TapeWorkload
+
+            self._workloads.append(
+                TapeWorkload(scenario.tape_factory(seed, ticks))
+            )
+        if scenario.vector_invariants:
+            from karpenter_tpu.load.invariants import VectorInvariantChecker
+
+            self.checker: InvariantChecker = VectorInvariantChecker(
+                self.env, deadline_s=scenario.schedule_deadline_s
+            )
+        else:
+            self.checker = InvariantChecker(
+                self.env, deadline_s=scenario.schedule_deadline_s
+            )
         self.checker.attach(op)
         self.env.default_node_class()
         self.env.default_node_pool()
@@ -218,6 +245,19 @@ class ScenarioRunner:
         self.disruptions_by_reason: Dict[str, int] = {}
         self.t0 = self.env.clock.now()
         self._sched = self.t0
+        # fleet-level accounting (report's `fleet` section): a streaming
+        # sketch over EVERY time-to-schedule observation (the registry
+        # histogram's exact window saturates at 1024), bound-pod seconds
+        # for cost-per-pod-hour, and the settle clock
+        from karpenter_tpu.load.sketch import QuantileSketch
+
+        self.tts_sketch = QuantileSketch()
+        self.env.registry.attach_sketch(
+            "karpenter_pods_time_to_schedule_seconds", self.tts_sketch
+        )
+        self.pod_seconds = 0.0
+        self.time_to_settle_s = 0.0
+        self._last_pending_at = self.t0
 
     # ------------------------------------------------------------- events
     def apply_event(self, ev: SimEvent) -> None:
@@ -226,11 +266,29 @@ class ScenarioRunner:
         self.event_counts[k] = self.event_counts.get(k, 0) + 1
         env.registry.inc("karpenter_sim_events_injected_total", {"kind": k})
         if k == "pod_create":
+            # optional labels + pod-(anti-)affinity terms (plain-JSON
+            # encoded so recorded traces stay self-contained): the gang
+            # and scale-anchor events in load/corpus.py use these
+            affinity = [
+                PodAffinityTerm(
+                    topology_key=t["topology_key"],
+                    label_selector=tuple(
+                        sorted(
+                            (str(lk), str(lv))
+                            for lk, lv in t.get("match_labels", {}).items()
+                        )
+                    ),
+                    anti=bool(t.get("anti", False)),
+                )
+                for t in d.get("affinity", [])
+            ]
             pod = Pod(
                 name=d["name"],
+                labels=dict(d.get("labels", {})),
                 requests=Resources(
                     cpu=d["cpu"], memory=int(d["mem_gib"] * 2**30)
                 ),
+                pod_affinity=affinity,
             )
             kube.put_pod(pod)
             self.sim_pods.add(pod.key())
@@ -281,6 +339,31 @@ class ScenarioRunner:
                 )
             )
             env.images.invalidate()
+        elif k == "image_deprecate":
+            # rolling catalog deprecation: the SSM-style latest-image
+            # lookup skips deprecated images, so resolved AMIs move and
+            # nodes on the old image start reporting drift
+            im = cloud.images.get(d["id"])
+            if im is not None:
+                im.deprecated = True
+                env.images.invalidate()
+        elif k == "price_shock":
+            # spot market repricing: scale the spot override for the
+            # matching (type, zone) cells by `factor` (empty selector =
+            # every type / every zone).  The pricing provider picks the
+            # change up on its next deterministic refresh.
+            type_sel = d.get("instance_type", "")
+            zone_sel = d.get("zone", "")
+            factor = float(d["factor"])
+            for t in sorted(cloud.shapes):
+                if type_sel and t != type_sel:
+                    continue
+                for z in cloud.zones:
+                    if zone_sel and z != zone_sel:
+                        continue
+                    cloud.spot_prices[(t, z)] = round(
+                        cloud.spot_price(t, z) * factor, 9
+                    )
         elif k == "pool_update":
             pool = kube.node_pools.get(d["pool"])
             if pool is None:
@@ -316,12 +399,17 @@ class ScenarioRunner:
     def _tick(self, tick: int, dt: float, phase: str,
               events: Sequence[SimEvent]) -> None:
         env = self.env
+        # harness phase split (wall clock, perf_counter): feeds ONLY the
+        # non-deterministic --profile section and the bench line — the
+        # byte-compared trace/report never read these histograms
+        t_apply0 = time.perf_counter()
         if self.trace is not None:
             self.trace.tick_start(tick, dt, phase)
         for ev in events:
             if self.trace is not None:
                 self.trace.event(tick, ev.kind, ev.data)
             self.apply_event(ev)
+        t_rec0 = time.perf_counter()
         self._sched += dt
         env.clock.advance_to(self._sched)
         env.kubelet.step()
@@ -339,11 +427,30 @@ class ScenarioRunner:
                 )
             if self.trace is not None:
                 self.trace.ledger(tick, led)
+        t_inv0 = time.perf_counter()
         self.checker.check_tick(tick)
+        t_inv1 = time.perf_counter()
+        env.registry.observe(
+            "karpenter_sim_phase_seconds", t_rec0 - t_apply0,
+            {"phase": "apply"},
+        )
+        env.registry.observe(
+            "karpenter_sim_phase_seconds", t_inv0 - t_rec0,
+            {"phase": "reconcile"},
+        )
+        env.registry.observe(
+            "karpenter_sim_phase_seconds", t_inv1 - t_inv0,
+            {"phase": "invariants"},
+        )
         env.registry.inc("karpenter_sim_ticks_total", {"phase": phase})
         pending = len(env.kube.pending_pods())
         self.peak_pending = max(self.peak_pending, pending)
         env.registry.set("karpenter_sim_pending_pods", float(pending))
+        if pending:
+            self._last_pending_at = env.clock.now()
+        # bound pods x simulated seconds (sim pods are either Pending or
+        # bound-Running, so the difference IS the bound count)
+        self.pod_seconds += (len(env.kube.pods) - pending) * dt
         for inst in env.cloud.instances.values():
             if inst.state != "running":
                 continue
@@ -377,13 +484,14 @@ class ScenarioRunner:
         if self.trace is not None:
             self.trace.meta(scn.name, self.seed, self.ticks, scn.tick_s)
         for tick in range(self.ticks):
+            t_gen0 = time.perf_counter()
             if self.tape is not None:
                 dt, recorded = self.tape.get(tick, (scn.tick_s, []))
                 events = [SimEvent(k, d) for k, d in recorded]
             else:
                 events = [
                     ev
-                    for w in scn.workloads
+                    for w in self._workloads
                     for ev in w.events(tick, self.rng, self.view)
                 ]
                 dt = (
@@ -391,6 +499,11 @@ class ScenarioRunner:
                     if scn.tick_jitter
                     else scn.tick_s
                 )
+            self.env.registry.observe(
+                "karpenter_sim_phase_seconds",
+                time.perf_counter() - t_gen0,
+                {"phase": "generate"},
+            )
             self._tick(tick, dt, "run", events)
         # drain: outlast the recovery windows (ICE TTL 180s, GC grace 30s)
         tick = self.ticks
@@ -414,6 +527,23 @@ class ScenarioRunner:
                 if quiet >= 2:
                     break
         self.checker.check_final(self._controller_names())
+        # time-to-settle: the last simulated moment with pending pods,
+        # relative to t0 — a function of the simulated clock only, so
+        # it belongs to the byte-compared fleet section (and, for the
+        # scale anchors, to the settle-budget invariant)
+        self.time_to_settle_s = round(self._last_pending_at - self.t0, 6)
+        self.env.registry.set(
+            "karpenter_sim_time_to_settle_seconds", self.time_to_settle_s
+        )
+        if (
+            scn.settle_budget_s is not None
+            and self.time_to_settle_s > scn.settle_budget_s
+        ):
+            self.checker._fail(
+                "settle-budget",
+                f"pending pods last seen at +{self.time_to_settle_s:.0f}s "
+                f"(budget {scn.settle_budget_s:.0f}s)",
+            )
         report = build_report(self)
         if self.trace is not None:
             self.trace.report(report)
@@ -751,12 +881,20 @@ SCENARIOS["chaos-soak"] = lambda ticks: chaos_soak_scenario(
 
 
 # -------------------------------------------------------------------- entry
+def _register_corpus() -> None:
+    """Pull in the load-harness corpus (registers its scenarios via the
+    @scenario decorator).  Imported lazily from the entry points — not
+    at module import — to keep `sim -> load -> sim` acyclic."""
+    import karpenter_tpu.load.corpus  # noqa: F401
+
+
 def run_scenario(
     name: str,
     seed: int,
     ticks: int,
     trace: Optional[TraceWriter] = None,
 ) -> Tuple[ScenarioRunner, dict]:
+    _register_corpus()
     if name not in SCENARIOS:
         raise KeyError(
             f"unknown scenario {name!r}; have {sorted(SCENARIOS)}"
@@ -772,6 +910,7 @@ def replay(
     from the registry (settings/shapes are code, not data), then apply the
     recorded tick durations and events instead of generating.  Returns
     (runner, recomputed report, the report recorded in the trace)."""
+    _register_corpus()
     meta, tape, recorded_slo = read_tape(trace_path)
     factory = SCENARIOS.get(meta["scenario"])
     if factory is None:

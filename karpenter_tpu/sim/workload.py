@@ -42,6 +42,8 @@ EVENT_KINDS = (
     "az_down",
     "az_up",
     "image_roll",
+    "image_deprecate",
+    "price_shock",
     "pool_update",
 )
 

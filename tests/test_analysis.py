@@ -669,3 +669,74 @@ class TestSeamRegressions:
         # real host->device upload
         assert scope.dispatches.get("pack_kernel", 0) >= 1
         assert scope.transfer_bytes.get("pack_kernel", 0) > 0
+
+
+# ------------------------------------------------------- load-harness teeth
+class TestLoadHarnessCoverage:
+    """The analyzers must keep their teeth over the load harness: the
+    wall-clock rule scans load/, and the determinism analyzer treats
+    `EventTape.digest` (the tape identity hash) as a byte-compared root."""
+
+    def test_wall_clock_rule_covers_load_package(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "load/generators.py": (
+                    "import time\n"
+                    "def build():\n"
+                    "    return time.time()\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["wall-clock"],
+            allowlists={"wall-clock": frozenset()},
+        )
+        assert len(live) == 1
+        assert live[0].file.endswith("load/generators.py")
+
+    def test_tape_digest_root_taints_reachable_wall_clock(self, tmp_path):
+        """A wall-clock read reachable from `EventTape.digest` — here via
+        the column-build helper the digest hashes — is a finding: the
+        tape's identity hash is a byte-compared surface."""
+        snap = forge(
+            tmp_path,
+            {
+                "load/generators.py": (
+                    "import time\n"
+                    "def _stamp():\n"
+                    "    return time.time()\n"
+                    "class EventTape:\n"
+                    "    def digest(self):\n"
+                    "        return str(_stamp())\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["determinism-reachability"],
+            allowlists={"determinism-reachability": frozenset()},
+        )
+        assert len(live) == 1 and "wall clock" in live[0].message
+
+    def test_tape_digest_root_clean_tree_has_no_findings(self, tmp_path):
+        snap = forge(
+            tmp_path,
+            {
+                "load/generators.py": (
+                    "import hashlib\n"
+                    "import json\n"
+                    "class EventTape:\n"
+                    "    def __init__(self, seed):\n"
+                    "        self.seed = seed\n"
+                    "    def digest(self):\n"
+                    "        h = hashlib.sha256()\n"
+                    "        h.update(json.dumps(self.seed).encode())\n"
+                    "        return h.hexdigest()\n"
+                ),
+            },
+        )
+        live, _ = run_rules(
+            snap, rule_names=["determinism-reachability"],
+            allowlists={"determinism-reachability": frozenset()},
+        )
+        assert not live, [f.render() for f in live]

@@ -20,6 +20,8 @@ REQUIRED_FIELDS = {"metric", "value", "unit", "vs_baseline", "path", "kernel", "
 PHASE_NAMES = {
     "partition", "compile", "pad", "dispatch", "device_block",
     "oracle", "decode", "other", "harness", "delta",
+    # the load-harness line's sim-tick phase split (bench.run_load_harness)
+    "generate", "apply", "reconcile", "invariants",
 }
 
 
@@ -142,6 +144,26 @@ class TestBenchSmoke:
         assert line["overlap_seconds"] >= 0
         assert line["max_phase_ms"] >= 0
         assert line["ticks"] >= 3
+
+    def test_load_harness_line(self, bench_lines):
+        """The million-event load-harness line: a columnar tape drives
+        the full operator with the vectorized invariant plane on, and the
+        harness's own share of the tick wall (generate + invariants) is
+        reported next to the throughput.  The 1M-event and <20% floors
+        assert on the full-scale artifact inside run_load_harness — at
+        tiny scale this checks structure and that the fraction is sane."""
+        line = next(
+            l for l in bench_lines if l["metric"] == "load_harness_1m_events"
+        )
+        assert line["path"] == "load" and line["kernel"] == "tape"
+        assert line["events_total"] > 0
+        assert line["events_per_sec"] > 0
+        assert line["vector_checked_ticks"] > 0
+        assert line["ticks"] >= 12
+        assert 0.0 <= line["harness_fraction"] < 1.0
+        assert line["harness_ms"] >= 0
+        # the phase split is the sim's, not the solver's
+        assert {"generate", "reconcile", "invariants"} <= set(line["phases"])
 
     def test_store_ops_line(self, bench_lines):
         """The fleet-scale store plane's throughput line: the negotiated

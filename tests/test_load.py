@@ -1,0 +1,529 @@
+"""Load-harness suite (karpenter_tpu/load/): the counter RNG's
+scalar/vector bit-parity, columnar tapes replaying byte-identical to
+their per-event twins, vector-vs-scalar invariant cross-validation
+(clean runs AND forged corruptions caught by both planes), the
+production scenario corpus with its scale anchors and settle budgets,
+the fleet-level report section, and the quantile sketch underneath it."""
+
+import math
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.load.generators import (
+    CDiurnal,
+    CInterruptionStorm,
+    CPodBurst,
+    CScript,
+    CSteady,
+    EventTape,
+    draw_u01,
+    draws_u01,
+    poisson_icdf,
+)
+from karpenter_tpu.load.invariants import VectorInvariantChecker
+from karpenter_tpu.load.sketch import QuantileSketch
+from karpenter_tpu.sim.invariants import (
+    GANG_LABEL,
+    GANG_SIZE_LABEL,
+    InvariantChecker,
+)
+from karpenter_tpu.sim.report import wall_profile
+from karpenter_tpu.sim.runner import (
+    Scenario,
+    ScenarioRunner,
+    replay,
+    run_scenario,
+)
+from karpenter_tpu.sim.trace import TraceWriter
+from karpenter_tpu.sim.workload import SimEvent
+from karpenter_tpu.state.kube import Node, NodeClaim, Pod
+
+
+# ------------------------------------------------------------- counter rng
+def test_counter_rng_scalar_vector_bit_parity():
+    """`draws_u01` must produce the exact bits of `draw_u01` for the same
+    counters — the foundation of the tape/twin parity contract."""
+    ticks = np.arange(0, 997, 7, dtype=np.int64)
+    idxs = (ticks * 13 + 5) % 101
+    for seed in (0, 1, 23, 987654321):
+        for stream in (0, 3, 17):
+            vec = draws_u01(seed, stream, ticks, idxs)
+            scalar = [
+                draw_u01(seed, stream, int(t), int(i))
+                for t, i in zip(ticks, idxs)
+            ]
+            assert vec.tolist() == scalar  # bit-identical, not approx
+            assert float(vec.min()) >= 0.0 and float(vec.max()) < 1.0
+    # distinct counters decorrelate: no two coordinates alias
+    assert draw_u01(1, 0, 0, 0) != draw_u01(0, 1, 0, 0)
+    assert draw_u01(0, 0, 1, 0) != draw_u01(0, 0, 0, 1)
+
+
+def test_poisson_icdf_is_a_pure_function():
+    assert poisson_icdf(0.0, 0.99) == 0
+    assert poisson_icdf(-1.0, 0.5) == 0
+    assert poisson_icdf(3.0, 0.25) == poisson_icdf(3.0, 0.25)
+    # monotone in u, and the empirical mean tracks lambda
+    us = [draw_u01(7, 0, t, 0) for t in range(4000)]
+    draws = [poisson_icdf(2.5, u) for u in us]
+    assert all(k >= 0 for k in draws)
+    assert abs(sum(draws) / len(draws) - 2.5) < 0.15
+    assert poisson_icdf(2.5, 0.1) <= poisson_icdf(2.5, 0.9)
+    # a u deep in the float64 tail terminates (the capped CDF walk)
+    assert poisson_icdf(1.0, 1.0 - 2.0**-53) > 0
+
+
+# --------------------------------------------------------- tape/twin parity
+# one spec list per family; factories because twins are stateful
+PARITY_FAMILIES = {
+    "steady-lifetime": lambda: [
+        CSteady(rate=1.2, lifetime=(2, 5), prefix="sl")
+    ],
+    "diurnal": lambda: [
+        CDiurnal(mean=0.9, amplitude=0.8, period_ticks=20, prefix="di")
+    ],
+    "interruption-storm": lambda: [
+        CSteady(rate=0.8, prefix="st"),
+        CInterruptionStorm(start=8, duration=6, per_tick=2),
+    ],
+    "burst-script": lambda: [
+        CScript(
+            {
+                3: [("az_down", {"zone": "zone-b"})],
+                12: [("az_up", {"zone": "zone-b"})],
+            }
+        ),
+        CPodBurst(total=10, per_tick=4, start=2, cpu=1.0, mem_gib=2.0),
+    ],
+}
+
+
+@pytest.mark.sim
+@pytest.mark.parametrize("family", sorted(PARITY_FAMILIES))
+def test_tape_replays_byte_identical_to_per_event_twin(family):
+    """The tentpole parity contract: a columnar tape drives a scenario to
+    the exact bytes its per-event twin generators produce on the same
+    seed — arrival counts, shapes, lifetimes, storm target selection."""
+    specs = PARITY_FAMILIES[family]
+    seed, ticks = 11, 30
+    assert EventTape(seed, ticks, specs()).total_events() > 0
+    w_tape = TraceWriter()
+    tape_scn = Scenario(
+        f"parity-{family}",
+        tape_factory=lambda s, t: EventTape(s, t, specs()),
+    )
+    r_tape = ScenarioRunner(tape_scn, seed, ticks, trace=w_tape).run()
+    w_twin = TraceWriter()
+    twin_scn = Scenario(
+        f"parity-{family}", workloads=EventTape(seed, ticks, specs()).twins()
+    )
+    r_twin = ScenarioRunner(twin_scn, seed, ticks, trace=w_twin).run()
+    assert w_tape.text() == w_twin.text()
+    assert w_tape.sha256() == w_twin.sha256()
+    assert r_tape == r_twin
+    assert r_tape["invariants"]["violations"] == []
+
+
+def test_tape_digest_identity_and_sensitivity():
+    def mk(seed, rate=1.0):
+        return EventTape(
+            seed,
+            25,
+            [
+                CSteady(rate=rate, lifetime=(2, 4), prefix="dg"),
+                CInterruptionStorm(start=5, duration=3),
+            ],
+        )
+
+    assert mk(7).digest() == mk(7).digest()  # build is deterministic
+    assert mk(7).digest() != mk(8).digest()  # seed is in the columns
+    assert mk(7).digest() != mk(7, rate=1.5).digest()  # params too
+    assert mk(7).total_events() == mk(7).total_events()
+
+
+def test_tape_lifetime_deletes_match_creates():
+    """Every delete a lifetimed tape schedules names a pod a prior tick
+    created, and never lands beyond the scripted horizon."""
+    tape = EventTape(3, 40, [CSteady(rate=2.0, lifetime=(1, 6), prefix="lf")])
+
+    class _View:
+        def claimed_instance_ids(self):
+            return []
+
+    created, deleted = set(), []
+    for t in range(40):
+        for ev in tape.materialize(t, _View()):
+            if ev.kind == "pod_create":
+                created.add(f"default/{ev.data['name']}")
+            elif ev.kind == "pod_delete":
+                deleted.append(ev.data["key"])
+    assert deleted and len(deleted) == len(set(deleted))
+    assert set(deleted) <= created
+    assert tape.total_events() == len(created) + len(deleted)
+
+
+# ----------------------------------------------- vector-vs-scalar invariants
+@pytest.mark.sim
+def test_vector_invariants_cross_validate_clean_run():
+    """The same tape-driven scenario checked on the scalar and the
+    vectorized invariant planes produces byte-identical traces and equal
+    reports — the vector plane changes COST, never outcomes."""
+
+    def specs():
+        return [
+            CSteady(rate=1.0, lifetime=(3, 6), prefix="xv"),
+            CInterruptionStorm(start=10, duration=4, per_tick=1),
+        ]
+
+    def scn(vec):
+        return Scenario(
+            "xval",
+            tape_factory=lambda s, t: EventTape(s, t, specs()),
+            vector_invariants=vec,
+        )
+
+    w_scalar = TraceWriter()
+    run_scalar = ScenarioRunner(scn(False), 9, 35, trace=w_scalar)
+    r_scalar = run_scalar.run()
+    w_vector = TraceWriter()
+    run_vector = ScenarioRunner(scn(True), 9, 35, trace=w_vector)
+    r_vector = run_vector.run()
+    assert not isinstance(run_scalar.checker, VectorInvariantChecker)
+    assert isinstance(run_vector.checker, VectorInvariantChecker)
+    assert w_scalar.text() == w_vector.text()
+    assert r_scalar == r_vector
+    assert r_scalar["invariants"]["violations"] == []
+    reg_v = run_vector.env.registry
+    reg_s = run_scalar.env.registry
+    assert reg_v.counter("karpenter_load_vector_checked_ticks_total") > 0
+    assert reg_s.counter("karpenter_load_vector_checked_ticks_total") == 0
+
+
+@pytest.mark.sim
+def test_forged_corruptions_caught_by_both_planes():
+    """Forged state corruptions — a double-launched claim and a ghost
+    node — must be caught by BOTH invariant planes with byte-identical
+    violation strings (the cross-validation teeth)."""
+    runner, report = run_scenario("steady", seed=13, ticks=30)
+    assert report["invariants"]["violations"] == []
+    env = runner.env
+    claims = [
+        c
+        for c in env.kube.node_claims.values()
+        if c.provider_id and c.deleted_at is None
+    ]
+    assert claims, "steady run must leave live claims to forge against"
+    env.kube.node_claims["forged"] = NodeClaim(
+        name="forged", pool_name="default", provider_id=claims[0].provider_id
+    )
+    env.kube.nodes["ghost"] = Node(name="ghost", provider_id="i-never-was")
+    scalar = InvariantChecker(env)
+    vector = VectorInvariantChecker(env)
+    scalar.check_tick(0)
+    vector.check_tick(0)
+    sv = [(v.invariant, v.detail) for v in scalar.violations]
+    vv = [(v.invariant, v.detail) for v in vector.violations]
+    assert sv == vv  # identical strings, identical order
+    kinds = {k for k, _ in sv}
+    assert "no-double-launch" in kinds
+    assert "registered-eq-launched" in kinds
+
+
+@pytest.mark.sim
+def test_gang_atomicity_clean_and_partial_on_both_planes():
+    """A gang with zero members placed is fine; a PARTIAL gang trips
+    gang-atomic identically on both planes."""
+    runner, _ = run_scenario("steady", seed=5, ticks=20)
+    env = runner.env
+    assert env.kube.nodes, "need a node to bind a partial gang onto"
+    scalar = InvariantChecker(env)
+    vector = VectorInvariantChecker(env)
+    gang = {GANG_LABEL: "forged-slice", GANG_SIZE_LABEL: "3"}
+    for i in range(3):  # the watch feeds both checkers' gang mirrors
+        env.kube.put_pod(Pod(name=f"g-{i}", labels=dict(gang)))
+    scalar.check_tick(0)
+    vector.check_tick(0)
+    assert [v.invariant for v in scalar.violations] == []
+    assert [v.invariant for v in vector.violations] == []
+    env.kube.bind_pod("default/g-0", next(iter(env.kube.nodes)))
+    scalar.check_tick(1)
+    vector.check_tick(1)
+    sv = [(v.invariant, v.detail) for v in scalar.violations]
+    vv = [(v.invariant, v.detail) for v in vector.violations]
+    assert sv == vv
+    assert (
+        "gang-atomic",
+        "gang forged-slice: 1/3 members placed (slices land all-or-nothing)",
+    ) in sv
+
+
+# ---------------------------------------------------------------- corpus
+@pytest.mark.sim
+def test_anchor_antiaffinity_smoke_shape():
+    """Tier-1 shape of the 500-node BASELINE anchor: every anti-affine
+    pod forces its own node, inside the settle budget."""
+    runner, report = run_scenario(
+        "anchor-500-antiaffinity-smoke", seed=1, ticks=12
+    )
+    assert report["invariants"]["violations"] == []
+    kube = runner.env.kube
+    assert runner.pods_created == 24
+    assert len(kube.nodes) == 24  # one node per hostile pod
+    for name in kube.nodes:
+        assert len(kube.pods_on_node(name)) == 1
+    fleet = report["fleet"]
+    assert fleet["settle_budget_s"] == 600.0
+    assert 0.0 <= fleet["time_to_settle_s"] <= 600.0
+
+
+@pytest.mark.sim
+def test_anchor_density_smoke_shape():
+    """Tier-1 shape of the 6,600-pod anchor: `max_pods=110` is the
+    binding constraint, so 220 tiny pods pack onto exactly 2 nodes."""
+    runner, report = run_scenario(
+        "anchor-6600-density-smoke", seed=1, ticks=12
+    )
+    assert report["invariants"]["violations"] == []
+    kube = runner.env.kube
+    assert runner.pods_created == 220
+    assert len(kube.nodes) == 2
+    for name in kube.nodes:
+        assert len(kube.pods_on_node(name)) == 110  # slot-packed full
+    assert 0.0 <= report["fleet"]["time_to_settle_s"] <= 600.0
+
+
+@pytest.mark.slow
+@pytest.mark.sim
+def test_anchor_500_antiaffinity_full():
+    """The full BASELINE anchor: 500 anti-affine pods -> 500 single-pod
+    nodes inside the 30-minute settle budget."""
+    runner, report = run_scenario("anchor-500-antiaffinity", seed=1, ticks=15)
+    assert report["invariants"]["violations"] == []
+    kube = runner.env.kube
+    assert runner.pods_created == 500
+    assert len(kube.nodes) == 500
+    assert 0.0 <= report["fleet"]["time_to_settle_s"] <= 1800.0
+
+
+@pytest.mark.slow
+@pytest.mark.sim
+def test_anchor_6600_density_full():
+    """The full density anchor: 6,600 pods at 110 pods/node -> 60 dense
+    nodes inside the 30-minute settle budget."""
+    runner, report = run_scenario("anchor-6600-density", seed=1, ticks=15)
+    assert report["invariants"]["violations"] == []
+    kube = runner.env.kube
+    assert runner.pods_created == 6600
+    assert len(kube.nodes) == 60
+    for name in kube.nodes:
+        assert len(kube.pods_on_node(name)) == 110
+    assert 0.0 <= report["fleet"]["time_to_settle_s"] <= 1800.0
+
+
+@pytest.mark.sim
+def test_gang_slice_lands_atomically_in_one_zone():
+    """The multi-host slice gang lands all-or-nothing: 8 members on 8
+    distinct hosts, all in the one zone left standing mid-drought."""
+    runner, report = run_scenario("gang-slice", seed=3, ticks=30)
+    assert report["invariants"]["violations"] == []
+    kube = runner.env.kube
+    members = [
+        p
+        for p in kube.pods.values()
+        if p.labels.get(GANG_LABEL) == "slice-a"
+    ]
+    assert len(members) == 8
+    assert all(p.node_name for p in members)  # the whole slice landed
+    hosts = {p.node_name for p in members}
+    assert len(hosts) == 8  # hostname anti-affinity: one member per host
+    zones = {kube.nodes[h].labels.get(L.LABEL_ZONE) for h in hosts}
+    assert len(zones) == 1  # zone co-location across the slice
+
+
+_T1_CORPUS = [
+    ("anchor-500-antiaffinity-smoke", 12),
+    ("anchor-6600-density-smoke", 12),
+    ("gang-slice", 30),
+    ("spot-shock-drought", 32),
+    ("catalog-deprecations", 30),
+]
+
+
+@pytest.mark.sim
+@pytest.mark.parametrize("name,ticks", _T1_CORPUS)
+def test_corpus_scenarios_byte_identical_and_replayable(
+    name, ticks, tmp_path
+):
+    """Every corpus scenario is byte-identical run/run AND run/replay,
+    report included."""
+    path = str(tmp_path / f"{name}.jsonl")
+    w1 = TraceWriter(path)
+    _, r1 = run_scenario(name, seed=7, ticks=ticks, trace=w1)
+    assert r1["invariants"]["violations"] == []
+    w2 = TraceWriter()
+    _, r2 = run_scenario(name, seed=7, ticks=ticks, trace=w2)
+    assert w2.text() == open(path).read()
+    assert r1 == r2
+    w3 = TraceWriter()
+    _, replayed, recorded = replay(path, trace=w3)
+    assert recorded == r1
+    assert replayed == r1
+    assert w3.text() == open(path).read()
+
+
+@pytest.mark.slow
+@pytest.mark.sim
+def test_million_events_byte_identical_and_replayable(tmp_path):
+    """The throughput anchor on the vector plane: run/run and run/replay
+    byte-identity at a few hundred events per tick."""
+    path = str(tmp_path / "million.jsonl")
+    w1 = TraceWriter(path)
+    runner1, r1 = run_scenario("million-events", seed=23, ticks=60, trace=w1)
+    assert r1["invariants"]["violations"] == []
+    reg = runner1.env.registry
+    assert reg.counter("karpenter_load_vector_checked_ticks_total") > 0
+    assert sum(runner1.event_counts.values()) > 50_000
+    w2 = TraceWriter()
+    _, r2 = run_scenario("million-events", seed=23, ticks=60, trace=w2)
+    assert w2.text() == open(path).read()
+    assert r1 == r2
+    _, replayed, recorded = replay(path)
+    assert recorded == r1
+    assert replayed == r1
+
+
+def test_cli_lists_corpus_scenarios(capsys):
+    from karpenter_tpu.sim.cli import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in (
+        "anchor-500-antiaffinity",
+        "anchor-500-antiaffinity-smoke",
+        "anchor-6600-density",
+        "anchor-6600-density-smoke",
+        "gang-slice",
+        "spot-shock-drought",
+        "catalog-deprecations",
+        "million-events",
+    ):
+        assert name in out
+
+
+# ------------------------------------------------------------ corpus events
+@pytest.mark.sim
+def test_price_shock_event_scales_spot_cells():
+    runner = ScenarioRunner(Scenario("evt"), seed=1, ticks=1)
+    cloud = runner.env.cloud
+    types = sorted(cloud.shapes)
+    zones = list(cloud.zones)
+    before = {
+        (t, z): cloud.spot_price(t, z) for t in types for z in zones
+    }
+    runner.apply_event(SimEvent("price_shock", {"factor": 4.0}))
+    for (t, z), p in before.items():
+        assert cloud.spot_price(t, z) == pytest.approx(p * 4.0)
+    # selective shock: only the named (type, zone) cell moves
+    t0, z0 = types[0], zones[0]
+    shocked = {(t, z): cloud.spot_price(t, z) for t in types for z in zones}
+    runner.apply_event(
+        SimEvent(
+            "price_shock",
+            {"factor": 0.5, "instance_type": t0, "zone": z0},
+        )
+    )
+    for (t, z), p in shocked.items():
+        want = p * 0.5 if (t, z) == (t0, z0) else p
+        assert cloud.spot_price(t, z) == pytest.approx(want)
+    assert runner.event_counts["price_shock"] == 2
+
+
+@pytest.mark.sim
+def test_image_deprecate_event_moves_resolution():
+    runner = ScenarioRunner(Scenario("evt"), seed=1, ticks=1)
+    env = runner.env
+    runner.apply_event(
+        SimEvent("image_roll", {"id": "image-standard-amd64-v2"})
+    )
+    assert "image-standard-amd64-v2" in env.cloud.images
+    runner.apply_event(
+        SimEvent("image_deprecate", {"id": "image-standard-amd64"})
+    )
+    assert env.cloud.images["image-standard-amd64"].deprecated
+    # deprecating an unknown id is a no-op, not a crash
+    runner.apply_event(SimEvent("image_deprecate", {"id": "nope"}))
+    assert runner.event_counts["image_deprecate"] == 2
+
+
+# ------------------------------------------------------------ fleet report
+@pytest.mark.sim
+def test_fleet_section_and_phase_profile():
+    runner, report = run_scenario("steady", seed=2, ticks=25)
+    fleet = report["fleet"]
+    assert set(fleet) == {
+        "tts",
+        "pod_hours",
+        "cost_per_pod_hour",
+        "disruptions_per_hour",
+        "time_to_settle_s",
+        "settle_budget_s",
+    }
+    tts = fleet["tts"]
+    assert set(tts) == {"count", "p50", "p99", "p999", "max"}
+    assert tts["count"] == runner.tts_sketch.count > 0
+    assert 0.0 <= tts["p50"] <= tts["p99"] <= tts["p999"] <= tts["max"]
+    assert fleet["pod_hours"] > 0.0
+    assert fleet["cost_per_pod_hour"] > 0.0
+    assert fleet["disruptions_per_hour"] >= 0.0
+    assert fleet["settle_budget_s"] is None  # steady declares no budget
+    assert fleet["time_to_settle_s"] >= 0.0
+    # the --profile phase split: per-tick wall broken into the harness
+    # phases, with the harness-overhead fraction alongside
+    prof = wall_profile(runner.env.registry)
+    phases = prof["sim_phases"]
+    assert {"generate", "apply", "reconcile", "invariants"} <= set(phases)
+    for split in phases.values():
+        assert split["count"] > 0
+        assert split["total_s"] >= 0.0
+        assert split["p50_s"] >= 0.0
+    assert 0.0 <= prof["harness_fraction"] < 1.0
+
+
+def test_quantile_sketch_accuracy_merge_and_zeros():
+    values = [((i * 2654435761) % 10_000) * 0.013 + 0.01 for i in range(2000)]
+    sk = QuantileSketch()
+    for v in values:
+        sk.observe(v)
+    ordered = sorted(values)
+    for q in (0.5, 0.9, 0.99, 0.999):
+        rank = max(0, min(len(ordered) - 1, int(round(q * (len(ordered) - 1)))))
+        exact = ordered[rank]
+        assert abs(sk.quantile(q) - exact) <= 0.016 * exact + 1e-9
+    assert sk.vmax == max(values)
+    assert abs(sk.quantile(1.0) - sk.vmax) <= 0.016 * sk.vmax
+    # merge is exactly a sketch over the union (order-free)
+    a, b, whole = QuantileSketch(), QuantileSketch(), QuantileSketch()
+    for i, v in enumerate(values):
+        (a if i % 2 else b).observe(v)
+        whole.observe(v)
+    a.merge(b)
+    assert a.section() == whole.section()
+    # zeros own their bucket: an idle fleet's p50 is exactly 0.0
+    z = QuantileSketch()
+    for _ in range(10):
+        z.observe(0.0)
+    z.observe(5.0)
+    assert z.quantile(0.5) == 0.0
+    assert z.section()["max"] == 5.0
+    assert z.count == 11
+    # empty sketch is all zeros
+    assert QuantileSketch().section() == {
+        "count": 0, "p50": 0.0, "p99": 0.0, "p999": 0.0, "max": 0.0,
+    }
+    # bucket resolution bound: ~0.8% relative error per octave bucket
+    one = QuantileSketch()
+    one.observe(3.7)
+    assert abs(one.quantile(0.99) - 3.7) <= 0.016 * 3.7
+    assert math.isfinite(one.quantile(0.5))
