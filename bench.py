@@ -1519,6 +1519,141 @@ def run_store_plane() -> None:
         server.stop()
 
 
+def run_store_sharded() -> None:
+    """``store_ops_sharded_p50`` — horizontal WRITE scaling across
+    key-partitioned store shards (docs/designs/store-scale.md).
+
+    The single-store line above (``store_ops_mixed_p50``) establishes
+    the per-op cost of the plane's serialization point; this line
+    establishes that sharding actually removes it.  One write mix (400
+    production-shaped pod puts, every one a fresh rv broadcast to a
+    4-watcher fan-out) is pre-encoded, then served two ways through the
+    REAL server path (request decode, dispatch, response encode, watch
+    frame rendering):
+
+    - 1 shard: every op serializes through one `VersionedStore`.
+    - 4 shards: ops partition by `shard_of` (the same blake2b routing
+      `RemoteKubeStore` uses) and the reported time is the CRITICAL
+      PATH — the slowest shard's stream, timed in isolation.  Shards
+      share nothing (stores, watch queues, durable state are per
+      process in deployment), so the critical path IS the fleet's
+      wall time; summing threads in one interpreter would only
+      measure the GIL.
+
+    ``speedup_shards`` = single-stream time / critical path.  With a
+    balanced hash over 400 keys the slowest of 4 shards carries ~27%
+    of the ops, so the acceptance floor is 3x (asserted at full scale;
+    a first ``--compare`` shows the line as ``status: new``)."""
+    from karpenter_tpu.api import Pod, Resources
+    from karpenter_tpu.service.codec import (
+        CODEC_BIN,
+        decode_payload,
+        encode_payload,
+    )
+    from karpenter_tpu.service.shardrouter import shard_of
+    from karpenter_tpu.service.store_server import StoreServer, VersionedStore
+
+    n_shards = 4
+    subscribers = 4
+    ops = _n(400)
+
+    def put_payload(i: int, flip: int) -> bytes:
+        pod = Pod(
+            name=f"sh{i}",
+            requests=Resources(cpu=1, memory="2Gi"),
+            labels={"app": f"a{i % 5}", "team": "ml"},
+        )
+        pod.phase = "Pending" if flip % 2 else "Running"
+        return encode_payload(
+            {
+                "method": "put",
+                "kind": "Pod",
+                "obj": pod,
+                "identity": "writer",
+            },
+            CODEC_BIN,
+        )
+
+    owners = [shard_of("Pod", f"default/sh{i}", n_shards) for i in range(ops)]
+
+    def make_server():
+        server = StoreServer(store=VersionedStore())
+        subs = [
+            server.store.subscribe(f"w{i}", CODEC_BIN)[2]
+            for i in range(subscribers)
+        ]
+        return server, subs
+
+    def serve(server, subs, payloads) -> float:
+        t0 = time.perf_counter()
+        for payload in payloads:
+            response = server.dispatch(
+                decode_payload(payload, CODEC_BIN), CODEC_BIN
+            )
+            encode_payload(response, CODEC_BIN)
+            for sub in subs:
+                if sub.batches:
+                    batches = list(sub.batches)
+                    sub.batches.clear()
+                    server._frame_payload(batches, CODEC_BIN)
+        return time.perf_counter() - t0
+
+    single = make_server()
+    sharded = [make_server() for _ in range(n_shards)]
+    flip = {"n": 0}
+
+    def mixes():
+        """(single-stream payloads, per-shard payload partitions) for
+        one iteration — client work, untimed.  Phase flips keep every
+        put a real commit."""
+        flip["n"] += 1
+        payloads = [put_payload(i, flip["n"]) for i in range(ops)]
+        parts = [[] for _ in range(n_shards)]
+        for i, payload in enumerate(payloads):
+            parts[owners[i]].append(payload)
+        return payloads, parts
+
+    # warm + seed both topologies
+    payloads, parts = mixes()
+    serve(*single, payloads)
+    for s, part in zip(sharded, parts):
+        serve(*s, part)
+
+    singles, criticals = [], []
+    for _ in range(max(ITERS, 5)):
+        payloads, parts = mixes()
+        singles.append(serve(*single, payloads))
+        criticals.append(
+            max(serve(*s, part) for s, part in zip(sharded, parts))
+        )
+    single[0].server_close()
+    for s in sharded:
+        s[0].server_close()
+
+    p50_single = statistics.median(singles) * 1000.0
+    p50_critical = statistics.median(criticals) * 1000.0
+    speedup = round(p50_single / max(p50_critical, 1e-9), 2)
+    if SCALE >= 1.0:
+        assert speedup >= 3.0, (
+            f"sharded write scaling {speedup}x < 3x acceptance floor"
+        )
+    _emit(
+        "store_ops_sharded_p50",
+        p50_critical,
+        "store",
+        CODEC_BIN,
+        ops,
+        phases={},
+        shards=n_shards,
+        ops=ops,
+        subscribers=subscribers,
+        single_shard_ms=round(p50_single, 2),
+        ops_per_sec_1shard=round(ops / (p50_single / 1000.0), 1),
+        ops_per_sec_4shard=round(ops / (p50_critical / 1000.0), 1),
+        speedup_shards=speedup,
+    )
+
+
 def run_sanitizer_overhead() -> None:
     """The cost of the instrumented lock wrappers (analysis/sanitizer.py)
     relative to bare ``threading.Lock`` — one line so enabling the
@@ -2119,6 +2254,7 @@ def _run_all() -> None:
     run_pipelined_tick()
     run_load_harness()
     run_store_plane()
+    run_store_sharded()
     run_sanitizer_overhead()
 
     pools, inventory, pods = build_multipool_spot()

@@ -110,9 +110,12 @@ def main(argv=None) -> int:
         help="host:port of a shared cluster-store server "
         "(`python -m karpenter_tpu store-server`); this process becomes a "
         "store CLIENT (state/remote.py) so multiple replicas share one "
-        "durable state and the Lease election is real.  The in-process "
-        "store is used when omitted — then each replica simulates an "
-        "independent cluster and replicas MUST be 1",
+        "durable state and the Lease election is real.  A comma-separated "
+        "list names a SHARDED store topology (docs/designs/store-scale.md "
+        "§sharding): keys partition across the listed servers in order, "
+        "Leases pin to the first.  The in-process store is used when "
+        "omitted — then each replica simulates an independent cluster and "
+        "replicas MUST be 1",
     )
     parser.add_argument(
         "--leader-elect",
@@ -183,19 +186,30 @@ def main(argv=None) -> int:
     if args.store_address:
         from karpenter_tpu.state.remote import RemoteKubeStore
 
-        host, _, port = args.store_address.partition(":")
+        addresses = []
+        for addr in args.store_address.split(","):
+            host, _, port = addr.strip().partition(":")
+            addresses.append((host, int(port) if port else 8082))
         # the operator's default registry: the client half of the store
         # plane (karpenter_store_rpc_seconds, byte counters, StoreResync
         # events) lands on this process's /metrics and flight recorder
         kube = RemoteKubeStore(
-            host,
-            int(port) if port else 8082,
+            addresses[0][0],
+            addresses[0][1],
             identity=identity,
             codec=settings.store_codec,
             registry=REGISTRY,
             events_cap=settings.store_events_cap,
+            # 2+ addresses name a sharded topology: keys partition across
+            # the servers in listed order, Leases pin to the first
+            shards=addresses if len(addresses) > 1 else None,
         )
-        log.info("shared cluster store at %s", args.store_address)
+        log.info(
+            "shared cluster store at %s (%d shard%s)",
+            args.store_address,
+            len(addresses),
+            "" if len(addresses) == 1 else "s",
+        )
     else:
         kube = KubeStore()
     elector = None

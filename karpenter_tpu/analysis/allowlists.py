@@ -130,6 +130,20 @@ _LOCK_BLOCKING = frozenset(
         # the ring lock — the lock is what keeps sink lines in seq
         # order; payloads are single events, never snapshot-sized.
         ("karpenter_tpu/obs/events.py", "EventLedger.emit"),
+        # The durable log's lock is what keeps on-disk records in seq
+        # order — encode+write+fsync MUST sit inside it or a concurrent
+        # append could interleave frames and corrupt the segment.  The
+        # payload is one commit batch (bounded by the batcher), and the
+        # caller already serialized on the store lock: durability-
+        # before-ack is the contract under test, not an accident.
+        ("karpenter_tpu/state/storelog.py", "DurableReplayLog.append_batch"),
+        # Checkpoints write snapshot-sized payloads, but to a TMP file
+        # finalized by an atomic rename; the lock orders the segment
+        # swap against concurrent appends so recovery's "last
+        # checkpoint + contiguous tail" invariant can never observe a
+        # half-swapped segment.
+        ("karpenter_tpu/state/storelog.py",
+         "DurableReplayLog.write_checkpoint"),
     }
 )
 
@@ -185,6 +199,14 @@ SANITIZER_BLOCKING_LOCKS = frozenset(
         # before the store lock drops (store_server.py's documented
         # contract — the static serve_watch allowlist's runtime twin)
         "VersionedStore.lock",
+        # per-shard RPC serialization: one in-flight request per shard
+        # socket is the framing invariant (the sharded twin of
+        # RemoteKubeStore._rpc_lock — each StoreChannel carries its own)
+        "StoreChannel._lock",
+        # durability-before-ack: encode+write+fsync hold the log lock
+        # so disk records stay in seq order (the runtime twin of the
+        # static storelog.py allowlist entries above)
+        "DurableReplayLog._lock",
     }
 )
 

@@ -413,7 +413,12 @@ class EventDocRule(Rule):
         return out
 
 
-_STORE_FRAME_FILES = ("service/store_server.py", "state/remote.py")
+_STORE_FRAME_FILES = (
+    "service/store_server.py",
+    "state/remote.py",
+    "service/shardrouter.py",
+    "state/storelog.py",
+)
 _STORE_FRAME_KEYS = frozenset({"method", "type"})
 
 

@@ -312,6 +312,75 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "compacted_seq now snapshot), 'events' (the durable "
         "cluster-event ledger dropped its oldest entries)",
     ),
+    # ---- durable log + sharding (docs/designs/store-scale.md, PR 17)
+    "karpenter_store_log_records_total": (
+        "counter",
+        "(none)",
+        "records appended to the durable replay log (batch and "
+        "checkpoint alike), each length-prefixed, encoded, and fsynced "
+        "per the log's fsync policy before the commit acks",
+    ),
+    "karpenter_store_log_bytes_total": (
+        "counter",
+        "(none)",
+        "bytes appended to the durable replay log segment, length "
+        "prefixes included",
+    ),
+    "karpenter_store_log_checkpoints_total": (
+        "counter",
+        "(none)",
+        "full-snapshot checkpoints written to a fresh segment "
+        "(tmp + fsync + atomic rename); recovery reads the LAST "
+        "checkpoint plus its contiguous batch tail",
+    ),
+    "karpenter_store_log_torn_records_total": (
+        "counter",
+        "(none)",
+        "records discarded at recovery because the segment tail was "
+        "torn mid-write (truncated length prefix, short payload, or "
+        "undecodable bytes); everything before the tear is kept — a "
+        "torn tail is a crash artifact, never an error",
+    ),
+    "karpenter_store_log_failures_total": (
+        "counter",
+        "(none)",
+        "append/fsync failures after which the log failed CLOSED "
+        "(inert for the rest of the process) while the in-memory "
+        "store kept serving; a restart from a failed log loses the "
+        "un-fsynced suffix, so alert on any nonzero delta",
+    ),
+    "karpenter_store_epoch_rotations_total": (
+        "counter",
+        "reason",
+        "store epoch rotations ('recovery_tail_lost' — the durable "
+        "log could not prove continuity at restart; 'shard_import' / "
+        "'shard_drop' — a key migration changed this shard's key set); "
+        "every rotation forces connected watchers onto a full snapshot "
+        "resync, which is exactly the safety the rotation buys",
+    ),
+    "karpenter_store_shard_migration_begun_total": (
+        "counter",
+        "shard",
+        "reshard export fences raised on a source shard by the "
+        "coordinator (service/shardrouter.py); pairs with "
+        "..._committed_total — a begun without a commit is a shard "
+        "stuck in migration (the doctor names it)",
+    ),
+    "karpenter_store_shard_migration_committed_total": (
+        "counter",
+        "shard",
+        "reshard migrations committed on a source shard: every "
+        "exported key was imported at its new owner (import-before-"
+        "drop) and the source's drop landed",
+    ),
+    "karpenter_sim_wire_faults_total": (
+        "counter",
+        "fault",
+        "scripted wire faults injected by the shard-chaos scenario "
+        "(sim/faults.py: drop, zero_frame, truncated_frame, "
+        "garbled_payload, delay); each must cost the client one retry "
+        "and zero wrong answers",
+    ),
     # ---- diagnosis layer (docs/designs/observability.md, PR 7)
     "karpenter_reconcile_tick_duration_seconds": (
         "histogram",

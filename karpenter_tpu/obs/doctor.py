@@ -226,6 +226,35 @@ def suspected_causes(
                 f"pending peaked at {max(pending[i:], default=0)} afterwards"
             )
 
+    # shard stuck in migration: a store shard raised its export fence
+    # (migration begun) but the coordinator never confirmed the drop
+    # (migration committed) — the shard is serving with a rotated epoch
+    # and keys that may already live at their new owner.  Matched
+    # per-shard so a commit on one shard cannot mask a stall on another.
+    begun: Dict[str, float] = {}
+    committed: Dict[str, float] = {}
+    last_begun_tick: Dict[str, int] = {}
+    for i, tick in enumerate(ticks):
+        for key, delta in tick.get("counters", {}).items():
+            name, labels = _parse_series(key)
+            shard = labels.get("shard", "?")
+            if name == "karpenter_store_shard_migration_begun_total":
+                begun[shard] = begun.get(shard, 0.0) + float(delta)
+                last_begun_tick[shard] = i
+            elif name == "karpenter_store_shard_migration_committed_total":
+                committed[shard] = committed.get(shard, 0.0) + float(delta)
+    for shard in sorted(begun):
+        pending_migrations = begun[shard] - committed.get(shard, 0.0)
+        if pending_migrations > 0:
+            causes.append(
+                f"store shard {shard} stuck in migration: "
+                f"{int(begun[shard])} migration(s) begun but only "
+                f"{int(committed.get(shard, 0.0))} committed (last begun "
+                f"at tick {last_begun_tick[shard]}) — its export fence "
+                "rotated the epoch but the key drop never landed; "
+                "re-run the reshard or restore the old topology"
+            )
+
     # ---- device observatory rules (obs/device.py tick sections) -------
     dev = device_sections(ticks)
     compiles = [int(d.get("compiles", 0) or 0) for d in dev]

@@ -55,8 +55,26 @@ def encode(meta: dict, arrays: Dict[str, np.ndarray]) -> bytes:
 
 
 def decode(payload: bytes) -> Tuple[dict, Dict[str, np.ndarray]]:
+    # bounds-check the length prefix BEFORE trusting it: a zero-length
+    # or truncated payload (torn disk tail, half-written socket frame)
+    # must surface as the one malformed-frame error type every reader
+    # already handles (ValueError), never a stray struct.error from the
+    # unpack or a JSONDecodeError from a short header slice
+    if len(payload) < 4:
+        raise ValueError(
+            f"truncated frame: {len(payload)} bytes, need a 4-byte "
+            "header length"
+        )
     (hlen,) = struct.unpack(">I", payload[:4])
-    header = json.loads(payload[4 : 4 + hlen].decode())
+    if 4 + hlen > len(payload):
+        raise ValueError(
+            f"truncated frame: header declares {hlen} bytes, "
+            f"{len(payload) - 4} present"
+        )
+    try:
+        header = json.loads(payload[4 : 4 + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise ValueError(f"malformed frame header: {exc}") from exc
     blob = payload[4 + hlen :]
     arrays: Dict[str, np.ndarray] = {}
     for m in header.pop("arrays", []):

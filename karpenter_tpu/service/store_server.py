@@ -84,6 +84,7 @@ from karpenter_tpu.service.codec import (
     recv_frame,
     send_frame,
 )
+from karpenter_tpu.service.shardrouter import shard_of
 from karpenter_tpu.service.watchclient import WatchChannelClient
 from karpenter_tpu.state.binwire import (
     Raw,
@@ -92,6 +93,7 @@ from karpenter_tpu.state.binwire import (
     encode_value,
 )
 from karpenter_tpu.state.kube import KubeStore
+from karpenter_tpu.state.storelog import DurableReplayLog, FSYNC_ALWAYS
 from karpenter_tpu.state.wire import STORE_KINDS, materialize, to_wire
 from karpenter_tpu.utils.trace import Tracer
 
@@ -107,6 +109,7 @@ _WRITE_METHODS = frozenset(
     {
         "put", "delete", "bind_pod", "evict_pod", "record_event",
         "lease_acquire", "lease_renew", "lease_release",
+        "shard_import", "shard_drop",
     }
 )
 
@@ -251,6 +254,7 @@ class VersionedStore:
         replay_log_events: int = REPLAY_LOG_EVENTS,
         watch_queue_batches: int = WATCH_QUEUE_BATCHES,
         events_cap: int = EVENTS_CAP,
+        durable_log: Optional[DurableReplayLog] = None,
     ):
         self.kube = kube or KubeStore()
         self.lock = make_rlock("VersionedStore.lock")
@@ -284,6 +288,151 @@ class VersionedStore:
         self._recorded: List[dict] = []
         self._rec_objs: List[object] = []
         self.kube.watch(self._record)
+        # the crash-durable half (state/storelog.py): every commit
+        # appends its bin-rendered batch; construction RECOVERS the
+        # previous incarnation's state — objects, rvs, lease CAS seqs,
+        # epoch, and the replay-log tail — so a restarted store serves
+        # DELTA resyncs from disk instead of forcing a snapshot storm
+        self.durable_log = durable_log
+        if durable_log is not None:
+            self._recover_from_log()
+
+    # ------------------------------------------------------------ durability
+    def _recover_from_log(self) -> None:
+        """Adopt the durable segment's state: checkpoint snapshot first
+        (objects + rvs verbatim — NO re-commit, these mutations already
+        broadcast in the previous life), then the batch tail, which also
+        repopulates the in-memory replay log so pre-restart watch
+        cursors stay covered.  Re-adopting the previous EPOCH is the
+        point: a recovered store is a continuation of the same seq
+        space, not a new one.  A fresh segment writes a genesis
+        checkpoint so even the first incarnation's epoch survives."""
+        dlog = self.durable_log
+        checkpoint, batches = dlog.recover()
+        if checkpoint is None and not batches:
+            with self.lock:
+                self._checkpoint_locked()
+            return
+        with self.lock:
+            if checkpoint is not None:
+                snap = checkpoint.get("snapshot") or {}
+                self.rvs = {}
+                for kind, (_cls, attr, _key_fn) in STORE_KINDS.items():
+                    store_dict = getattr(self.kube, attr)
+                    store_dict.clear()
+                    for key, entry in snap.get("kinds", {}).get(
+                        kind, {}
+                    ).items():
+                        store_dict[key] = materialize(entry["obj"])
+                        self.rvs[(kind, key)] = entry["rv"]
+                self.rv = checkpoint.get("rv", 0)
+                self.event_rv = checkpoint.get("event_rv", 0)
+                self.lease_seq = dict(checkpoint.get("lease_seq", {}))
+                self.kube.events = [
+                    materialize(e)
+                    for e in snap.get("events", [])[-self.events_cap:]
+                ]
+                self.epoch = str(checkpoint.get("epoch") or self.epoch)
+                self.log_seq = checkpoint.get("seq", 0)
+                self.compacted_seq = self.log_seq
+            for rec in batches:
+                metas: List[dict] = []
+                bins: List[Raw] = []
+                for ev in rec.get("events", ()):
+                    meta = self._recover_event(ev)
+                    if meta is None:
+                        continue
+                    metas.append(meta)
+                    bins.append(Raw(encode_value(ev)))
+                batch = _Batch(rec["seq"], metas, None, bins)
+                self.replay_log.append(batch)
+                self._log_events += len(metas)
+                self.log_seq = rec["seq"]
+                self.epoch = str(rec.get("epoch") or self.epoch)
+            # the in-memory bound still applies to the recovered tail:
+            # compaction advances compacted_seq exactly as _commit does
+            while (
+                self._log_events > self.replay_log_events
+                and len(self.replay_log) > 1
+            ):
+                dropped = self.replay_log.popleft()
+                self._log_events -= len(dropped.metas)
+                self.compacted_seq = dropped.seq
+            dlog.batches_since_checkpoint = len(batches)
+
+    def _recover_event(self, ev) -> Optional[dict]:
+        """Apply one recovered batch event to the kube dicts (verbatim,
+        like apply_replicated — cascades already materialized in the
+        recorded stream).  Returns the event's meta, or None for an
+        unrecognized kind (a segment from a newer build)."""
+        if not isinstance(ev, dict):
+            return None
+        if ev.get("kind") == "Event":
+            tup = materialize(ev.get("event"))
+            if ev.get("event_rv", 0) > self.event_rv:
+                self.event_rv = ev["event_rv"]
+                self.kube.events.append(tup)
+                self._trim_events_locked()
+            return {
+                "kind": "Event",
+                "verb": "append",
+                "event_rv": ev.get("event_rv", 0),
+            }
+        spec = STORE_KINDS.get(ev.get("kind"))
+        if spec is None:
+            return None
+        _cls, attr, _key_fn = spec
+        key, rv = ev["key"], ev["rv"]
+        store_dict = getattr(self.kube, attr)
+        if ev["verb"] == "delete":
+            store_dict.pop(key, None)
+        else:
+            store_dict[key] = materialize(ev["obj"])
+        self.rvs[(ev["kind"], key)] = rv
+        self.rv = max(self.rv, rv)
+        return {
+            "rv": rv, "kind": ev["kind"], "verb": ev["verb"], "key": key,
+        }
+
+    def _checkpoint_locked(self) -> None:
+        """Lock held: rewrite the durable segment as one checkpoint
+        record.  The bin snapshot references live objects, so rendering
+        must finish before the lock drops — same contract as
+        serve_watch's bin resync."""
+        self.durable_log.write_checkpoint(
+            self.epoch,
+            self.log_seq,
+            self.rv,
+            self.event_rv,
+            self.lease_seq,
+            self.snapshot(CODEC_BIN),
+        )
+
+    def rotate_epoch(self, reason: str = "migration") -> None:
+        """Fence every outstanding cursor: new epoch id, replay log
+        reset, every subscriber forced onto its own resync.  The
+        migration primitive — after an import/drop changed what this
+        shard owns, no cursor minted before the change may claim
+        coverage across it (a replayed gap would silently miss the
+        ownership delta).  Checkpoints the durable log so the NEW epoch
+        is what a post-crash recovery re-adopts."""
+        with self.lock:
+            self.replay_log.clear()
+            self._log_events = 0
+            self.log_seq += 1
+            self.compacted_seq = self.log_seq
+            self.epoch = os.urandom(8).hex()
+            self.registry.inc(
+                "karpenter_store_epoch_rotations_total", {"reason": reason}
+            )
+            for sub in self._subscribers:
+                if not sub.closed:
+                    sub.batches.clear()
+                    sub.pending_resync = True
+                    sub.forced_reason = "epoch"
+                    sub.cond.notify_all()
+            if self.durable_log is not None:
+                self._checkpoint_locked()
 
     # ------------------------------------------------------------ recording
     def _record(self, kind: str, verb: str, obj) -> None:
@@ -342,8 +491,16 @@ class VersionedStore:
         originator's codec plus whatever the live subscribers speak —
         an all-binary plane never builds a JSON tree."""
         self.log_seq += 1
-        need_bin = origin_codec == CODEC_BIN or any(
-            s.codec == CODEC_BIN and not s.closed for s in self._subscribers
+        # a durable log always needs the bin rendering: the disk record
+        # IS the batch's bin events (rendered once here, under the lock
+        # where live objects are safe, then reused by the watch fan-out)
+        need_bin = (
+            origin_codec == CODEC_BIN
+            or self.durable_log is not None
+            or any(
+                s.codec == CODEC_BIN and not s.closed
+                for s in self._subscribers
+            )
         )
         need_json = origin_codec == CODEC_JSON or any(
             s.codec == CODEC_JSON and not s.closed for s in self._subscribers
@@ -370,6 +527,12 @@ class VersionedStore:
                 bin_events.append(Raw(encode_value(native)))
         batch = _Batch(self.log_seq, metas, json_events, bin_events)
         note_access("VersionedStore.replay_log")  # lockset witness
+        if self.durable_log is not None:
+            self.durable_log.append_batch(
+                self.log_seq, self.epoch, batch.bin_events()
+            )
+            if self.durable_log.checkpoint_due():
+                self._checkpoint_locked()
         self.replay_log.append(batch)
         self._log_events += len(metas)
         while (
@@ -622,6 +785,79 @@ class VersionedStore:
                     sub.forced_reason = "epoch"
                     sub.cond.notify_all()
 
+    # ------------------------------------------------------------- migration
+    def export_entries(
+        self, self_index: int, new_n: int
+    ) -> Dict[str, List[dict]]:
+        """Read-only migration scan: every key this shard holds whose
+        owner under an ``new_n``-shard topology is NOT this shard,
+        grouped by new owner (string keys — the groups ride a JSON
+        control-plane frame).  Leases never export: they are pinned to
+        ``LEASE_SHARD`` under every topology (service/shardrouter.py),
+        so the leadership CAS space never migrates."""
+        out: Dict[str, List[dict]] = {}
+        with self.lock:
+            for kind, (_cls, attr, key_fn) in STORE_KINDS.items():
+                if kind == "Lease":
+                    continue
+                for key, obj in getattr(self.kube, attr).items():
+                    owner = shard_of(kind, key, new_n)
+                    if owner == self_index:
+                        continue
+                    out.setdefault(str(owner), []).append(
+                        {
+                            "kind": kind,
+                            "key": key,
+                            "rv": self.rvs.get((kind, key), 0),
+                            "obj": to_wire(obj),
+                        }
+                    )
+        return out
+
+    def import_entries(self, entries) -> int:
+        """Adopt migrated keys VERBATIM — object bytes and per-key rv
+        both (the rv travels with the key, so a client whose dirty
+        flush carries an old-owner base_rv still fences correctly at
+        the new owner).  Ends with an epoch rotation: ownership
+        changed, so no pre-import cursor may claim coverage."""
+        n = 0
+        with self.lock:
+            for e in entries:
+                spec = STORE_KINDS.get(e.get("kind"))
+                if spec is None or e.get("kind") == "Lease":
+                    continue
+                _cls, attr, _key_fn = spec
+                rv = e.get("rv", 0)
+                getattr(self.kube, attr)[e["key"]] = materialize(e["obj"])
+                self.rvs[(e["kind"], e["key"])] = rv
+                # adopt at least the imported rv space's high-water
+                # mark: this shard's future commits must stamp rvs
+                # ABOVE every imported one, or a client's stale-echo
+                # check would drop fresh writes to migrated keys
+                self.rv = max(self.rv, rv)
+                n += 1
+            self.rotate_epoch("migration")
+        return n
+
+    def drop_keys(self, keys) -> int:
+        """Drop migrated keys WITHOUT verb cascades (delete_node would
+        re-pend its pods — but those pods moved WITH their node; the
+        ownership transfer is not a semantic delete).  Epoch-rotates
+        like import: the fence is what keeps a cursor from spanning
+        the ownership change."""
+        n = 0
+        with self.lock:
+            for kind, key in keys:
+                spec = STORE_KINDS.get(kind)
+                if spec is None:
+                    continue
+                _cls, attr, _key_fn = spec
+                if getattr(self.kube, attr).pop(key, None) is not None:
+                    n += 1
+                self.rvs.pop((kind, key), None)
+            self.rotate_epoch("migration")
+        return n
+
     def close_subscribers(self) -> None:
         with self.lock:
             for sub in self._subscribers:
@@ -722,9 +958,14 @@ class StoreServer(socketserver.ThreadingTCPServer):
         codecs: Tuple[str, ...] = (CODEC_BIN, CODEC_JSON),
         legacy_protocol: bool = False,
         replica_of: Optional[Tuple[str, int]] = None,
+        shard_index: int = 0,
     ):
         super().__init__((host, port), _Handler)
         self.store = store or VersionedStore()
+        # this server's position in the shard topology (0 for the
+        # unsharded single-store deployment): shard_export computes
+        # "what do I no longer own?" relative to it
+        self.shard_index = shard_index
         self.codecs = tuple(codecs)
         self.legacy_protocol = legacy_protocol
         self.replica_of = replica_of
@@ -741,6 +982,9 @@ class StoreServer(socketserver.ThreadingTCPServer):
         self.ledger = EventLedger(registry=self.registry)
         self.registry.ledger = self.ledger
         self.store.registry = self.registry
+        if self.store.durable_log is not None:
+            # the log's counters land on the serving process's surface
+            self.store.durable_log.registry = self.registry
         # live connections, so stop() can sever them: a stopped server
         # must not keep answering established RPC sockets from daemon
         # handler threads (a real process exit closes them; the
@@ -806,6 +1050,7 @@ class StoreServer(socketserver.ThreadingTCPServer):
                     "status": "ok",
                     "rv": store.rv,
                     "seq": store.log_seq,
+                    "epoch": store.epoch,
                     "event_count": len(store.kube.events),
                     "read_only": self.read_only,
                 }
@@ -872,6 +1117,19 @@ class StoreServer(socketserver.ThreadingTCPServer):
             return self._lease_renew(header)
         if method == "lease_release":
             return self._lease_release(header, codec)
+        if method == "shard_export":
+            entries = store.export_entries(
+                self.shard_index, int(header.get("new_n", 1))
+            )
+            return {"status": "ok", "entries": entries}
+        if method == "shard_import":
+            imported = store.import_entries(header.get("entries", ()))
+            return {"status": "ok", "imported": imported,
+                    "epoch": store.epoch}
+        if method == "shard_drop":
+            dropped = store.drop_keys(header.get("keys", ()))
+            return {"status": "ok", "dropped": dropped,
+                    "epoch": store.epoch}
         return {"status": "error", "error": f"unknown method {method}"}
 
     def _put(self, header: dict, codec: str = CODEC_JSON) -> dict:
@@ -1380,16 +1638,51 @@ def main(argv=None) -> int:
         action="store_true",
         help="disable bin1 negotiation (tagged JSON only)",
     )
+    parser.add_argument(
+        "--log-dir",
+        default="",
+        help="directory for the crash-durable replay segment; empty "
+        "disables durability (a restart forces snapshot resyncs). "
+        "A restarted server re-adopts its epoch from the segment and "
+        "serves DELTA resyncs from disk",
+    )
+    parser.add_argument(
+        "--log-fsync",
+        default=FSYNC_ALWAYS,
+        choices=("always", "off"),
+        help="fsync policy for the durable replay log: 'always' syncs "
+        "every append (crash loses nothing acknowledged), 'off' leaves "
+        "flushing to the OS (crash may lose the unsynced tail, which "
+        "recovery drops as torn)",
+    )
+    parser.add_argument(
+        "--shard-index",
+        type=int,
+        default=0,
+        help="this server's index in the key-sharded store topology "
+        "(0 for the unsharded deployment); shard_export routes moving "
+        "keys relative to it",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     replica_of = None
     if args.replica_of:
         rhost, _, rport = args.replica_of.partition(":")
         replica_of = (rhost, int(rport) if rport else 8082)
+    durable_log = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        durable_log = DurableReplayLog(
+            os.path.join(
+                args.log_dir, f"store-shard-{args.shard_index}.log"
+            ),
+            fsync=args.log_fsync,
+        )
     store = VersionedStore(
         replay_log_events=args.replay_log_events,
         watch_queue_batches=args.watch_queue_batches,
         events_cap=args.events_cap,
+        durable_log=durable_log,
     )
     server = StoreServer(
         args.host,
@@ -1397,6 +1690,7 @@ def main(argv=None) -> int:
         store=store,
         codecs=(CODEC_JSON,) if args.json_only else (CODEC_BIN, CODEC_JSON),
         replica_of=replica_of,
+        shard_index=args.shard_index,
     )
     telemetry = None
     if args.telemetry_port:

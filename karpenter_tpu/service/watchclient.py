@@ -60,6 +60,11 @@ class WatchChannelClient:
       marks the handshake's first sync frame)
     - ``on_live(sock_or_none)`` → expose/clear the blocking socket so
       ``close()`` elsewhere can interrupt the recv
+    - ``pace(delay_s)`` → wait out one reconnect backoff; returns True
+      to stop the loop.  Production's default waits the exponential
+      backoff on the stop event (wall clock); the fleet simulator
+      injects a deterministic pacer so scripted disconnects reconnect
+      on SIMULATED time and record/replay traces stay byte-identical.
     """
 
     def __init__(
@@ -76,6 +81,7 @@ class WatchChannelClient:
         on_live: Optional[Callable] = None,
         backoff_s: float = 0.05,
         backoff_max: float = 1.0,
+        pace: Optional[Callable[[float], bool]] = None,
     ):
         self.dial = dial
         self.hello = hello
@@ -88,6 +94,10 @@ class WatchChannelClient:
         self.on_live = on_live or (lambda _sock: None)
         self.backoff_s = backoff_s
         self.backoff_max = backoff_max
+        # the reconnect-backoff seam: all waiting routes through ONE
+        # injectable callable (stop.wait keeps production's wall-clock
+        # exponential backoff AND stays responsive to close())
+        self.pace = pace or self.stop.wait
 
     def run(self) -> None:
         backoff = self.backoff_s
@@ -114,7 +124,7 @@ class WatchChannelClient:
                         decode_payload(self.rx(sock, codec), codec), False
                     )
             except RECONNECT_ERRORS:
-                if self.stop.wait(backoff):
+                if self.pace(backoff):
                     break
                 backoff = min(backoff * 2, self.backoff_max)
             finally:

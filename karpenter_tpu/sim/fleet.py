@@ -32,7 +32,12 @@ preserved key-for-key.
 from __future__ import annotations
 
 import hashlib
+import os
 import random
+import shutil
+import socket
+import tempfile
+import threading
 from types import SimpleNamespace
 from typing import Dict, List, Optional, Tuple
 
@@ -40,17 +45,29 @@ from karpenter_tpu.api import NodeClass, NodePool, Pod, Resources, Settings
 from karpenter_tpu.api import labels as L
 from karpenter_tpu.api.objects import (
     SelectorTerm,
+    StorageClass,
     reset_name_sequences,
     tolerates_all,
 )
 from karpenter_tpu.cloud.fake.backend import FakeCloud, generate_catalog
 from karpenter_tpu.metrics.registry import Registry
 from karpenter_tpu.operator import Operator
-from karpenter_tpu.service.codec import CODEC_JSON
+from karpenter_tpu.service.codec import (
+    CODEC_BIN,
+    CODEC_JSON,
+    decode_payload,
+    encode_payload,
+    recv_frame,
+    send_frame,
+)
+from karpenter_tpu.service.shardrouter import ShardCoordinator
 from karpenter_tpu.service.store_server import StoreServer, VersionedStore
+from karpenter_tpu.sim.faults import FailingFsync, WireFaultInjector
 from karpenter_tpu.sim.trace import TraceWriter, read_trace
+from karpenter_tpu.state.binwire import SCHEMA_FP
 from karpenter_tpu.state.kube import Node
 from karpenter_tpu.state.remote import RemoteKubeStore
+from karpenter_tpu.state.storelog import FSYNC_ALWAYS, DurableReplayLog
 from karpenter_tpu.state.wire import canonical
 from karpenter_tpu.testing import FAST_BATCH_WINDOWS
 from karpenter_tpu.utils.clock import FakeClock
@@ -58,6 +75,7 @@ from karpenter_tpu.utils.leader import LEASE_DURATION_S, LeaderElector
 
 TICK_S = 2.0
 SETTLE_MAX_ROUNDS = 60
+FLEET_SHARDS = 4  # initial shard count for the sharded scenario
 
 # the scripted failover storm, as tick fractions of the run: crash the
 # leader, let the standby take over on lease expiry, rejoin, force a
@@ -67,10 +85,25 @@ _CRASH_A, _REJOIN_A, _RELEASE, _CRASH_B, _REJOIN_B = (
     0.2, 0.4, 0.55, 0.7, 0.85,
 )
 
+# the sharded scenario's second storm, layered over the failover storm:
+# kill a shard mid-churn (atomic kill + restart-from-disk at the same
+# address, with a protocol-level delta-resync probe), tear bytes on the
+# wire, split 4 shards into 5 under the migration fence, kill again in
+# the NEW topology, then fail a shard's fsync
+_SHARD_KILL_A, _WIRE_FAULT_A, _SHARD_SPLIT, _WIRE_FAULT_B, _SHARD_KILL_B, _FSYNC_FAIL = (
+    0.25, 0.35, 0.5, 0.6, 0.65, 0.8,
+)
+
 FLEET_SCENARIOS: Dict[str, str] = {
     "store-fleet-chaos": (
         "3 real Operators + a read replica + a wedged watcher against one "
         "store server through seeded churn and a scripted failover storm"
+    ),
+    "store-fleet-shard-chaos": (
+        "3 real Operators against 4 durable store shards through the "
+        "failover storm PLUS shard kills (restart-from-disk, delta "
+        "resync), a live 4->5 split under the migration fence, scripted "
+        "wire faults, and an injected fsync failure"
     ),
 }
 
@@ -161,9 +194,34 @@ class FleetRunner:
         self._gen_rng = random.Random(seed)
         reset_name_sequences()
 
-        self.primary = StoreServer(
-            store=VersionedStore(replay_log_events=64)
-        ).start_background()
+        self.sharded = scenario == "store-fleet-shard-chaos"
+        self._pace_stop = threading.Event()
+        if self.sharded:
+            # N durable shard primaries, each with its own on-disk replay
+            # segment — a killed shard restarts FROM DISK at the same
+            # address and must serve delta resyncs
+            self._log_dir = tempfile.mkdtemp(prefix="fleet-shardlog-")
+            self._fsyncs: List[FailingFsync] = []
+            self._injector = WireFaultInjector()
+            self.shards: List[StoreServer] = [
+                self._make_shard(i) for i in range(FLEET_SHARDS)
+            ]
+            self.shard_addrs: List[Tuple[str, int]] = [
+                s.address for s in self.shards
+            ]
+            self.primary = self.shards[0]
+            self.shard_facts: Dict[str, object] = {
+                "kills": 0,
+                "delta_resyncs": 0,
+                "snapshot_fallbacks": 0,
+                "delta_ratio_max": 0.0,
+                "epoch_preserved": True,
+                "split_moved_keys": 0,
+            }
+        else:
+            self.primary = StoreServer(
+                store=VersionedStore(replay_log_events=64)
+            ).start_background()
         host, port = self.primary.address
         self.replica = StoreServer(
             replica_of=self.primary.address
@@ -184,7 +242,14 @@ class FleetRunner:
         self.kubes: Dict[str, RemoteKubeStore] = {}
         self.names = [f"op-{i}" for i in range(operators)]
         for name in self.names:
-            kube = RemoteKubeStore(host, port, identity=name)
+            if self.sharded:
+                kube = RemoteKubeStore(
+                    identity=name,
+                    shards=self.shard_addrs,
+                    watch_pace=self._pace,
+                )
+            else:
+                kube = RemoteKubeStore(host, port, identity=name)
             elector = LeaderElector(kube, self.clock, name)
             registry = Registry()
             op = Operator(
@@ -209,10 +274,20 @@ class FleetRunner:
             self.kubes[name] = kube
             self.ops[name] = op
         # a passive reader mirroring the READ REPLICA: proves the
-        # replica serves snapshot+watch traffic with primary ordering
+        # replica serves snapshot+watch traffic with primary ordering.
+        # In the sharded scenario a SECOND reader merges all the shards'
+        # watch streams into one mirror (the replica still follows shard
+        # 0, which the kill script never targets).
         self.reader = RemoteKubeStore(
             *self.replica.address, identity="replica-reader"
         )
+        self.merged_reader: Optional[RemoteKubeStore] = None
+        if self.sharded:
+            self.merged_reader = RemoteKubeStore(
+                identity="merged-reader",
+                shards=self.shard_addrs,
+                watch_pace=self._pace,
+            )
         self._led_seqs = {name: 0 for name in self.names}
         self.launches: List[Tuple[int, str, str]] = []
         self.tick_no = -1
@@ -233,9 +308,154 @@ class FleetRunner:
             )
         )
         kube.put_node_pool(NodePool(name="default", node_class_ref="default"))
+        if self.sharded:
+            # ballast corpus: a fleet store's snapshot is dominated by
+            # STANDING state, not the churn since a disconnect — the
+            # delta-vs-snapshot probe (and the whole point of disk-backed
+            # delta resyncs) is only meaningful against that shape.
+            # StorageClasses are inert to the controllers unless a PVC
+            # references one, so they fatten every shard's snapshot
+            # without adding scheduling work.
+            for i in range(400):
+                kube.put_storage_class(
+                    StorageClass(
+                        name=f"ballast-{i}", zones=(f"zone-{i % 4}",)
+                    )
+                )
         self._sync("init")
 
     # ----------------------------------------------------------- plumbing
+    def _pace(self, _delay_s: float) -> bool:
+        """Deterministic watch-reconnect pacer (service/watchclient.py's
+        ``pace`` seam): a short FIXED wall wait instead of the wall-clock
+        exponential backoff, so scripted shard kills reconnect promptly
+        and uniformly — reconnect timing never shapes which tick a
+        resync lands in relative to the sync barriers."""
+        return self._pace_stop.wait(0.02)
+
+    def _make_shard(self, index: int, port: int = 0) -> StoreServer:
+        """One durable shard primary: its replay segment lives in the
+        run's log dir under the shard's index, so a restart at the same
+        index recovers the same segment.  The fsync seam is an armable
+        `FailingFsync` for the scripted fsync-failure event."""
+        fsync = FailingFsync()
+        while len(self._fsyncs) <= index:
+            self._fsyncs.append(fsync)
+        self._fsyncs[index] = fsync
+        dlog = DurableReplayLog(
+            os.path.join(self._log_dir, f"store-shard-{index}.log"),
+            fsync=FSYNC_ALWAYS,
+            fsync_fn=fsync,
+        )
+        return StoreServer(
+            port=port,
+            store=VersionedStore(replay_log_events=64, durable_log=dlog),
+            shard_index=index,
+        ).start_background()
+
+    def _probe_watch(self, srv: StoreServer, since_seq: int, epoch: str):
+        """Protocol-level resync probe: present a (epoch, seq) cursor to
+        ``srv`` and return (mode, first_sync_payload_bytes) — the
+        wire-level fact of whether the server answered with a delta
+        replay or a full snapshot, and how big it was."""
+        sock = socket.create_connection(srv.address, timeout=5.0)
+        try:
+            sock.settimeout(5.0)
+            send_frame(
+                sock,
+                encode_payload(
+                    {
+                        "method": "watch",
+                        "identity": f"chaos-probe-{since_seq}",
+                        "codecs": [CODEC_BIN, CODEC_JSON],
+                        "schema_fp": SCHEMA_FP,
+                        "since_seq": since_seq,
+                        "epoch": epoch,
+                    },
+                    CODEC_JSON,
+                ),
+            )
+            ack = decode_payload(recv_frame(sock), CODEC_JSON)
+            codec = ack.get("codec", CODEC_JSON)
+            payload = recv_frame(sock)
+            frame = decode_payload(payload, codec)
+            return frame.get("mode", "?"), len(payload)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _kill_restart_shard(self, index: int) -> None:
+        """Atomic shard crash: stop the server, restart it at the SAME
+        address from its on-disk replay segment, then prove the wire
+        contract — the recovered store re-adopted its epoch and serves a
+        pre-kill cursor as a DELTA replay an order of magnitude smaller
+        than the snapshot fallback."""
+        srv = self.shards[index]
+        host, port = srv.address
+        pre_epoch, pre_seq = srv.store.epoch, srv.store.log_seq
+        srv.stop()
+        new_srv = self._make_shard(index, port=port)
+        self.shards[index] = new_srv
+        self.shard_addrs[index] = new_srv.address
+        facts = self.shard_facts
+        facts["kills"] = int(facts["kills"]) + 1
+        if new_srv.store.epoch != pre_epoch:
+            facts["epoch_preserved"] = False
+        # a client that was at (pre_epoch, a few batches back) must get
+        # a replay; a cursorless client measures the snapshot cost
+        probe_seq = max(1, pre_seq - 2)
+        mode, delta_bytes = self._probe_watch(new_srv, probe_seq, pre_epoch)
+        _snap_mode, snap_bytes = self._probe_watch(new_srv, 0, "")
+        if mode == "replay":
+            facts["delta_resyncs"] = int(facts["delta_resyncs"]) + 1
+        else:
+            facts["snapshot_fallbacks"] = (
+                int(facts["snapshot_fallbacks"]) + 1
+            )
+        ratio = round(delta_bytes / max(1, snap_bytes), 2)
+        facts["delta_ratio_max"] = max(
+            float(facts["delta_ratio_max"]), ratio
+        )
+
+    def _split_shards(self) -> None:
+        """Live 4->5 reshard: start the new shard, migrate every moving
+        key under the epoch fence (import-before-drop), then re-point
+        every client at the new topology."""
+        old = list(self.shard_addrs)
+        new_srv = self._make_shard(len(self.shards))
+        self.shards.append(new_srv)
+        self.shard_addrs.append(new_srv.address)
+        stats = ShardCoordinator().reshard(old, self.shard_addrs)
+        self.shard_facts["split_moved_keys"] = stats["moved_keys"]
+        for kube in self.kubes.values():
+            kube.apply_topology(self.shard_addrs)
+        if self.merged_reader is not None:
+            self.merged_reader.apply_topology(self.shard_addrs)
+
+    def _merged_kube(self):
+        """The digest's view of authoritative truth: in the sharded
+        scenario, the union of every shard's store (key spaces are
+        disjoint by ownership), duck-typing the digest's KubeStore
+        surface."""
+        if not self.sharded:
+            return self.primary.store.kube
+        merged: Dict[str, dict] = {
+            attr: {} for attr in ("pods", "nodes", "node_claims", "node_pools")
+        }
+        for srv in self.shards:
+            with srv.store.lock:
+                for attr, into in merged.items():
+                    into.update(getattr(srv.store.kube, attr))
+        ns = SimpleNamespace(**merged)
+        ns.pending_pods = lambda: [
+            p
+            for p in ns.pods.values()
+            if p.phase == "Pending" and not p.node_name
+        ]
+        return ns
+
     def _instrument_launches(self, op: Operator, name: str) -> None:
         orig = op.cloud_provider.create
 
@@ -253,6 +473,27 @@ class FleetRunner:
                     f"synced_rv={kube.synced_rv} "
                     f"server_rv={self.primary.store.rv}"
                 )
+        if self.sharded:
+            # a sharded mirror's dict INSERTION order is arrival order
+            # across N watch streams — wall-clock nondeterministic even
+            # though the content is fully synced.  The controllers
+            # iterate those dicts, so decision order (and with it the
+            # byte-compared trace) would leak thread pacing: re-sort
+            # every mirror to key order at each barrier.  Content is
+            # untouched; this is the sharded analogue of the single
+            # stream's commit-order insertion.
+            for kube in self.kubes.values():
+                with kube._mirror_lock:
+                    for attr in (
+                        "pods",
+                        "nodes",
+                        "node_claims",
+                        "node_pools",
+                        "storage_classes",
+                    ):
+                        d = getattr(kube, attr)
+                        for key in sorted(d):
+                            d[key] = d.pop(key)
 
     def _violation(self, msg: str) -> None:
         self.violations.append(f"tick {self.tick_no}: {msg}")
@@ -348,6 +589,41 @@ class FleetRunner:
             events.append(("op_crash", {"replica": leader}))
         if at(_REJOIN_B):
             events.append(("op_rejoin", {"replica": ""}))
+
+        if self.sharded:
+            # the shard storm rides ON TOP of the failover storm; every
+            # choice (victim shard, fault kind, faulted operator) is
+            # resolved here and recorded, like all chaos decisions.
+            # Kills never target shard 0: it owns the Leases and feeds
+            # the read replica — both pinned by design.
+            from karpenter_tpu.sim.faults import WIRE_FAULTS
+
+            if at(_SHARD_KILL_A) or at(_SHARD_KILL_B):
+                events.append(
+                    (
+                        "shard_kill",
+                        {"shard": rng.randrange(1, len(self.shards))},
+                    )
+                )
+            if at(_WIRE_FAULT_A) or at(_WIRE_FAULT_B):
+                events.append(
+                    (
+                        "wire_fault",
+                        {
+                            "fault": rng.choice(sorted(WIRE_FAULTS)),
+                            "op": rng.choice(self.names),
+                        },
+                    )
+                )
+            if at(_SHARD_SPLIT):
+                events.append(("shard_split", {}))
+            if at(_FSYNC_FAIL):
+                events.append(
+                    (
+                        "fsync_fail",
+                        {"shard": rng.randrange(len(self.shards))},
+                    )
+                )
         return events
 
     def _apply_event(self, kind: str, data: dict) -> None:
@@ -376,6 +652,19 @@ class FleetRunner:
         elif kind == "op_release":
             self.release_pending.add(data["replica"])
             self.failover_ticks.add(self.tick_no)
+        elif kind == "shard_kill":
+            self._kill_restart_shard(int(data["shard"]))
+        elif kind == "shard_split":
+            self._split_shards()
+        elif kind == "wire_fault":
+            # poison the op's LAST channel (never the lease shard): the
+            # next RPC through it must classify the torn bytes as
+            # reconnect-worthy and heal on retry
+            self._injector.inject(
+                self.kubes[data["op"]]._channels[-1], data["fault"]
+            )
+        elif kind == "fsync_fail":
+            self._fsyncs[int(data["shard"])].arm()
 
     # --------------------------------------------------------------- tick
     def _tick(
@@ -469,7 +758,7 @@ class FleetRunner:
 
     def _digest(self, tick: int, leader: str) -> None:
         env = SimpleNamespace(
-            kube=self.primary.store.kube, cloud=self.cloud, clock=self.clock
+            kube=self._merged_kube(), cloud=self.cloud, clock=self.clock
         )
         self.trace.digest(tick, env)
         h = hashlib.sha256()
@@ -611,11 +900,54 @@ class FleetRunner:
         if not reader_synced:
             self._violation("replica reader mirror diverged")
 
+        merged_reader_synced = True
+        if self.sharded and self.merged_reader is not None:
+            # the merged-stream mirror must converge on the UNION of all
+            # shards — proving the per-channel cursors never dropped or
+            # cross-credited a shard's events through kills, splits, and
+            # wire faults
+            deadline = wall.now() + 15.0
+            merged_reader_synced = False
+            while wall.now() < deadline:
+                mk = self._merged_kube()
+                if set(self.merged_reader.pods) == set(mk.pods) and set(
+                    self.merged_reader.nodes
+                ) == set(mk.nodes):
+                    merged_reader_synced = all(
+                        canonical(self.merged_reader.pods[k])
+                        == canonical(v)
+                        for k, v in mk.pods.items()
+                    )
+                    if merged_reader_synced:
+                        break
+                wall.sleep(0.02)
+            if not merged_reader_synced:
+                self._violation("merged shard reader diverged")
+
         store = self.primary.store
         compactions = self.primary.registry.counter(
             "karpenter_store_compactions_total", {"log": "replay"}
         )
-        return {
+        shards_section = None
+        if self.sharded:
+            if not self.shard_facts["epoch_preserved"]:
+                self._violation("restarted shard lost its epoch")
+            if int(self.shard_facts["snapshot_fallbacks"]) > 0:
+                self._violation(
+                    "restarted shard fell back to snapshot resync"
+                )
+            if float(self.shard_facts["delta_ratio_max"]) >= 0.1:
+                self._violation(
+                    "post-restart delta resync not < 10% of snapshot"
+                )
+            shards_section = {
+                "n": len(self.shards),
+                **self.shard_facts,
+                "wire_faults": dict(sorted(self._injector.injected.items())),
+                "fsync_failures": sum(f.failures for f in self._fsyncs),
+                "merged_reader_synced": merged_reader_synced,
+            }
+        report = {
             "scenario": self.scenario,
             "seed": self.seed,
             "ticks": self.ticks,
@@ -643,13 +975,24 @@ class FleetRunner:
             },
             "invariants": {"violations": self.violations},
         }
+        if shards_section is not None:
+            report["shards"] = shards_section
+        return report
 
     def close(self) -> None:
+        self._pace_stop.set()
         for kube in self.kubes.values():
             kube.close()
         self.reader.close()
+        if self.merged_reader is not None:
+            self.merged_reader.close()
         self.replica.stop()
-        self.primary.stop()
+        if self.sharded:
+            for srv in self.shards:
+                srv.stop()
+            shutil.rmtree(self._log_dir, ignore_errors=True)
+        else:
+            self.primary.stop()
         self.trace.close()
 
 

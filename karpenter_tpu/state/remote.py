@@ -33,6 +33,17 @@ Consistency model:
   request timeouts raise `StoreUnavailableError` (retryable) instead of
   hanging.  The watch thread reconnects and resyncs from a fresh
   snapshot, so a store restart mid-watch heals itself.
+- **Sharding**: ``shards=[(host, port), ...]`` spreads the key space
+  over N store primaries (service/shardrouter.py owns the hash).  Each
+  shard gets its own `StoreChannel` — RPC socket, negotiated codec, and
+  an independent watch stream with its own ``(epoch, seq)`` cursor and
+  ``synced_rv`` (rv/seq/event_rv spaces are PER SHARD; only per-shard
+  comparisons are meaningful).  Writes fan out to the owner shard;
+  leases always route to shard 0; the merged watch streams feed one
+  mirror, each key touched only by its owner's stream.  A topology
+  change (``apply_topology``) tears down every channel and resyncs
+  under the servers' migration epoch fence — per-key rvs migrate WITH
+  their keys, so dirty-flush fencing survives the move.
 """
 
 from __future__ import annotations
@@ -41,7 +52,7 @@ import logging
 import socket
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu.metrics.registry import Registry
 from karpenter_tpu.obs.context import current_trace_id
@@ -59,6 +70,7 @@ from karpenter_tpu.service.codec import (
     recv_frame,
     send_frame,
 )
+from karpenter_tpu.service.shardrouter import LEASE_SHARD, ShardRouter
 from karpenter_tpu.service.watchclient import WatchChannelClient
 from karpenter_tpu.state.binwire import SCHEMA_FP
 from karpenter_tpu.state.kube import KubeStore
@@ -83,6 +95,69 @@ class StoreUnavailableError(ConnectionError):
     out.  Retryable: the caller may re-issue the request."""
 
 
+class StoreChannel:
+    """One shard's client-side state: the RPC socket (one in-flight
+    request per connection — the framing protocol's invariant, held by
+    ``_lock`` across send+recv), the negotiated codec, and this shard's
+    independent watch cursor.
+
+    rv/seq/event_rv are PER-SHARD spaces: ``synced_rv`` and
+    ``event_rv`` here are this shard's high-water marks, never compared
+    against another channel's.  The single-shard deployment is the
+    degenerate case — one channel owning every key — which is exactly
+    the pre-sharding client."""
+
+    def __init__(self, host: str, port: int, index: int):
+        self.host = host
+        self.port = port
+        self.index = index
+        self._lock = make_lock("StoreChannel._lock")
+        self.sock: Optional[socket.socket] = None
+        self.sock_codec = CODEC_JSON  # negotiated per RPC connection
+        self.watch_seq = 0
+        self.watch_epoch = ""
+        self.synced_rv = 0
+        self.event_rv = 0
+        # whether this channel has EVER completed a state transfer —
+        # the first-sync test for resync accounting.  Inferring it from
+        # zeroed cursors is wrong: an epoch change zeroes them too, and
+        # the forced snapshot that follows is a genuine resync that
+        # must be counted
+        self.ever_synced = False
+        self.stop = threading.Event()
+        self.watch_thread: Optional[threading.Thread] = None
+        self.watch_sock: Optional[socket.socket] = None
+
+    def close_sock(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    def shutdown(self) -> None:
+        """Stop this channel's watch loop and sever both sockets.  The
+        live watch socket gets a protocol-level shutdown(SHUT_RDWR)
+        BEFORE close: close() alone frees the fd but does NOT wake a
+        recv already blocked in another thread — the watch thread would
+        sit out its whole join timeout on every teardown."""
+        self.stop.set()
+        self.close_sock()
+        watch_sock = self.watch_sock
+        if watch_sock is not None:
+            try:
+                watch_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already disconnected
+            try:
+                watch_sock.close()
+            except OSError:
+                pass
+        if self.watch_thread is not None:
+            self.watch_thread.join(timeout=2.0)
+            self.watch_thread = None
+
+
 class RemoteKubeStore(KubeStore):
     def __init__(
         self,
@@ -96,6 +171,8 @@ class RemoteKubeStore(KubeStore):
         codec: str = "auto",
         registry: Optional[Registry] = None,
         events_cap: int = EVENTS_CAP,
+        shards: Optional[Sequence[Tuple[str, int]]] = None,
+        watch_pace=None,
     ):
         super().__init__()
         self.host = host
@@ -126,50 +203,98 @@ class RemoteKubeStore(KubeStore):
         self.clock = clock or Clock()
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
-        self._sock: Optional[socket.socket] = None
-        self._sock_codec = CODEC_JSON  # negotiated per RPC connection
-        self._rpc_lock = make_lock("RemoteKubeStore._rpc_lock")  # one in-flight RPC per conn
         self._mirror_lock = make_rlock("RemoteKubeStore._mirror_lock")  # mirror + rv bookkeeping
         self._lease_mutex = make_lock("RemoteKubeStore._lease_mutex")  # lease ops end-to-end
         self._rvs: Dict[Tuple[str, str], int] = {}
         self._shadow: Dict[Tuple[str, str], str] = {}
         self._lease_rvs: Dict[str, int] = {}
-        self._event_rv = 0
-        self.synced_rv = 0
-        # last seq contiguously applied from the WATCH stream (snapshot
-        # or event frames) — the delta-resync cursor.  NOT synced_rv:
-        # that also counts rvs from our own RPC responses, whose
-        # neighboring foreign events may still be in flight on the watch
-        # socket; replaying from synced_rv could skip them.
-        self._watch_seq = 0
-        # ...and the epoch that seq belongs to: seq spaces are
-        # per-VersionedStore, and the server refuses to treat a cursor
-        # from another epoch as covered (a fresh store's seqs could have
-        # overtaken a stale cursor — a bare number proves nothing)
-        self._watch_epoch = ""
+        # one channel per shard; the single-address constructor is the
+        # degenerate one-shard topology (the pre-sharding client,
+        # byte-for-byte in behavior)
+        self._channels: List[StoreChannel] = [
+            StoreChannel(h, p, i)
+            for i, (h, p) in enumerate(shards or [(host, port)])
+        ]
+        self._router = ShardRouter(len(self._channels))
         self.watch_resyncs: Dict[str, int] = {}
         self._stop = threading.Event()
-        self._watch_thread: Optional[threading.Thread] = None
-        self._watch_sock: Optional[socket.socket] = None
+        # reconnect-backoff pacing seam (service/watchclient.py): the
+        # fleet simulator injects a deterministic pacer; None keeps
+        # production's wall-clock exponential backoff
+        self._watch_pace = watch_pace
+        self._watch_enabled = False
         if start_watch:
             self.start_watch()
 
+    # ----------------------------------------------- single-shard compat view
+    # The one-shard deployment's tests and tools observe the client
+    # through these names; they read channel 0 (the only channel).
+    # Read-only on purpose: all writes go through the owning channel.
+    @property
+    def _sock_codec(self) -> str:
+        return self._channels[0].sock_codec
+
+    @property
+    def _watch_seq(self) -> int:
+        # last seq contiguously applied from the WATCH stream (snapshot
+        # or event frames) — the delta-resync cursor.  NOT synced_rv:
+        # that also counts rvs from our own RPC responses, whose
+        # neighboring foreign events may still be in flight on the
+        # watch socket; replaying from synced_rv could skip them.
+        return self._channels[0].watch_seq
+
+    @property
+    def _watch_epoch(self) -> str:
+        # the epoch that seq belongs to: seq spaces are
+        # per-VersionedStore, and the server refuses to treat a cursor
+        # from another epoch as covered (a fresh store's seqs could
+        # have overtaken a stale cursor — a bare number proves nothing)
+        return self._channels[0].watch_epoch
+
+    @property
+    def _watch_sock(self):
+        return self._channels[0].watch_sock
+
+    @property
+    def synced_rv(self) -> int:
+        """The mirror's sync high-water mark.  Per-shard rv spaces are
+        independent, so the cross-shard aggregate is only meaningful as
+        a monotone progress indicator; `wait_synced` compares per shard."""
+        return max(c.synced_rv for c in self._channels)
+
     # ------------------------------------------------------------- transport
-    def _connect(self) -> socket.socket:
-        if self._sock is None:
+    def _owner_for(self, header: dict) -> int:
+        """Which shard serves this request: leases pin to LEASE_SHARD,
+        cluster events ride the shard owning the object they describe,
+        keyed verbs hash by (kind, key)."""
+        method = header.get("method")
+        if method in ("lease_acquire", "lease_renew", "lease_release"):
+            return LEASE_SHARD if self._router.n > 1 else 0
+        if method == "record_event":
+            return self._router.owner("Event", str(header.get("obj_name", "")))
+        kind = header.get("kind")
+        if kind:
+            key = header.get("key")
+            if key is None and header.get("obj") is not None:
+                key = STORE_KINDS[kind][2](materialize(header["obj"]))
+            return self._router.owner(kind, str(key))
+        return 0
+
+    def _connect(self, chan: StoreChannel) -> socket.socket:
+        if chan.sock is None:
             try:
-                self._sock = socket.create_connection(
-                    (self.host, self.port), timeout=self.connect_timeout
+                chan.sock = socket.create_connection(
+                    (chan.host, chan.port), timeout=self.connect_timeout
                 )
-                self._sock.settimeout(self.request_timeout)
+                chan.sock.settimeout(self.request_timeout)
             except OSError as exc:
                 raise StoreUnavailableError(
-                    f"cluster store at {self.host}:{self.port}: {exc}"
+                    f"cluster store at {chan.host}:{chan.port}: {exc}"
                 ) from exc
-            self._sock_codec = CODEC_JSON
+            chan.sock_codec = CODEC_JSON
             if self.codec == "auto":
-                self._sock_codec = self._hello(self._sock)
-        return self._sock
+                chan.sock_codec = self._hello(chan.sock)
+        return chan.sock
 
     def _hello(self, sock: socket.socket) -> str:
         """Negotiate the payload codec for this connection.  The hello
@@ -219,16 +344,18 @@ class RemoteKubeStore(KubeStore):
         return payload
 
     def _close_sock(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
+        for chan in self._channels:
+            chan.close_sock()
 
-    def _rpc(self, header: dict) -> dict:
-        """One request/response with bounded retry on transient errors.
-        Mutations here are idempotent re-applied (puts/deletes/lease CAS);
-        a retried record_event may at worst duplicate an event line."""
+    def _rpc(self, header: dict, shard: Optional[int] = None) -> dict:
+        """One request/response with bounded retry on transient errors,
+        routed to the owner shard (``shard`` overrides for control
+        traffic like per-shard ``stat``).  Mutations here are idempotent
+        re-applied (puts/deletes/lease CAS); a retried record_event may
+        at worst duplicate an event line."""
+        chan = self._channels[
+            self._owner_for(header) if shard is None else shard
+        ]
         header = dict(header, identity=self.identity)
         # runtime blocking witness: a store round trip issued while some
         # OTHER lock is held (the lease mutex is the one sanctioned
@@ -245,10 +372,10 @@ class RemoteKubeStore(KubeStore):
         last: Optional[Exception] = None
         t0 = time.perf_counter()
         for attempt in range(RETRIES):
-            with self._rpc_lock:
+            with chan._lock:
                 try:
-                    sock = self._connect()
-                    codec = self._sock_codec
+                    sock = self._connect(chan)
+                    codec = chan.sock_codec
                     self._tx(
                         sock,
                         encode_payload(self._prep(header, codec), codec),
@@ -259,19 +386,22 @@ class RemoteKubeStore(KubeStore):
                 except socket.timeout as exc:
                     # a timed-out request must surface as retryable, not
                     # hang or half-read the next response off the socket
-                    self._close_sock()
+                    chan.close_sock()
                     raise StoreUnavailableError(
                         f"store request {header.get('method')} timed out "
                         f"after {self.request_timeout}s"
                     ) from exc
-                except (ConnectionError, OSError) as exc:
-                    self._close_sock()
+                except (ConnectionError, OSError, ValueError) as exc:
+                    # ValueError: a malformed/truncated response frame
+                    # (e.g. a fault injector tearing bytes) poisons the
+                    # connection — reconnect, same as a transport drop
+                    chan.close_sock()
                     last = exc
             if attempt < RETRIES - 1:  # no pointless sleep after the last try
                 self.clock.sleep(BACKOFF_S * (2**attempt))
         else:
             raise StoreUnavailableError(
-                f"cluster store at {self.host}:{self.port}: {last}"
+                f"cluster store at {chan.host}:{chan.port}: {last}"
             ) from last
         self.registry.observe(
             "karpenter_store_rpc_seconds",
@@ -297,14 +427,16 @@ class RemoteKubeStore(KubeStore):
         return header
 
     # ------------------------------------------------------------ mirroring
-    def _record_applied(self, kind: str, key: str, obj, rv: int) -> None:
+    def _record_applied(
+        self, chan: StoreChannel, kind: str, key: str, obj, rv: int
+    ) -> None:
         if obj is None:
             self._rvs.pop((kind, key), None)
             self._shadow.pop((kind, key), None)
         else:
             self._rvs[(kind, key)] = rv
             self._shadow[(kind, key)] = canonical(obj)
-        self.synced_rv = max(self.synced_rv, rv)
+        chan.synced_rv = max(chan.synced_rv, rv)
 
     def _locally_dirty(self, kind: str, key: str, obj) -> bool:
         """Whether the mirror object carries state the server has not
@@ -314,8 +446,10 @@ class RemoteKubeStore(KubeStore):
         through the flush -> conflict -> adopt path instead."""
         return self._shadow.get((kind, key)) != canonical(obj)
 
-    def _absorb_events(self, events, remote: bool) -> None:
-        """Apply server events to the mirror.
+    def _absorb_events(self, chan: StoreChannel, events, remote: bool) -> None:
+        """Apply server events to the mirror.  ``chan`` is the shard
+        the events arrived from: its rv/event_rv spaces are the only
+        ones these events may be compared against or credited to.
 
         Own RPC responses (`remote=False`): the local verb already ran —
         keep the local object (identity preserved for callers holding a
@@ -332,8 +466,8 @@ class RemoteKubeStore(KubeStore):
             for ev in events:
                 kind = ev["kind"]
                 if kind == "Event":
-                    if ev["event_rv"] > self._event_rv:
-                        self._event_rv = ev["event_rv"]
+                    if ev["event_rv"] > chan.event_rv:
+                        chan.event_rv = ev["event_rv"]
                         if remote:
                             self.events.append(materialize(ev["event"]))
                             if len(self.events) > self.events_cap:
@@ -351,7 +485,7 @@ class RemoteKubeStore(KubeStore):
                     local = store_dict.get(key)
                     if rv <= self._rvs.get((kind, key), 0):
                         # a stale echo must not delete a newer object
-                        self.synced_rv = max(self.synced_rv, rv)
+                        chan.synced_rv = max(chan.synced_rv, rv)
                         continue
                     if (
                         remote
@@ -362,15 +496,15 @@ class RemoteKubeStore(KubeStore):
                         # in-flight local create/mutation is never
                         # silently dropped by a watch delete — the next
                         # flush's rv conflict resolves who wins
-                        self.synced_rv = max(self.synced_rv, rv)
+                        chan.synced_rv = max(chan.synced_rv, rv)
                         continue
                     store_dict.pop(key, None)
-                    self._record_applied(kind, key, None, rv)
+                    self._record_applied(chan, kind, key, None, rv)
                     if remote and local is not None:
                         self._notify(kind, "delete", local)
                     continue
                 if rv <= self._rvs.get((kind, key), 0):
-                    self.synced_rv = max(self.synced_rv, rv)
+                    chan.synced_rv = max(chan.synced_rv, rv)
                     continue
                 local = store_dict.get(key)
                 server_obj = materialize(ev["obj"])  # decoded once, reused
@@ -378,24 +512,26 @@ class RemoteKubeStore(KubeStore):
                 if not remote:
                     # own write: local object IS the source of this event
                     if local is None:  # deleted locally since; keep that
-                        self.synced_rv = max(self.synced_rv, rv)
+                        chan.synced_rv = max(chan.synced_rv, rv)
                         continue
                     self._rvs[(kind, key)] = rv
                     self._shadow[(kind, key)] = server_enc
-                    self.synced_rv = max(self.synced_rv, rv)
+                    chan.synced_rv = max(chan.synced_rv, rv)
                     continue
                 if local is not None and self._locally_dirty(kind, key, local):
-                    self.synced_rv = max(self.synced_rv, rv)
+                    chan.synced_rv = max(chan.synced_rv, rv)
                     continue
                 if local is not None and canonical(local) == server_enc:
-                    self._record_applied(kind, key, local, rv)
+                    self._record_applied(chan, kind, key, local, rv)
                     continue
                 store_dict[key] = server_obj
-                self._record_applied(kind, key, server_obj, rv)
+                self._record_applied(chan, kind, key, server_obj, rv)
                 self._notify(kind, "put", server_obj)
 
     def _forward(self, header: dict) -> dict:
-        response = self._rpc(header)
+        shard = self._owner_for(header)
+        chan = self._channels[shard]
+        response = self._rpc(header, shard=shard)
         if response.get("status") == "conflict":
             kind = header["kind"]
             key = header.get("key")
@@ -428,12 +564,14 @@ class RemoteKubeStore(KubeStore):
                 "store write conflict on %s/%s (rv %s); adopting server state",
                 kind, key, response.get("rv"),
             )
-            self._adopt(kind, key, server_wire, response["rv"])
+            self._adopt(chan, kind, key, server_wire, response["rv"])
             return response
-        self._absorb_events(response.get("events", ()), remote=False)
+        self._absorb_events(chan, response.get("events", ()), remote=False)
         return response
 
-    def _adopt(self, kind: str, key: str, obj_wire, rv: int) -> None:
+    def _adopt(
+        self, chan: StoreChannel, kind: str, key: str, obj_wire, rv: int
+    ) -> None:
         _cls, attr, _key_fn = STORE_KINDS[kind]
         with self._mirror_lock:
             # lockset witness: the mirror is written from the watch
@@ -443,12 +581,12 @@ class RemoteKubeStore(KubeStore):
             store_dict = getattr(self, attr)
             if obj_wire is None:
                 store_dict.pop(key, None)
-                self._record_applied(kind, key, None, rv)
-                self.synced_rv = max(self.synced_rv, rv)
+                self._record_applied(chan, kind, key, None, rv)
+                chan.synced_rv = max(chan.synced_rv, rv)
             else:
                 obj = materialize(obj_wire)
                 store_dict[key] = obj
-                self._record_applied(kind, key, obj, rv)
+                self._record_applied(chan, kind, key, obj, rv)
 
     # -------------------------------------------------------------- flushing
     def _flush_dirty(self) -> None:
@@ -587,21 +725,21 @@ class RemoteKubeStore(KubeStore):
             # the event_rv check, so this is the only trim site for it)
             if len(self.events) > self.events_cap:
                 del self.events[: len(self.events) - self.events_cap]
+        header = {
+            "method": "record_event",
+            "kind": kind,
+            "reason": reason,
+            "obj_name": obj_name,
+            "message": message,
+        }
+        chan = self._channels[self._owner_for(header)]
         try:
-            response = self._rpc(
-                {
-                    "method": "record_event",
-                    "kind": kind,
-                    "reason": reason,
-                    "obj_name": obj_name,
-                    "message": message,
-                }
-            )
+            response = self._rpc(header, shard=chan.index)
         except StoreUnavailableError as exc:
             # events are advisory; a store blip must not fail a reconcile
             log.warning("event %s/%s not recorded remotely: %s", kind, reason, exc)
             return
-        self._event_rv = max(self._event_rv, response.get("event_rv", 0))
+        chan.event_rv = max(chan.event_rv, response.get("event_rv", 0))
 
     # ---------------------------------------------------------------- leases
     # _lease_mutex serializes each lease operation END-TO-END (header
@@ -611,8 +749,15 @@ class RemoteKubeStore(KubeStore):
     # stale-base renewal — a spurious conflict that abdicates a healthy
     # leader mid-tick.
 
+    @property
+    def _lease_chan(self) -> StoreChannel:
+        """Leases pin to LEASE_SHARD under every topology — the
+        leadership CAS space lives on exactly one shard."""
+        return self._channels[LEASE_SHARD if self._router.n > 1 else 0]
+
     def try_acquire_lease(self, name, holder, now, duration_s) -> bool:
         with self._lease_mutex:
+            chan = self._lease_chan
             try:
                 self._flush_dirty()
                 response = self._rpc(
@@ -633,8 +778,8 @@ class RemoteKubeStore(KubeStore):
             # wait_synced stalls on our own acquires.  (Never the server's
             # global rv: that would claim sync for other replicas' events
             # still queued on our watch socket.)
-            self.synced_rv = max(
-                self.synced_rv, response.get("lease_event_rv", 0)
+            chan.synced_rv = max(
+                chan.synced_rv, response.get("lease_event_rv", 0)
             )
             if response.get("lease") is not None:
                 with self._mirror_lock:
@@ -645,6 +790,7 @@ class RemoteKubeStore(KubeStore):
                     # _absorb_events skip every later foreign Lease event
                     # and freeze a stale holder into this mirror forever
                     self._record_applied(
+                        chan,
                         "Lease",
                         name,
                         lease,
@@ -672,21 +818,23 @@ class RemoteKubeStore(KubeStore):
                 log.warning("lease renew unavailable (%s); abdicating", exc)
                 return False
             self._lease_rvs[name] = response.get("rv", 0)
-            self.synced_rv = max(
-                self.synced_rv, response.get("lease_event_rv", 0)
+            chan = self._lease_chan
+            chan.synced_rv = max(
+                chan.synced_rv, response.get("lease_event_rv", 0)
             )
             return bool(response["renewed"])
 
     def release_lease(self, name, holder) -> None:
         with self._lease_mutex:
+            chan = self._lease_chan
             try:
                 self._flush_dirty()
                 response = self._rpc(
                     {"method": "lease_release", "name": name, "holder": holder}
                 )
                 self._lease_rvs[name] = response.get("rv", 0)
-                self.synced_rv = max(
-                    self.synced_rv, response.get("lease_event_rv", 0)
+                chan.synced_rv = max(
+                    chan.synced_rv, response.get("lease_event_rv", 0)
                 )
             except StoreUnavailableError as exc:  # best-effort: expiry fences
                 log.warning("lease release unavailable (%s)", exc)
@@ -698,6 +846,7 @@ class RemoteKubeStore(KubeStore):
                     # refresh the shadow so the mirror entry stays clean
                     # for later foreign Lease events (see try_acquire)
                     self._record_applied(
+                        chan,
                         "Lease",
                         name,
                         lease,
@@ -706,33 +855,38 @@ class RemoteKubeStore(KubeStore):
 
     # ----------------------------------------------------------------- watch
     def start_watch(self) -> None:
-        if self._watch_thread is not None:
-            return
-        self._watch_thread = threading.Thread(
-            target=self._watch_loop,
-            daemon=True,
-            name=f"store-watch-{self.identity}",
-        )
-        self._watch_thread.start()
+        self._watch_enabled = True
+        for chan in self._channels:
+            if chan.watch_thread is not None:
+                continue
+            chan.watch_thread = threading.Thread(
+                target=self._watch_loop,
+                args=(chan,),
+                daemon=True,
+                name=f"store-watch-{self.identity}-s{chan.index}",
+            )
+            chan.watch_thread.start()
 
-    def _watch_loop(self) -> None:
+    def _watch_loop(self, chan: StoreChannel) -> None:
         # the dial/handshake/backoff/resync choreography is the SHARED
         # watch-client primitive (service/watchclient.py — one
         # definition with the read-replica follower); this mirror
         # contributes the handshake contents, the frame handler, and
-        # the byte-counting tx/rx
+        # the byte-counting tx/rx.  One loop per shard channel: each
+        # stream carries only its shard's keys and advances only its
+        # shard's (epoch, seq) cursor.
         def dial():
             sock = socket.create_connection(
-                (self.host, self.port), timeout=self.connect_timeout
+                (chan.host, chan.port), timeout=self.connect_timeout
             )
             sock.settimeout(self.request_timeout)
             return sock
 
         def hello() -> dict:
             # delta resync: present the last seq this mirror applied
-            # from the watch stream; the server replays just the gap
-            # when its replay log still covers it, and falls back to
-            # a full snapshot when compaction has passed us by
+            # from this shard's watch stream; the server replays just
+            # the gap when its replay log still covers it, and falls
+            # back to a full snapshot when compaction has passed us by
             return {
                 "method": "watch",
                 "identity": self.identity,
@@ -742,39 +896,42 @@ class RemoteKubeStore(KubeStore):
                     else [CODEC_JSON]
                 ),
                 "schema_fp": SCHEMA_FP,
-                "since_seq": self._watch_seq,
-                "epoch": self._watch_epoch,
+                "since_seq": chan.watch_seq,
+                "epoch": chan.watch_epoch,
             }
 
         def set_live(sock) -> None:
-            self._watch_sock = sock
+            chan.watch_sock = sock
 
         WatchChannelClient(
             dial=dial,
             hello=hello,
             tx=lambda sock, payload: self._tx(sock, payload, CODEC_JSON),
             rx=self._rx,
-            on_epoch=self._note_epoch,
-            on_legacy_snapshot=self._apply_snapshot,
+            on_epoch=lambda epoch: self._note_epoch(chan, epoch),
+            on_legacy_snapshot=lambda snap: self._apply_snapshot(chan, snap),
             on_frame=lambda frame, initial: self._handle_watch_frame(
-                frame, initial=initial
+                chan, frame, initial=initial
             ),
-            stop=self._stop,
+            stop=chan.stop,
             on_live=set_live,
             backoff_s=BACKOFF_S,
+            pace=self._watch_pace,
         ).run()
 
-    def _handle_watch_frame(self, frame: dict, initial: bool = False) -> None:
+    def _handle_watch_frame(
+        self, chan: StoreChannel, frame: dict, initial: bool = False
+    ) -> None:
         """One pushed watch frame: ordinary events, or a resync the
         server forced (reconnect gap, or this client fell so far behind
         that its bounded queue overflowed and was coalesced)."""
         ftype = frame.get("type")
         if ftype == "events":
-            self._absorb_events(frame.get("events", ()), remote=True)
+            self._absorb_events(chan, frame.get("events", ()), remote=True)
             # frames arrive in seq order on one stream; assignment (not
             # max) lets a post-restart server's fresh, lower seq epoch
             # take over (see _apply_snapshot)
-            self._watch_seq = frame.get("seq", self._watch_seq)
+            chan.watch_seq = frame.get("seq", chan.watch_seq)
             return
         if ftype != "resync":
             return
@@ -782,9 +939,10 @@ class RemoteKubeStore(KubeStore):
         # that had to full-resync from a restarted primary rotates its
         # own) — the reset must land before the payload applies
         if "epoch" in frame:
-            self._note_epoch(str(frame.get("epoch") or ""))
+            self._note_epoch(chan, str(frame.get("epoch") or ""))
         mode = frame.get("mode", "snapshot")
-        first_sync = initial and self._watch_seq == 0 and self.synced_rv == 0
+        first_sync = initial and not chan.ever_synced
+        chan.ever_synced = True
         if not first_sync:
             # a genuine resync (not the very first state transfer):
             # count it and put it on the decision ledger — a mirror that
@@ -797,12 +955,12 @@ class RemoteKubeStore(KubeStore):
                 "StoreResync", mode=mode, identity=self.identity
             )
         if mode == "snapshot":
-            self._apply_snapshot(frame["snapshot"])
+            self._apply_snapshot(chan, frame["snapshot"])
         else:
-            self._absorb_events(frame.get("events", ()), remote=True)
-        self._watch_seq = frame.get("seq", self._watch_seq)
+            self._absorb_events(chan, frame.get("events", ()), remote=True)
+        chan.watch_seq = frame.get("seq", chan.watch_seq)
 
-    def _note_epoch(self, epoch: str) -> None:
+    def _note_epoch(self, chan: StoreChannel, epoch: str) -> None:
         """Adopt the server's epoch id, resetting every old-space cursor
         the moment a CHANGE is detected — before any payload applies.
         Doing it at detection time (not at snapshot-apply time) matters:
@@ -811,32 +969,38 @@ class RemoteKubeStore(KubeStore):
         (seq 0), never a new epoch label over an old-space seq that the
         busy new server's log might falsely 'cover'."""
         with self._mirror_lock:
-            if epoch == self._watch_epoch:
+            if epoch == chan.watch_epoch:
                 return
-            if self._watch_epoch:
+            if chan.watch_epoch:
                 # genuine epoch change: old-space cursors are meaningless
-                self._watch_seq = 0
-                self.synced_rv = 0
+                chan.watch_seq = 0
+                chan.synced_rv = 0
                 # per-key rvs drop to 0 for CLEAN keys — 0 keeps the
                 # snapshot deletion sweep working (the key is still
                 # provably server-acked) while never vetoing adoption of
                 # new-space rvs.  Dirty keys keep their entries and heal
-                # through flush -> fence conflict -> adopt.
+                # through flush -> fence conflict -> adopt.  Only THIS
+                # shard's keys: other shards' rv spaces didn't rotate.
                 for (kind, key) in list(self._rvs):
+                    if self._router.owner(kind, key) != chan.index:
+                        continue
                     _cls, attr, _key_fn = STORE_KINDS[kind]
                     obj = getattr(self, attr).get(key)
                     if obj is None or not self._locally_dirty(
                         kind, key, obj
                     ):
                         self._rvs[(kind, key)] = 0
-            self._watch_epoch = epoch
+            chan.watch_epoch = epoch
 
-    def _apply_snapshot(self, snap: dict) -> None:
-        """Full-state resync: adopt the server's objects, drop mirror
-        entries the server no longer has (store restart / reconnect).
-        Locally DIRTY entries are kept as-is — in-flight creates and
-        unflushed in-place mutations reconcile through the next flush,
-        never by a racing snapshot clobbering them (lost-update hazard)."""
+    def _apply_snapshot(self, chan: StoreChannel, snap: dict) -> None:
+        """Full-state resync for ONE shard: adopt the server's objects,
+        drop mirror entries this shard owns that the server no longer
+        has (store restart / reconnect).  The deletion sweep is
+        ownership-restricted — shard i's snapshot says nothing about
+        keys other shards hold.  Locally DIRTY entries are kept as-is —
+        in-flight creates and unflushed in-place mutations reconcile
+        through the next flush, never by a racing snapshot clobbering
+        them (lost-update hazard)."""
         with self._mirror_lock:
             for kind, (_cls, attr, _key_fn) in STORE_KINDS.items():
                 entries = snap["kinds"].get(kind, {})
@@ -845,9 +1009,13 @@ class RemoteKubeStore(KubeStore):
                     # drop only keys the server has acknowledged before
                     # (recorded rv): an absent rv means an in-flight local
                     # create the server simply hasn't seen yet
-                    if key not in entries and (kind, key) in self._rvs:
+                    if (
+                        key not in entries
+                        and (kind, key) in self._rvs
+                        and self._router.owner(kind, key) == chan.index
+                    ):
                         old = store_dict.pop(key)
-                        self._record_applied(kind, key, None, 0)
+                        self._record_applied(chan, kind, key, None, 0)
                         self._notify(kind, "delete", old)
                 for key, entry in entries.items():
                     obj_wire, rv = entry["obj"], entry["rv"]
@@ -856,58 +1024,99 @@ class RemoteKubeStore(KubeStore):
                         rv <= self._rvs.get((kind, key), 0)
                         or self._locally_dirty(kind, key, local)
                     ):
-                        self.synced_rv = max(self.synced_rv, rv)
+                        chan.synced_rv = max(chan.synced_rv, rv)
                         continue
                     server_obj = materialize(obj_wire)  # decoded once
                     if local is not None and canonical(local) == canonical(
                         server_obj
                     ):
-                        self._record_applied(kind, key, local, rv)
+                        self._record_applied(chan, kind, key, local, rv)
                         continue
                     store_dict[key] = server_obj
-                    self._record_applied(kind, key, server_obj, rv)
+                    self._record_applied(chan, kind, key, server_obj, rv)
                     self._notify(kind, "put", server_obj)
-            # the cap is an INVARIANT, not a steady-state tendency: a
-            # snapshot from a server with a larger ledger adopts only
-            # the newest events_cap entries
-            self.events = [
-                materialize(e)
-                for e in snap.get("events", [])[-self.events_cap :]
-            ]
-            self._event_rv = snap.get("event_rv", self._event_rv)
+            snap_events = snap.get("events", [])
+            snap_event_rv = snap.get("event_rv", chan.event_rv)
+            if self._router.n <= 1:
+                # single shard: the server ledger IS the ledger — adopt
+                # it wholesale.  The cap is an INVARIANT, not a
+                # steady-state tendency: a snapshot from a server with a
+                # larger ledger adopts only the newest events_cap entries
+                self.events = [
+                    materialize(e)
+                    for e in snap_events[-self.events_cap :]
+                ]
+                chan.event_rv = snap_event_rv
+            else:
+                # merged ledger: this shard contributes only the events
+                # the mirror hasn't credited from it yet (its event_rv
+                # delta) — replacing would wipe the other shards' events
+                fresh = snap_event_rv - chan.event_rv
+                if fresh > 0:
+                    for e in snap_events[-fresh:]:
+                        self.events.append(materialize(e))
+                    chan.event_rv = snap_event_rv
+                    if len(self.events) > self.events_cap:
+                        del self.events[
+                            : len(self.events) - self.events_cap
+                        ]
             # synced_rv MAXES: it also credits rvs from our own RPC
             # responses, which the origin-skipping watch stream never
             # echoes — assignment could regress below a racing own write
             # and stall wait_synced forever.  Epoch changes already
             # zeroed it in _note_epoch, so maxing never resurrects an
-            # old space.  _watch_seq assigns: only the watch stream
+            # old space.  watch_seq assigns: only the watch stream
             # advances it, and in-epoch a snapshot's seq is >= anything
             # it delivered.
-            self.synced_rv = max(self.synced_rv, snap.get("rv", 0))
-            self._watch_seq = snap.get("seq", 0)
+            chan.synced_rv = max(chan.synced_rv, snap.get("rv", 0))
+            chan.watch_seq = snap.get("seq", 0)
+            chan.ever_synced = True  # legacy path counts as a transfer too
 
     def wait_synced(self, min_rv: Optional[int] = None, timeout: float = 5.0) -> bool:
         """Block until the mirror has applied every server mutation up to
-        ``min_rv`` (default: the server's current rv).  Test/handoff
-        helper: a standby asserts its mirror is warm before acting."""
+        ``min_rv`` (default: every shard's current rv).  Test/handoff
+        helper: a standby asserts its mirror is warm before acting.
+
+        With an explicit ``min_rv`` the aggregate high-water mark is
+        compared (single-shard semantics — the caller got the target
+        from one shard's response); with the default, each shard is
+        statted and waited on in ITS OWN rv space."""
         if min_rv is None:
-            min_rv = self._rpc({"method": "stat"})["rv"]
+            targets = [
+                (chan, self._rpc({"method": "stat"}, shard=chan.index)["rv"])
+                for chan in self._channels
+            ]
+            synced = lambda: all(c.synced_rv >= t for c, t in targets)
+        else:
+            synced = lambda: self.synced_rv >= min_rv
         deadline = self.clock.now() + timeout
         while self.clock.now() < deadline:
-            if self.synced_rv >= min_rv:
+            if synced():
                 return True
             self.clock.sleep(0.005)
-        return self.synced_rv >= min_rv
+        return synced()
+
+    # ------------------------------------------------------------- topology
+    def apply_topology(self, addresses: Sequence[Tuple[str, int]]) -> None:
+        """Re-point this client at a new shard topology (after a
+        coordinator-driven reshard).  Tears down every channel (watch
+        loops included), swaps the router atomically under the mirror
+        lock, and resyncs from scratch cursors.  Per-key rvs are KEPT:
+        they migrated with their keys server-side, so dirty-flush
+        fencing still lines up at the new owners; the fresh channels'
+        empty watch_epoch means the first epoch adoption does not zero
+        them (see ``_note_epoch``)."""
+        for chan in self._channels:
+            chan.shutdown()
+        with self._mirror_lock:
+            self._channels = [
+                StoreChannel(h, p, i) for i, (h, p) in enumerate(addresses)
+            ]
+            self._router = ShardRouter(len(self._channels))
+        if self._watch_enabled and not self._stop.is_set():
+            self.start_watch()
 
     def close(self) -> None:
         self._stop.set()
-        self._close_sock()
-        watch_sock = self._watch_sock
-        if watch_sock is not None:  # interrupt the blocking watch recv
-            try:
-                watch_sock.close()
-            except OSError:
-                pass
-        if self._watch_thread is not None:
-            self._watch_thread.join(timeout=2.0)
-            self._watch_thread = None
+        for chan in self._channels:
+            chan.shutdown()

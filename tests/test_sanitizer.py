@@ -324,14 +324,14 @@ class TestCrossValidation:
         _snap, edges, universe = static_model
         w = Witness(
             scenario="fabricated",
-            locks=["RemoteKubeStore._rpc_lock", "VersionedStore.lock"],
+            locks=["StoreChannel._lock", "VersionedStore.lock"],
             edges=[{
-                "outer": "RemoteKubeStore._rpc_lock",
+                "outer": "StoreChannel._lock",
                 "inner": "VersionedStore.lock",
                 "sites": ["karpenter_tpu/state/remote.py:_rpc"],
             }],
         )
-        assert ("RemoteKubeStore._rpc_lock", "VersionedStore.lock") \
+        assert ("StoreChannel._lock", "VersionedStore.lock") \
             not in edges
         cv = cross_validate(w, edges, universe, WITNESS_EDGES)
         assert not cv.ok
@@ -339,7 +339,7 @@ class TestCrossValidation:
         # ...and the allowlist silences it (the sanctioned-edge path)
         cv2 = cross_validate(
             w, edges, universe,
-            {"RemoteKubeStore._rpc_lock|VersionedStore.lock"},
+            {"StoreChannel._lock|VersionedStore.lock"},
         )
         assert cv2.ok
 
@@ -388,7 +388,7 @@ class TestCrossValidation:
         bad = Witness(
             scenario="gap",
             edges=[{
-                "outer": "RemoteKubeStore._rpc_lock",
+                "outer": "StoreChannel._lock",
                 "inner": "VersionedStore.lock",
                 "sites": ["karpenter_tpu/state/remote.py:_rpc"],
             }],

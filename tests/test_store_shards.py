@@ -1,0 +1,661 @@
+"""Sharded, crash-durable store plane (docs/designs/store-scale.md,
+PR 17).
+
+Covers the plane's new legs at the unit/integration level — the full
+fleet proof lives in test_sim_fleet_shards.py:
+
+1. the durable replay log's torn-tail rule at the DISK boundary
+   (truncated prefix, overrun length, zero-length and undecodable
+   payloads: dropped, never IndexError'd or wrongly decoded) and its
+   checkpoint+tail recovery semantics;
+2. frame hardening at the SOCKET boundary — every scripted wire fault
+   surfaces as a reconnect-classified error (ValueError /
+   ConnectionError), and a live client HEALS each one with one retry;
+3. the shard router and live key migration under the epoch fence;
+4. the injectable reconnect-backoff pace seam (watchclient.py);
+5. epoch rotation under rapid double-restart: two server restarts
+   inside ONE client reconnect window must land the client on the
+   newest epoch with a full resync, never a stale-epoch delta;
+6. restart-from-disk: a durable server re-adopts its epoch and serves
+   a DELTA resync across its own death.
+"""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.service.codec import (
+    CODEC_BIN,
+    CODEC_JSON,
+    decode_payload,
+    encode_payload,
+    recv_frame,
+    send_frame,
+)
+from karpenter_tpu.service.shardrouter import (
+    LEASE_SHARD,
+    ShardCoordinator,
+    ShardRouter,
+    shard_of,
+)
+from karpenter_tpu.service.store_server import StoreServer, VersionedStore
+from karpenter_tpu.service.watchclient import (
+    RECONNECT_ERRORS,
+    WatchChannelClient,
+)
+from karpenter_tpu.sim.faults import (
+    WIRE_FAULTS,
+    FailingFsync,
+    WireFaultInjector,
+)
+from karpenter_tpu.state.kube import Node
+from karpenter_tpu.state.remote import RemoteKubeStore
+from karpenter_tpu.state.storelog import (
+    FSYNC_ALWAYS,
+    FSYNC_OFF,
+    DurableReplayLog,
+    read_segment,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _wait(cond, timeout=8.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _record_bytes(rec: dict) -> bytes:
+    payload = encode_payload(rec, CODEC_BIN)
+    return struct.pack(">Q", len(payload)) + payload
+
+
+# ------------------------------------------------- torn-tail rule (disk)
+class TestTornTail:
+    """Recovery's contract: everything before the first tear is kept,
+    the tear is counted, nothing after it is trusted."""
+
+    def _batch(self, seq, epoch="e1"):
+        return {"type": "batch", "seq": seq, "epoch": epoch, "events": []}
+
+    def test_truncated_length_prefix_dropped(self, tmp_path):
+        path = tmp_path / "seg.log"
+        path.write_bytes(_record_bytes(self._batch(1)) + b"\x00\x00\x01")
+        records, torn = read_segment(str(path))
+        assert [r["seq"] for r in records] == [1]
+        assert torn == 1
+
+    def test_declared_length_overruns_file(self, tmp_path):
+        path = tmp_path / "seg.log"
+        path.write_bytes(
+            _record_bytes(self._batch(1))
+            + struct.pack(">Q", 4096)
+            + b"short"
+        )
+        records, torn = read_segment(str(path))
+        assert [r["seq"] for r in records] == [1]
+        assert torn == 1
+
+    def test_zero_length_record_is_torn_not_indexerror(self, tmp_path):
+        path = tmp_path / "seg.log"
+        path.write_bytes(struct.pack(">Q", 0) + _record_bytes(self._batch(9)))
+        records, torn = read_segment(str(path))
+        # the zero-length payload is a tear; the valid record AFTER it is
+        # garbage-by-association (a boundary found by luck is not trust)
+        assert records == []
+        assert torn == 1
+
+    def test_undecodable_payload_dropped(self, tmp_path):
+        path = tmp_path / "seg.log"
+        path.write_bytes(
+            _record_bytes(self._batch(1))
+            + struct.pack(">Q", 3)
+            + b"\xff\xff\xff"
+        )
+        records, torn = read_segment(str(path))
+        assert [r["seq"] for r in records] == [1]
+        assert torn == 1
+
+    def test_missing_file_is_empty_not_error(self, tmp_path):
+        assert read_segment(str(tmp_path / "absent.log")) == ([], 0)
+
+    def test_recovery_keeps_contiguous_epoch_matched_tail(self, tmp_path):
+        dlog = DurableReplayLog(str(tmp_path / "seg.log"), fsync=FSYNC_OFF)
+        dlog.write_checkpoint("e1", 0, 0, 0, {}, {"kinds": {}})
+        dlog.append_batch(1, "e1", [])
+        dlog.append_batch(2, "e1", [])
+        dlog.append_batch(3, "e2", [])  # stray other-epoch record: skipped
+        dlog.append_batch(5, "e1", [])  # then a seq gap: untrusted suffix
+        dlog.close()
+        fresh = DurableReplayLog(str(tmp_path / "seg.log"), fsync=FSYNC_OFF)
+        checkpoint, batches = fresh.recover()
+        assert checkpoint["epoch"] == "e1"
+        assert [b["seq"] for b in batches] == [1, 2]
+
+    def test_checkpoint_supersedes_earlier_batches(self, tmp_path):
+        reg = Registry()
+        dlog = DurableReplayLog(
+            str(tmp_path / "seg.log"), fsync=FSYNC_OFF, registry=reg
+        )
+        dlog.append_batch(1, "e1", [])
+        dlog.write_checkpoint("e1", 4, 40, 0, {}, {"kinds": {}})
+        dlog.append_batch(5, "e1", [])
+        dlog.append_batch(6, "e1", [])
+        dlog.close()
+        assert reg.counter("karpenter_store_log_checkpoints_total") == 1
+        fresh = DurableReplayLog(str(tmp_path / "seg.log"), fsync=FSYNC_OFF)
+        checkpoint, batches = fresh.recover()
+        # checkpointing atomically REPLACED the segment, so the pre-
+        # checkpoint batch is gone from disk, and the tail is only what
+        # follows the checkpoint's seq in its epoch
+        assert checkpoint["seq"] == 4 and checkpoint["rv"] == 40
+        assert [b["seq"] for b in batches] == [5, 6]
+
+    def test_fsync_failure_fails_closed(self, tmp_path):
+        reg = Registry()
+        fsync = FailingFsync()
+        dlog = DurableReplayLog(
+            str(tmp_path / "seg.log"),
+            fsync=FSYNC_ALWAYS,
+            fsync_fn=fsync,
+            registry=reg,
+        )
+        dlog.append_batch(1, "e1", [])
+        fsync.arm()
+        dlog.append_batch(2, "e1", [])  # fsync raises: log fails closed
+        assert dlog.failed is True
+        assert fsync.failures == 1
+        dlog.append_batch(3, "e1", [])  # inert, no raise
+        assert reg.counter("karpenter_store_log_failures_total") == 1
+        # batch 2's bytes landed before the fsync raised (the OS still
+        # holds them — only a POWER loss would tear them); batch 3,
+        # appended after the failure, must never appear
+        fresh = DurableReplayLog(str(tmp_path / "seg.log"), fsync=FSYNC_OFF)
+        _cp, batches = fresh.recover()
+        assert [b["seq"] for b in batches] == [1, 2]
+
+
+# --------------------------------------------- frame hardening (socket)
+class TestSocketFrameHardening:
+    """Satellite (a): zero-length and truncated length-prefix frames
+    must surface as reconnect-classified errors — ValueError or
+    ConnectionError, never IndexError, never a hang."""
+
+    def test_decode_payload_zero_length_raises_valueerror(self):
+        with pytest.raises(ValueError):
+            decode_payload(b"", CODEC_BIN)
+        with pytest.raises(ValueError):
+            decode_payload(b"", CODEC_JSON)
+
+    def test_decode_payload_truncated_raises_valueerror(self):
+        whole = encode_payload({"method": "ping"}, CODEC_BIN)
+        for cut in (1, 2, len(whole) - 1):
+            with pytest.raises(ValueError):
+                decode_payload(whole[:cut], CODEC_BIN)
+        with pytest.raises(ValueError):
+            decode_payload(b"\x00\x01", CODEC_JSON)  # < 4 header bytes
+        with pytest.raises(ValueError):
+            # JSON header length overruns the payload
+            decode_payload(struct.pack(">I", 4096) + b"{}", CODEC_JSON)
+
+    def test_every_wire_fault_is_reconnect_classified(self):
+        """Each scripted fault, fed through the REAL socket recv path,
+        raises something the watch/RPC loops classify as reconnect-
+        worthy — within a bounded time (no hang)."""
+        for fault, response in sorted(WIRE_FAULTS.items()):
+            a, b = socket.socketpair()
+            try:
+                a.sendall(response)
+                a.close()  # then the wire dies
+                b.settimeout(5.0)
+                with pytest.raises(RECONNECT_ERRORS):
+                    payload = recv_frame(b)
+                    decode_payload(payload, CODEC_BIN)
+            finally:
+                b.close()
+
+    def test_client_heals_every_fault_with_one_retry(self):
+        """A poisoned RPC connection costs one retry, never a wrong
+        answer: after each injected fault the next write lands."""
+        srv = StoreServer().start_background()
+        injector = WireFaultInjector()
+        client = None
+        try:
+            host, port = srv.address
+            client = RemoteKubeStore(
+                host, port, identity="victim", start_watch=False
+            )
+            for i, fault in enumerate(sorted(WIRE_FAULTS)):
+                injector.inject(client._channels[0], fault)
+                client.put_pod(Pod(name=f"heal{i}", requests=Resources(cpu=1)))
+                assert f"default/heal{i}" in srv.store.kube.pods, fault
+            assert injector.injected == {f: 1 for f in WIRE_FAULTS}
+        finally:
+            if client is not None:
+                client.close()
+            srv.stop()
+
+    def test_inject_unknown_fault_refuses(self):
+        with pytest.raises(ValueError, match="unknown wire fault"):
+            WireFaultInjector().inject(object(), "gremlins")
+
+    def test_delay_ack_advances_injected_clock(self):
+        srv = StoreServer().start_background()
+        injector = WireFaultInjector()
+        clock = FakeClock()
+        client = None
+        try:
+            host, port = srv.address
+            client = RemoteKubeStore(
+                host, port, identity="slow", start_watch=False
+            )
+            client.put_pod(Pod(name="warm", requests=Resources(cpu=1)))
+            t0 = clock.now()
+            injector.delay_ack(client._channels[0], clock, 7.5)
+            client.put_pod(Pod(name="delayed", requests=Resources(cpu=1)))
+            # the delay burned SIMULATED time, not wall time, and the
+            # response still landed
+            assert clock.now() - t0 == pytest.approx(7.5)
+            assert "default/delayed" in srv.store.kube.pods
+        finally:
+            if client is not None:
+                client.close()
+            srv.stop()
+
+
+# ------------------------------------------------------- shard routing
+class TestShardRouter:
+    def test_shard_of_deterministic_and_in_range(self):
+        for n in (1, 2, 4, 5):
+            for i in range(50):
+                s = shard_of("Pod", f"default/p{i}", n)
+                assert 0 <= s < n
+                assert s == shard_of("Pod", f"default/p{i}", n)
+
+    def test_leases_pin_to_shard_zero(self):
+        router = ShardRouter(4)
+        for name in ("leader", "anything", "x" * 40):
+            assert router.owner("Lease", name) == LEASE_SHARD
+
+    def test_keys_spread_over_every_shard(self):
+        owners = {shard_of("Pod", f"default/p{i}", 4) for i in range(200)}
+        assert owners == {0, 1, 2, 3}
+
+    def test_kind_participates_in_the_hash(self):
+        placements = {
+            shard_of(kind, "default/same-key", 5)
+            for kind in ("Pod", "Node", "NodeClaim", "NodePool")
+        }
+        assert len(placements) > 1  # not all kinds co-hash
+
+
+class TestMigration:
+    def _server(self, index):
+        return StoreServer(
+            store=VersionedStore(), shard_index=index
+        ).start_background()
+
+    def test_reshard_moves_keys_under_epoch_fence(self):
+        servers = [self._server(i) for i in range(2)]
+        writer = None
+        reg = Registry()
+        try:
+            addrs = [s.address for s in servers]
+            writer = RemoteKubeStore(
+                identity="w", shards=addrs, start_watch=False
+            )
+            for i in range(30):
+                writer.put_pod(Pod(name=f"m{i}", requests=Resources(cpu=1)))
+            pre_epochs = [s.store.epoch for s in servers]
+            servers.append(self._server(2))
+            new_addrs = [s.address for s in servers]
+            stats = ShardCoordinator(registry=reg).reshard(addrs, new_addrs)
+            assert stats["moved_keys"] > 0
+            assert stats["new_n"] == 3
+            # every key sits at exactly its new owner
+            for i in range(30):
+                key = f"default/m{i}"
+                owner = shard_of("Pod", key, 3)
+                for j, s in enumerate(servers):
+                    assert (key in s.store.kube.pods) == (j == owner), key
+            # the epoch fence: every shard the migration touched rotated,
+            # so no pre-migration cursor can claim delta coverage
+            for s, pre in zip(servers[:2], pre_epochs):
+                if reg.counter(
+                    "karpenter_store_shard_migration_begun_total",
+                    {"shard": str(s.shard_index)},
+                ):
+                    assert s.store.epoch != pre
+            # begun == committed: nothing stuck
+            for s in servers[:2]:
+                shard = {"shard": str(s.shard_index)}
+                assert reg.counter(
+                    "karpenter_store_shard_migration_begun_total", shard
+                ) == reg.counter(
+                    "karpenter_store_shard_migration_committed_total", shard
+                )
+        finally:
+            if writer is not None:
+                writer.close()
+            for s in servers:
+                s.stop()
+
+    def test_doctor_names_a_stuck_migration(self):
+        from karpenter_tpu.obs.doctor import ledger_events, suspected_causes
+
+        ticks = [
+            {"counters": {
+                "karpenter_store_shard_migration_begun_total{shard=1}": 1.0,
+            }},
+            {"counters": {}},
+        ]
+        causes = suspected_causes(ticks, ledger_events(ticks), {})
+        assert any("stuck in migration" in c for c in causes), causes
+        # a committed migration is NOT a cause
+        ticks[1]["counters"] = {
+            "karpenter_store_shard_migration_committed_total{shard=1}": 1.0,
+        }
+        causes = suspected_causes(ticks, ledger_events(ticks), {})
+        assert not any("stuck in migration" in c for c in causes), causes
+
+
+# ------------------------------------------- reconnect-backoff pace seam
+class TestPaceSeam:
+    def test_injected_pace_sees_exponential_capped_backoff(self):
+        """Satellite (b): all reconnect waiting routes through the ONE
+        injectable pace callable — the injected pacer observes the
+        exponential backoff schedule and can stop the loop."""
+        delays = []
+
+        def pace(delay_s):
+            delays.append(delay_s)
+            return len(delays) >= 6  # True stops the loop
+
+        def dial():
+            raise ConnectionError("scripted: server down")
+
+        WatchChannelClient(
+            dial=dial,
+            hello=dict,
+            tx=lambda sock, payload: None,
+            rx=lambda sock, codec: b"",
+            on_epoch=lambda epoch: None,
+            on_legacy_snapshot=lambda snap: None,
+            on_frame=lambda frame, initial: None,
+            stop=threading.Event(),
+            backoff_s=0.05,
+            backoff_max=0.3,
+            pace=pace,
+        ).run()  # returns because pace said stop — no wall-clock sleeps
+        assert delays == [0.05, 0.1, 0.2, 0.3, 0.3, 0.3]
+
+    def test_remote_store_routes_reconnects_through_watch_pace(self):
+        """RemoteKubeStore(watch_pace=...) hands the seam to its watch
+        loops: with the server gone, reconnect attempts wait on the
+        injected pacer instead of the wall clock."""
+        srv = StoreServer().start_background()
+        host, port = srv.address
+        paced = threading.Event()
+
+        def pace(_delay_s):
+            paced.set()
+            return False  # keep reconnecting
+
+        client = RemoteKubeStore(
+            host, port, identity="paced", watch_pace=pace
+        )
+        try:
+            assert client.wait_synced(timeout=8.0)
+            srv.stop()
+            _wait(paced.is_set, msg="watch loop consulted the pace seam")
+        finally:
+            client.close()
+
+
+# ------------------------------------- epoch rotation under double-restart
+class TestDoubleRestartEpoch:
+    def test_client_lands_on_newest_epoch_with_full_resync(self):
+        """Satellite (c): two server restarts inside one client
+        reconnect window.  The middle incarnation's epoch must never
+        leak into the client's cursor — when the reconnect finally
+        happens it presents a cursor the NEWEST server rejects as
+        foreign-epoch and answers with a full snapshot; the client's
+        mirror is the newest state, and no stale-epoch replay occurred."""
+        srv1 = StoreServer().start_background()
+        host, port = srv1.address
+        gate = threading.Event()
+        gated = threading.Event()
+
+        def pace(_delay_s):
+            gated.set()
+            gate.wait(timeout=30.0)
+            return False
+
+        client = RemoteKubeStore(
+            host, port, identity="survivor", watch_pace=pace
+        )
+        srv2 = srv3 = writer = None
+        try:
+            assert client.wait_synced(timeout=8.0)
+            client.put_pod(Pod(name="era1", requests=Resources(cpu=1)))
+            _wait(lambda: "default/era1" in client.pods, msg="era1 mirrored")
+            epoch1 = srv1.store.epoch
+
+            # restart #1: the client's watch loop hits the dead socket
+            # and parks on the gated pacer — its reconnect window is OPEN
+            srv1.stop()
+            _wait(gated.is_set, msg="client parked in reconnect backoff")
+            srv2 = StoreServer(host=host, port=port).start_background()
+            writer = RemoteKubeStore(
+                host, port, identity="w2", start_watch=False
+            )
+            writer.put_pod(Pod(name="era2", requests=Resources(cpu=1)))
+            writer.close()
+            writer = None
+            epoch2 = srv2.store.epoch
+
+            # restart #2, still inside the same window
+            srv2.stop()
+            srv3 = StoreServer(host=host, port=port).start_background()
+            writer = RemoteKubeStore(
+                host, port, identity="w3", start_watch=False
+            )
+            writer.put_pod(Pod(name="era3", requests=Resources(cpu=1)))
+            epoch3 = srv3.store.epoch
+            assert len({epoch1, epoch2, epoch3}) == 3
+
+            gate.set()  # release the one reconnect
+            _wait(
+                lambda: client._watch_epoch == epoch3,
+                msg="client adopted the newest epoch",
+            )
+            _wait(
+                lambda: "default/era3" in client.pods
+                and "default/era1" not in client.pods
+                and "default/era2" not in client.pods,
+                msg="mirror is the newest incarnation's state",
+            )
+            # the resync was a SNAPSHOT — a stale-epoch delta would have
+            # registered as 'replay' (and left era1 behind)
+            assert client.watch_resyncs.get("snapshot", 0) >= 1
+            assert client.watch_resyncs.get("replay", 0) == 0
+        finally:
+            gate.set()
+            client.close()
+            if writer is not None:
+                writer.close()
+            for s in (srv1, srv2, srv3):
+                if s is not None:
+                    s.stop()
+
+
+# --------------------------------------------- restart-from-disk (delta)
+class TestDurableRestart:
+    def test_restarted_server_serves_delta_from_disk(self, tmp_path):
+        """The tentpole's durability claim, unit-scale: a killed store
+        restarted over its segment re-adopts its epoch and serves a
+        reconnecting mirror a DELTA resync — never a snapshot."""
+        path = str(tmp_path / "shard-0.log")
+        srv1 = StoreServer(
+            store=VersionedStore(
+                durable_log=DurableReplayLog(path, fsync=FSYNC_OFF)
+            )
+        ).start_background()
+        host, port = srv1.address
+        gate = threading.Event()
+
+        def pace(_delay_s):
+            gate.wait(timeout=30.0)
+            return False
+
+        client = RemoteKubeStore(
+            host, port, identity="mirror", watch_pace=pace
+        )
+        srv2 = writer = None
+        try:
+            assert client.wait_synced(timeout=8.0)
+            # seed through a SEPARATE writer: the fan-out skips the
+            # originator, so a mirror's own writes never advance its
+            # watch cursor — the delta claim needs a real cursor
+            seeder = RemoteKubeStore(
+                host, port, identity="seed", start_watch=False
+            )
+            for i in range(20):
+                seeder.put_pod(Pod(name=f"pre{i}", requests=Resources(cpu=1)))
+            seeder.close()
+            _wait(
+                lambda: client._watch_seq == srv1.store.log_seq,
+                msg="watch cursor caught up",
+            )
+            epoch1 = srv1.store.epoch
+
+            srv1.stop()  # crash: the client parks on the gated pacer
+            srv2 = StoreServer(
+                host=host,
+                port=port,
+                store=VersionedStore(
+                    durable_log=DurableReplayLog(path, fsync=FSYNC_OFF)
+                ),
+            ).start_background()
+            # same epoch, same seq space, state recovered from disk
+            assert srv2.store.epoch == epoch1
+            assert len(srv2.store.kube.pods) == 20
+            writer = RemoteKubeStore(
+                host, port, identity="w", start_watch=False
+            )
+            writer.put_pod(Pod(name="post", requests=Resources(cpu=1)))
+
+            gate.set()
+            _wait(
+                lambda: "default/post" in client.pods
+                and len(client.pods) == 21,
+                msg="mirror resynced across the restart",
+            )
+            # the gap was served from the recovered disk tail: a replay,
+            # not a snapshot
+            assert client.watch_resyncs.get("replay", 0) >= 1
+            assert client.watch_resyncs.get("snapshot", 0) == 0
+        finally:
+            gate.set()
+            client.close()
+            if writer is not None:
+                writer.close()
+            for s in (srv1, srv2):
+                if s is not None:
+                    s.stop()
+
+
+# ----------------------------------------------- sharded client end-to-end
+class TestShardedClient:
+    def test_fanout_merge_leases_and_migrated_fencing(self):
+        """The sharded RemoteKubeStore in one pass: writes fan out to
+        their hash owners, N watch streams merge into one mirror, leases
+        pin to shard 0, a live 4→5 reshard keeps every mirror
+        consistent, and a migrated key's dirty-flush fencing survives
+        because its per-key rv moved with it."""
+        servers = [
+            StoreServer(
+                store=VersionedStore(), shard_index=i
+            ).start_background()
+            for i in range(4)
+        ]
+        a = b = None
+        try:
+            addrs = [s.address for s in servers]
+            a = RemoteKubeStore(identity="writer", shards=addrs)
+            b = RemoteKubeStore(identity="reader", shards=addrs)
+            for k in range(24):
+                a.put_pod(Pod(name=f"p{k}", requests=Resources(cpu=1)))
+                a.put_node(Node(name=f"n{k}", capacity=Resources(cpu=8)))
+            for k in range(24):
+                owner = shard_of("Pod", f"default/p{k}", 4)
+                assert f"default/p{k}" in servers[owner].store.kube.pods
+            assert a.wait_synced(timeout=8.0)
+            assert b.wait_synced(timeout=8.0)
+            _wait(
+                lambda: len(b.pods) == 24 and len(b.nodes) == 24,
+                msg="merged mirror",
+            )
+
+            # leases pin to shard 0; CAS refuses the second holder
+            assert a.try_acquire_lease(
+                "leader", "writer", now=100.0, duration_s=30.0
+            )
+            assert "leader" in servers[0].store.kube.leases
+            assert all(
+                "leader" not in s.store.kube.leases for s in servers[1:]
+            )
+            assert not b.try_acquire_lease(
+                "leader", "reader", now=101.0, duration_s=30.0
+            )
+
+            # events route by obj_name and merge into every mirror
+            a.record_event("Normal", "Launched", "default/p0", "up")
+            _wait(lambda: len(b.events) >= 1, msg="merged events")
+
+            # live reshard 4 → 5 under the epoch fence
+            servers.append(
+                StoreServer(
+                    store=VersionedStore(), shard_index=4
+                ).start_background()
+            )
+            new_addrs = [s.address for s in servers]
+            stats = ShardCoordinator().reshard(addrs, new_addrs)
+            assert stats["moved_keys"] > 0
+            a.apply_topology(new_addrs)
+            b.apply_topology(new_addrs)
+            a.put_pod(Pod(name="post-migrate", requests=Resources(cpu=1)))
+            assert a.wait_synced(timeout=8.0)
+            assert b.wait_synced(timeout=8.0)
+            _wait(lambda: len(b.pods) == 25, msg="post-reshard mirror")
+
+            # dirty-flush fencing at the MIGRATED owner: mutate the
+            # mirror in place; the lease acquire flushes dirty state
+            # with the per-key base_rv that traveled with the key
+            b.pods["default/p3"].labels["smoke"] = "dirty"
+            assert b.try_acquire_lease(
+                "flush", "reader", now=200.0, duration_s=30.0
+            )
+            owner = shard_of("Pod", "default/p3", 5)
+            _wait(
+                lambda: servers[owner]
+                .store.kube.pods["default/p3"]
+                .labels.get("smoke")
+                == "dirty",
+                msg="dirty flush fenced at the migrated owner",
+            )
+        finally:
+            for c in (a, b):
+                if c is not None:
+                    c.close()
+            for s in servers:
+                s.stop()
