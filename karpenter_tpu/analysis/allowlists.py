@@ -25,12 +25,15 @@ from typing import Dict
 _WALL_CLOCK = frozenset({"karpenter_tpu/utils/clock.py"})
 
 # rule 4: the sanctioned scheduler.update call sites in controllers/ —
-# the provisioner's one-per-solve refresh, the deprovisioner's explicit
+# the provisioner's one-per-solve sync (extracted to _sync_scheduler so
+# the batched solve and the admission fast path share exactly ONE update
+# per provisioning pass), the deprovisioner's explicit
 # sequential-simulation fallback, and the batched evaluator's
 # once-per-pass full-cluster sync.
 _SCHEDULER_UPDATE = frozenset(
     {
-        ("karpenter_tpu/controllers/provisioning.py", "Provisioner.provision"),
+        ("karpenter_tpu/controllers/provisioning.py",
+         "Provisioner._sync_scheduler"),
         ("karpenter_tpu/controllers/disruption.py",
          "DisruptionController._simulate"),
         ("karpenter_tpu/controllers/disruption.py",

@@ -164,6 +164,12 @@ class CallSiteRule(Rule):
     scan: tuple = ()  # rel_in_pkg prefixes; () = whole package
     loop_tag = True
     advice = ""
+    # DENY fence: rel-path suffixes where no allowlist entry may ever
+    # sanction a match — the rule fires there even when an entry exists,
+    # and the entry itself is flagged.  This is how a module whose whole
+    # contract is "never does X" (the admission fast path vs tensorize)
+    # stays un-allowlistable by construction.
+    deny: tuple = ()
 
     def match(self, node: ast.Call, name: Optional[str]) -> Optional[str]:
         """The matched display name, or None.  Subclasses with richer
@@ -174,21 +180,43 @@ class CallSiteRule(Rule):
         out: List[Finding] = []
         rule = self
 
+        if self.deny:
+            # an allowlist entry pointing into a DENIED file is itself a
+            # finding: the fence must be visible at review time, not
+            # only when someone writes the forbidden call
+            for entry in sorted(allowlist, key=repr):
+                rel_entry = entry[0] if isinstance(entry, tuple) else entry
+                if isinstance(rel_entry, str) and rel_entry.endswith(
+                    self.deny
+                ):
+                    out.append(
+                        self.finding(
+                            rel_entry, 0,
+                            f"allowlist entry {entry!r} references a "
+                            f"DENIED file — no exception to "
+                            f"'{self.title}' may be sanctioned there",
+                        )
+                    )
+
         for info in snap.in_package(*self.scan):
             rel = info.rel
+            # str.endswith(()) is False, so an empty deny never matches
+            denied = rel.endswith(self.deny)
 
             class V(ScopedVisitor):
                 def on_call(self, node):
                     matched = rule.match(node, call_name(node))
                     if matched is None:
                         return
-                    if (rel, self.qual) in allowlist:
+                    if (rel, self.qual) in allowlist and not denied:
                         return
                     where = (
                         "INSIDE A LOOP"
                         if rule.loop_tag and self.loops
                         else "call"
                     )
+                    if denied:
+                        where += ", DENIED file"
                     out.append(
                         rule.finding(
                             rel, node.lineno,
@@ -238,6 +266,10 @@ class FullTensorizeRule(CallSiteRule):
     guards = "the resident-tensor warm path (35 ms flagship p50)"
     names = frozenset({"compile_problem", "_compile_tensor"})
     scan = ("controllers/", "scheduling/")
+    # the admission fast path's sub-millisecond budget is STRUCTURAL:
+    # its module may never tensorize, and no future allowlist entry may
+    # carve an exception (docs/designs/admission-fastpath.md)
+    deny = ("scheduling/fastpath.py",)
     advice = (
         "route warm updates through the resident delta path, or "
         "consciously allowlist a cold-build/rebuild site"

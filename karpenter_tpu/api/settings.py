@@ -27,6 +27,18 @@ class Settings:
     # clock instead of wall time
     provision_batch_idle_s: float = 1.0
     provision_batch_max_s: float = 10.0
+    # single-pod admission fast path (docs/designs/admission-fastpath.md):
+    # a fresh tiny-burst arrival with nothing else pending is scattered
+    # into the resident tensors and scored in one admit dispatch, then
+    # nominated immediately — the periodic batched solve stays
+    # authoritative and must converge identically (the mismatch counter
+    # pins it); off restores the pure batch-window behavior
+    enable_admission_fastpath: bool = True
+    # singleton batch-window bypass: a LONE pending pod has nothing to
+    # coalesce with, so when the fast path declines (or is off) it is
+    # released to the batched solve immediately instead of waiting out
+    # provision_batch_idle_s
+    provision_fastpath_bypass: bool = True
     # pipelined reconcile (pipeline.py + docs/designs/pipelined-reconcile
     # .md): the disruption controller speculatively DISPATCHES its
     # consolidation search's device rounds at tick boundaries so the

@@ -45,6 +45,7 @@ from karpenter_tpu.metrics.registry import (
     export_compile_cache_counters,
     export_resident_counters,
 )
+from karpenter_tpu.scheduling import fastpath
 from karpenter_tpu.scheduling.scheduler import SchedulingResult, VirtualNode
 from karpenter_tpu.scheduling.solver import TensorScheduler
 from karpenter_tpu.state.cluster import Cluster
@@ -144,11 +145,83 @@ class Provisioner:
             "karpenter_pods_pending_age_seconds",
             max((now - t0 for t0 in self._first_seen.values()), default=0.0),
         )
+        # FRESH pods (never observed by the batcher) are the admission
+        # fast path's input: computed BEFORE observe() marks them seen
+        fresh = [p for p in pending if p.key() not in self.batcher._seen]
         self.batcher.observe(pending)
-        if not pending or not self.batcher.ready():
+        if not pending:
+            return []
+        if (
+            fresh
+            and len(fresh) == len(pending)
+            and self.settings.enable_admission_fastpath
+        ):
+            # single-pod / tiny-burst arrival with nothing else waiting:
+            # try the sub-millisecond path (scatter + one admit dispatch
+            # + oracle cross-check + nominate) before any batch window
+            # opens.  Stale pending pods disqualify the tick — the admit
+            # score equals the full solve only when the arriving class
+            # is the sole work (docs/designs/admission-fastpath.md).
+            claims = self._admit_fastpath(pending)
+            if claims is not None:
+                return claims
+        if not self.batcher.ready():
+            if (
+                self.settings.provision_fastpath_bypass
+                and len(pending) == 1
+                and fresh
+            ):
+                # singleton-bypass bug fix: a lone pending pod with no
+                # batch-mates used to wait the FULL idle window before
+                # any solve — there is nothing to coalesce with, so when
+                # the fast path declines (or is off), release it to the
+                # batched solve immediately
+                self.batcher.reset()
+                return self.provision(pending)
             return []
         self.batcher.reset()
         return self.provision(pending)
+
+    def _admit_fastpath(self, pods: Sequence[Pod]) -> Optional[List[NodeClaim]]:
+        """One fast-path admission attempt.  Returns the tick's claim
+        list ([] — nominations never launch nodes) when the pods were
+        nominated, or None when the batched solve must run (fallback or
+        mismatch, both counted with their reason)."""
+        scheduler = self._sync_scheduler(pods)
+        if scheduler is None:
+            self.registry.inc(
+                "karpenter_admission_fastpath_total", {"outcome": "fallback"}
+            )
+            self.registry.inc(
+                "karpenter_admission_fastpath_fallback_total",
+                {"reason": fastpath.REASON_NO_POOLS},
+            )
+            return None
+        res = fastpath.try_admit(scheduler, pods)
+        self.registry.inc(
+            "karpenter_admission_fastpath_total", {"outcome": res.outcome}
+        )
+        if res.outcome == "mismatch":
+            # convergence-contract violation: the device score disagreed
+            # with the sequential host oracle.  Never trust the device
+            # half of a disagreement — the batched solve decides.
+            self.registry.inc("karpenter_admission_fastpath_mismatch_total")
+            return None
+        if res.outcome != "nominated":
+            self.registry.inc(
+                "karpenter_admission_fastpath_fallback_total",
+                {"reason": res.reason},
+            )
+            return None
+        for pod_key, node_name in res.placements.items():
+            self.cluster.nominate(pod_key, node_name)
+            self.registry.event(
+                "PodNominated", pod=pod_key, node=node_name,
+                placement="existing",
+            )
+            self._observe_scheduled(pod_key, path="fast")
+        self.batcher.reset()
+        return []
 
     def _provisionable_pods(self) -> List[Pod]:
         """Pending pods not already nominated onto an in-flight node."""
@@ -162,11 +235,17 @@ class Provisioner:
         return out
 
     # -------------------------------------------------------------- provision
-    def provision(self, pods: Sequence[Pod]) -> List[NodeClaim]:
-        """One scheduling solve + launches for a closed pod batch."""
+    def _sync_scheduler(self, pods: Sequence[Pod]) -> Optional[TensorScheduler]:
+        """Sync the long-lived scheduler against the live snapshot: pool
+        filter, volume-requirement resolution, inventory fetch, limits
+        headroom, and the ONE sanctioned `scheduler.update` call for the
+        provisioning layer (lint rule 4's allowlist points here) —
+        shared by the batched solve and the admission fast path so both
+        score against identical state.  Returns None when there is
+        nothing to schedule against."""
         pools = [p for p in self.kube.node_pools.values() if not p.deleted]
         if not pools or not pods:
-            return []
+            return None
         for p in pods:
             resolve_volume_requirements(p, self.kube)
         inventory: Dict[str, list] = {}
@@ -199,12 +278,27 @@ class Provisioner:
                 pool, inventory[pool.name],
                 usage_by_pool.get(pool.name, Resources()),
             )
-        scheduler = self.scheduler.update(
+        ts = self.scheduler.update(
             pools,
             inventory,
             existing=snapshot,
             daemonsets=self.kube.daemonset_pods(),
         )
+        if ts is not None:
+            # open the resident cache's tick trust window over the fresh
+            # snapshot: every refresh this tick (each fast-path admission,
+            # the batched solve's delta) reuses one O(cluster) invariant
+            # scan instead of paying it per call.  Nothing mutates
+            # `existing` between here and those refreshes — the next
+            # reconcile re-syncs and re-opens the window.
+            ts._resident.note_sync(ts)
+        return ts
+
+    def provision(self, pods: Sequence[Pod]) -> List[NodeClaim]:
+        """One scheduling solve + launches for a closed pod batch."""
+        scheduler = self._sync_scheduler(pods)
+        if scheduler is None:
+            return []
         with self.registry.time("karpenter_provisioner_scheduling_duration_seconds"):
             result = scheduler.solve(pods)
         self.registry.inc(
@@ -245,14 +339,19 @@ class Provisioner:
             self._observe_scheduled(pod_key)
         return self._launch(result)
 
-    def _observe_scheduled(self, pod_key: str) -> None:
+    def _observe_scheduled(self, pod_key: str, path: str = "batch") -> None:
         """Pod first-seen-pending -> nominated latency (the scheduling SLO
-        the sim report aggregates into p50/p95/p99)."""
+        the sim report aggregates into p50/p95/p99), attributed to the
+        admission path that nominated it (fast vs batch) on the split
+        histogram; the legacy unsplit series keeps its full stream."""
         t0 = self._first_seen.pop(pod_key, None)
         if t0 is not None:
+            dt = max(self.clock.now() - t0, 0.0)
             self.registry.observe(
-                "karpenter_pods_time_to_schedule_seconds",
-                max(self.clock.now() - t0, 0.0),
+                "karpenter_pods_time_to_schedule_seconds", dt
+            )
+            self.registry.observe(
+                "karpenter_admission_latency_seconds", dt, {"path": path}
             )
 
     def _headroom_types(self, pool, types, usage: Resources) -> list:

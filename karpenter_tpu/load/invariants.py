@@ -237,3 +237,4 @@ class VectorInvariantChecker(InvariantChecker):
                 )
 
         self._check_gangs()
+        self._check_fastpath_convergence()

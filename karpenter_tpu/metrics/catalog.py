@@ -51,6 +51,41 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "simulator's SLO report (sim/report.py) aggregates its samples "
         "into p50/p95/p99 time-to-schedule",
     ),
+    "karpenter_admission_latency_seconds": (
+        "histogram",
+        "path",
+        "pod first-seen-pending -> nominated, split by the admission "
+        "path that nominated it: fast (the single-pod resident admit "
+        "dispatch) vs batch (the authoritative coalesced solve).  Same "
+        "clock and endpoints as karpenter_pods_time_to_schedule_seconds "
+        "— that legacy series keeps the unsplit stream",
+    ),
+    "karpenter_admission_fastpath_total": (
+        "counter",
+        "outcome",
+        "admission fast-path attempts: nominated (pods placed onto live "
+        "nodes in one admit dispatch), fallback (ineligible or no fit — "
+        "the batched solve runs, reason on the fallback counter), "
+        "mismatch (device score refuted by the sequential host oracle)",
+    ),
+    "karpenter_admission_fastpath_fallback_total": (
+        "counter",
+        "reason",
+        "fast-path declines by reason (docs/designs/admission-fastpath"
+        ".md taxonomy): burst_too_large, mixed_burst, pod_shape, "
+        "affinity_carrier, catalog_roll, resident_cold, resident_miss, "
+        "sharded_backend, needs_new_node, unschedulable, no_pools — "
+        "every one lands in the batched solve, never a mis-nomination",
+    ),
+    "karpenter_admission_fastpath_mismatch_total": (
+        "counter",
+        "(none)",
+        "admit-dispatch verdicts refuted by the sequential host oracle "
+        "(bit-equality over the take vector, placed count, and "
+        "open-capacity bit).  The convergence contract requires this to "
+        "stay 0 — the sim/load invariant planes fail the run otherwise; "
+        "a mismatch never nominates (the batched solve decides)",
+    ),
     "karpenter_sim_events_injected_total": (
         "counter",
         "kind",

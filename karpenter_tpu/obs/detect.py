@@ -59,6 +59,11 @@ WATCHED_FAMILIES = (
     # store plane: the client half's per-RPC latency (state/remote.py)
     # — a store server falling over shows up here first, per method
     "karpenter_store_rpc_seconds",
+    # admission path split (controllers/provisioning.py): the fast
+    # path's pod->nomination latency blowing up — or the batch series
+    # absorbing traffic the fast path used to take — judges exactly
+    # like a phase blowup, attributed per path label
+    "karpenter_admission_latency_seconds",
 )
 
 _MAD_SCALE = 1.4826  # MAD -> stddev-equivalent under normality

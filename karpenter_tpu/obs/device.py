@@ -67,13 +67,28 @@ from karpenter_tpu.utils.trace import TRACER
 from karpenter_tpu.analysis.sanitizer import make_lock, note_access
 
 
+# str(np.dtype) costs microseconds and dispatch signatures sit on the
+# fast path's per-admission budget; dtype objects are interned, so the
+# names memoize cleanly
+_DTYPE_NAMES: Dict = {}
+
+
+def _dtype_name(dt) -> str:
+    name = _DTYPE_NAMES.get(dt)
+    if name is None:
+        name = str(dt)
+        _DTYPE_NAMES[dt] = name
+    return name
+
+
 def _sig_part(v) -> tuple:
     """One argument's contribution to a dispatch signature: arrays by
     (shape, dtype) — values are data, not trace constants — everything
     else (static kwargs like k_slots/objective) by value or type name."""
     shape = getattr(v, "shape", None)
     if shape is not None:
-        return ("a", tuple(shape), str(getattr(v, "dtype", "")))
+        dt = getattr(v, "dtype", None)
+        return ("a", tuple(shape), _dtype_name(dt) if dt is not None else "")
     if isinstance(v, (int, float, str, bool, type(None))):
         return ("s", v)
     return ("t", type(v).__name__)

@@ -52,6 +52,7 @@ _FAMILY_SHORT = {
     "karpenter_provisioner_scheduling_duration_seconds": "scheduling",
     "karpenter_device_compile_seconds": "device_compile",
     "karpenter_store_rpc_seconds": "store_rpc",
+    "karpenter_admission_latency_seconds": "admission",
 }
 
 # device-rule thresholds: a warm tick's upload bytes must not grow past
@@ -321,6 +322,46 @@ def suspected_causes(
                 f"({base_r:g} -> {rec_r:g}) — the uploads are not "
                 "justified by the cluster delta"
             )
+
+    # ---- admission fast path rules (controllers/provisioning.py) ------
+    # fallback storm: the single-pod fast path declining at a spiking
+    # rate after the split — every decline re-routes an arrival through
+    # the batched solve (latency regression for exactly the traffic the
+    # fast path exists for), and the dominant reason names the trigger
+    # (catalog_roll -> resident tensors obsoleted; resident_miss ->
+    # delta planner churn; pod_shape -> the workload stopped being plain)
+    fb_per_tick = [0.0] * len(ticks)
+    fb_reasons: Dict[str, float] = {}
+    fp_mismatches = 0.0
+    for i, tick in enumerate(ticks):
+        for key, delta in tick.get("counters", {}).items():
+            name, labels = _parse_series(key)
+            if name == "karpenter_admission_fastpath_fallback_total":
+                fb_per_tick[i] += float(delta)
+                reason = labels.get("reason", "?")
+                fb_reasons[reason] = fb_reasons.get(reason, 0.0) + float(delta)
+            elif name == "karpenter_admission_fastpath_mismatch_total":
+                fp_mismatches += float(delta)
+    fb_before, fb_after = sum(fb_per_tick[:split]), sum(fb_per_tick[split:])
+    if fb_after > fb_before and fb_after >= 4:
+        top = max(fb_reasons, key=fb_reasons.get) if fb_reasons else "?"
+        causes.append(
+            f"admission fast-path fallback storm: {int(fb_after)} "
+            f"fallback(s) in the {len(ticks) - split} tick(s) after the "
+            f"split vs {int(fb_before)} before — single-pod arrivals are "
+            f"re-routing through the batched solve; dominant reason "
+            f"'{top}' ({int(fb_reasons.get(top, 0))} of "
+            f"{int(sum(fb_reasons.values()))})"
+        )
+    if fp_mismatches:
+        causes.append(
+            f"{int(fp_mismatches)} admission fast-path verdict "
+            "mismatch(es): the admit dispatch disagreed with the "
+            "sequential host oracle — the convergence contract requires "
+            "this counter to stay 0; no pod was nominated off the "
+            "disagreeing verdict, but the device arithmetic (or the "
+            "resident mirrors) has drifted and needs a bug hunt"
+        )
 
     # warm-recompile attributions are causes by construction
     for i, ev in events:

@@ -194,6 +194,53 @@ def pack_kernel(
     )
 
 
+@jax.jit
+def admit_kernel(
+    req: jax.Array,  # [G, R] float32 — resident class requests
+    cnt: jax.Array,  # [G] int32
+    feas: jax.Array,  # [G, C] bool
+    alloc: jax.Array,  # [C, R] float32
+    openable: jax.Array,  # [C] bool
+    used0: jax.Array,  # [K, R] float32 — live-node prefill
+    cfg0: jax.Array,  # [K] int32 (fe+k on live columns, -1 past them)
+    g: jax.Array,  # int32 — the single class row to score
+) -> jax.Array:
+    """The single-pod admission score: ONE tiny fused dispatch over the
+    device-resident buffers (docs/designs/admission-fastpath.md).
+
+    This is exactly `_pack_core`'s existing-node fill for one class —
+    the same `_per_node_cap` row math, the same feasibility gate, the
+    same exclusive-cumsum first-fit prefix — with the scan, the
+    signature counters, and the new-node opening all dropped, because
+    the fast path's eligibility gate guarantees they are vacuous for
+    the resident plain shape (maxper=BIG, sig0=0, single live class).
+    Sharing `_per_node_cap` keeps the arithmetic provably identical to
+    the authoritative solve: both paths floor the same float32 ratios,
+    so the sequential host oracle in scheduling/fastpath.py can demand
+    bit-equality, not tolerance.
+
+    Returns ONE [K+2] int32 array — take-per-slot, total placed, and an
+    open-capacity bit (some openable config fits the class, i.e. the
+    batched solve could still place it on a NEW node) — so the host
+    fetch is exactly one transfer.
+    """
+    req_g = req[g]
+    feas_g = feas[g]
+    valid = cfg0 >= 0
+    cfg_safe = jnp.maximum(cfg0, 0)
+    rem = alloc[cfg_safe] - used0  # [K, R]
+    cap = _per_node_cap(rem, req_g)  # [K]
+    cap = jnp.where(valid & feas_g[cfg_safe], cap, 0)
+    prefix = jnp.cumsum(cap) - cap  # exclusive cumsum: first-fit order
+    take1 = jnp.clip(cnt[g] - prefix, 0, cap)
+    placed = take1.sum()
+    cap_open = _per_node_cap(alloc, req_g)  # [C]
+    open_ok = (feas_g & openable & (cap_open > 0)).any()
+    return jnp.concatenate(
+        [take1, jnp.stack([placed, open_ok.astype(jnp.int32)])]
+    )
+
+
 @partial(
     jax.jit, static_argnames=("Gp", "Cp", "Kp", "R", "Sp", "objective")
 )

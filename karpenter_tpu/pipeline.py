@@ -31,6 +31,14 @@ This module is also the sanctioned home for thread construction in the
 controller layer: :func:`run_concurrently` is the one fan-out primitive
 (lint rule 11 fences raw ``ThreadPoolExecutor``/``Thread`` construction
 in controllers/operator to this seam).
+
+The admission fast path (scheduling/fastpath.py) needs no stage of its
+own: a fast-path nomination happens INSIDE the provisioner's mutate
+stage, in the canonical sequence position, exactly where the batched
+solve would have nominated — so the disruption controller's speculation
+fingerprints (which hash cluster state AFTER the provisioning slot)
+observe identical state whether an arrival took the fast or the batched
+path, and pipelining composes with the fast path with no new join.
 """
 
 from __future__ import annotations

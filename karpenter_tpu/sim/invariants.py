@@ -92,6 +92,10 @@ class InvariantChecker:
         # gang membership (GANG_LABEL pods), maintained from the same
         # watch: key -> (gang name, declared size)
         self._gang_pods: Dict[str, tuple] = {}
+        # high-water mark of the admission fast path's mismatch counter:
+        # the convergence contract says it stays 0, and the invariant
+        # plane fails the run the tick it first moves
+        self._fastpath_mismatch_seen = 0.0
         # a pod evicted (consolidation, drain) or re-pended by a node
         # deletion starts a FRESH scheduling wait — without re-arming, a
         # long-lived pod evicted late in a long run would instantly
@@ -267,6 +271,25 @@ class InvariantChecker:
                 del self.pod_created[key]
 
         self._check_gangs()
+        self._check_fastpath_convergence()
+
+    def _check_fastpath_convergence(self) -> None:
+        """The admission fast path's convergence contract: the device
+        admit score must never disagree with the sequential host oracle
+        (karpenter_admission_fastpath_mismatch_total stays 0).  Shared
+        verbatim by the vectorized plane — one counter read, nothing to
+        vectorize."""
+        seen = self.env.registry.counter(
+            "karpenter_admission_fastpath_mismatch_total"
+        )
+        if seen > self._fastpath_mismatch_seen:
+            self._fail(
+                "fastpath-convergence",
+                f"karpenter_admission_fastpath_mismatch_total rose to "
+                f"{int(seen)}: the admit dispatch disagreed with the "
+                "sequential host oracle",
+            )
+            self._fastpath_mismatch_seen = seen
 
     def _check_gangs(self) -> None:
         """Gang atomicity: every gang must end the tick with zero or ALL

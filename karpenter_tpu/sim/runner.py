@@ -751,6 +751,28 @@ def _resident_churn(ticks: int) -> Scenario:
 
 
 @scenario(
+    "single-pod-trickle",
+    "one pod at a time against warm resident capacity — the admission "
+    "fast path's home turf: a low steady trickle keeps nearly every "
+    "arrival a lone fresh pod, light churn keeps headroom open on live "
+    "nodes, and the fast path must nominate most of them in one admit "
+    "dispatch (fastpath outcome=nominated > 0, mismatch counter 0) "
+    "while record/replay stays byte-identical with the fast path live",
+)
+def _single_pod_trickle(ticks: int) -> Scenario:
+    return Scenario(
+        "single-pod-trickle",
+        workloads=[
+            # sparse enough that simultaneous arrivals are rare (the
+            # lone-fresh-pod shape), dense enough that the fast path
+            # gets real traffic over a 60-tick run
+            Steady(rate=0.35),
+            Churn(rate=0.1),
+        ],
+    )
+
+
+@scenario(
     "consolidation-storm",
     "over-provisioned fleet on small shapes + a deep diurnal trough + "
     "background spot interruptions: flash crowds spin up many small "

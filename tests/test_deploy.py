@@ -133,6 +133,9 @@ class TestChart:
         assert settings.enable_pipelined_reconcile is True
         assert settings.launch_max_concurrency == 64
         assert settings.enable_profiling is False
+        # the admission fast path's chart knobs flow end to end
+        assert settings.enable_admission_fastpath is True
+        assert settings.provision_fastpath_bypass is True
 
     def test_controller_matches_entry_point_contract(self):
         docs = _docs()

@@ -1,0 +1,473 @@
+"""Admission fast path (docs/designs/admission-fastpath.md): the twin
+contract (fast path on/off converge to identical placements), the
+eligibility boundary (constrained shapes fall back with the right
+counted reason and NEVER mis-nominate), the singleton batch-window
+bypass, the single-pod-trickle sim scenario's byte-identity with the
+fast path live, and the doctor's fallback-storm / verdict-mismatch
+rules over a forged flight dump."""
+
+import pytest
+
+from karpenter_tpu.api import Pod, Resources, Settings
+from karpenter_tpu.api import labels as L
+from karpenter_tpu.api.objects import PodAffinityTerm, reset_name_sequences
+from karpenter_tpu.scheduling import TensorScheduler, fastpath
+from karpenter_tpu.state.cluster import StateNode
+from karpenter_tpu.testing import Environment
+
+SIZE = Resources(cpu=0.25, memory="512Mi")
+ZONES = ("zone-a", "zone-b", "zone-c")
+
+
+def _live_node(pool_name: str, i: int, pods=()) -> StateNode:
+    return StateNode(
+        name=f"live-{i}",
+        provider_id=f"fake://live-{i}",
+        labels={
+            L.LABEL_ZONE: ZONES[i % len(ZONES)],
+            L.LABEL_NODEPOOL: pool_name,
+        },
+        taints=[],
+        allocatable=Resources(cpu=64, memory="256Gi", pods=110),
+        pods=list(pods),
+        used=Resources(),
+    )
+
+
+def _warm_scheduler(n_nodes: int = 4, seed_pods: int = 4):
+    """A resident-warm TensorScheduler over live headroom: the unit-test
+    twin of the provisioner's synced scheduler.  The seed batch is kept
+    small so every later refresh's churn (drops the seeds, adds the
+    arrivals) stays inside the delta planner's budget, while still
+    opening enough node-slot bucket headroom for a max-size burst."""
+    env = Environment()
+    pool = env.default_node_pool()
+    nc = env.default_node_class()
+    types = env.instance_types.list(pool, nc)
+    existing = [_live_node(pool.name, i) for i in range(n_nodes)]
+    ts = TensorScheduler([pool], {pool.name: types}, existing=existing)
+    ts.solve([Pod(name=f"seed-{i}", requests=SIZE) for i in range(seed_pods)])
+    assert ts._resident.states, "seed solve must warm the resident plane"
+    return ts
+
+
+# ------------------------------------------------------------ happy path
+class TestAdmit:
+    def test_single_fresh_pod_nominated_onto_live_node(self):
+        ts = _warm_scheduler()
+        pod = Pod(name="arrival-1", requests=SIZE)
+        res = fastpath.try_admit(ts, [pod])
+        assert res.outcome == "nominated", (res.outcome, res.reason)
+        assert set(res.placements) == {pod.key()}
+        assert res.placements[pod.key()].startswith("live-")
+
+    def test_full_solve_agrees_with_the_nomination(self):
+        """The convergence contract at its smallest: the authoritative
+        batched solve, run right after a fast-path verdict on the SAME
+        scheduler, places the pod on the IDENTICAL node (and opens no
+        new ones)."""
+        ts = _warm_scheduler()
+        pod = Pod(name="arrival-2", requests=SIZE)
+        res = fastpath.try_admit(ts, [pod])
+        assert res.outcome == "nominated", (res.outcome, res.reason)
+        result = ts.solve([pod])
+        assert not result.new_nodes
+        assert result.existing_placements[pod.key()] == res.placements[
+            pod.key()
+        ]
+
+    def test_tiny_burst_single_class_nominated(self):
+        ts = _warm_scheduler()
+        pods = [
+            Pod(name=f"burst-{i}", requests=SIZE)
+            for i in range(fastpath.FASTPATH_MAX_BURST)
+        ]
+        res = fastpath.try_admit(ts, pods)
+        assert res.outcome == "nominated", (res.outcome, res.reason)
+        assert set(res.placements) == {p.key() for p in pods}
+        result = ts.solve(pods)
+        assert not result.new_nodes
+        assert result.existing_placements == res.placements
+
+
+# ----------------------------------------------------- eligibility fence
+class TestEligibilityBoundary:
+    """Every ineligible shape falls back with ITS reason, and no fallback
+    ever carries placements — the fence can refuse, never mis-nominate."""
+
+    def _assert_fallback(self, res, reason):
+        assert res.outcome == "fallback", (res.outcome, res.reason)
+        assert res.reason == reason
+        assert res.placements == {}
+
+    def test_burst_too_large(self):
+        ts = _warm_scheduler()
+        pods = [
+            Pod(name=f"big-{i}", requests=SIZE)
+            for i in range(fastpath.FASTPATH_MAX_BURST + 1)
+        ]
+        self._assert_fallback(
+            fastpath.try_admit(ts, pods), fastpath.REASON_BURST_TOO_LARGE
+        )
+
+    def test_mixed_class_burst(self):
+        ts = _warm_scheduler()
+        pods = [
+            Pod(name="mix-a", requests=SIZE),
+            Pod(name="mix-b", requests=Resources(cpu=2, memory="4Gi")),
+        ]
+        self._assert_fallback(
+            fastpath.try_admit(ts, pods), fastpath.REASON_MIXED_BURST
+        )
+
+    def test_constrained_pod_shape(self):
+        ts = _warm_scheduler()
+        pod = Pod(
+            name="affine",
+            requests=SIZE,
+            pod_affinity=[
+                PodAffinityTerm(topology_key="kubernetes.io/hostname")
+            ],
+        )
+        self._assert_fallback(
+            fastpath.try_admit(ts, [pod]), fastpath.REASON_POD_SHAPE
+        )
+
+    def test_affinity_carrier_on_a_live_node(self):
+        ts = _warm_scheduler()
+        carrier = Pod(
+            name="bound-carrier",
+            requests=SIZE,
+            pod_affinity=[
+                PodAffinityTerm(topology_key="kubernetes.io/hostname")
+            ],
+        )
+        ts.existing.append(_live_node("default", 99, pods=[carrier]))
+        self._assert_fallback(
+            fastpath.try_admit(ts, [Pod(name="a-3", requests=SIZE)]),
+            fastpath.REASON_AFFINITY_CARRIER,
+        )
+
+    def test_catalog_roll_in_flight(self):
+        ts = _warm_scheduler()
+        # a pool mutation bumps the epoch half of the catalog key
+        # (ops/resident._catalog_key), obsoleting every resident state
+        ts.pools[0].__dict__["_mut"] = (
+            ts.pools[0].__dict__.get("_mut", 0) + 1
+        )
+        self._assert_fallback(
+            fastpath.try_admit(ts, [Pod(name="a-4", requests=SIZE)]),
+            fastpath.REASON_CATALOG_ROLL,
+        )
+
+    def test_resident_cold(self):
+        env = Environment()
+        pool = env.default_node_pool()
+        nc = env.default_node_class()
+        types = env.instance_types.list(pool, nc)
+        ts = TensorScheduler(
+            [pool], {pool.name: types},
+            existing=[_live_node(pool.name, 0)],
+        )
+        self._assert_fallback(
+            fastpath.try_admit(ts, [Pod(name="a-5", requests=SIZE)]),
+            fastpath.REASON_RESIDENT_COLD,
+        )
+
+    def test_pod_that_fits_no_live_node_needs_new_node(self):
+        ts = _warm_scheduler()
+        res = fastpath.try_admit(
+            ts, [Pod(name="huge", requests=Resources(cpu=65, memory="1Gi"))]
+        )
+        assert res.outcome in ("fallback",), res.outcome
+        assert res.reason in (
+            fastpath.REASON_NEEDS_NEW_NODE,
+            fastpath.REASON_RESIDENT_MISS,
+        ), res.reason
+        assert res.placements == {}
+
+    def test_fuzz_boundary_never_mis_nominates(self):
+        """Deterministic fuzz over the eligibility boundary: whatever mix
+        of constrained/oversized/mixed arrivals hits the fence, a
+        non-nominated verdict NEVER carries placements, and every
+        nominated verdict is confirmed by the authoritative solve."""
+        ts = _warm_scheduler()
+        cases = [
+            [Pod(name="f-0", requests=SIZE)],
+            [Pod(name="f-1", requests=SIZE),
+             Pod(name="f-2", requests=Resources(cpu=1, memory="1Gi"))],
+            [Pod(name="f-3", requests=SIZE,
+                 pod_affinity=[PodAffinityTerm(topology_key="zone")])],
+            [Pod(name=f"f-4-{i}", requests=SIZE) for i in range(12)],
+            [Pod(name="f-5", requests=Resources(cpu=4096))],
+            [Pod(name="f-6", requests=Resources(cpu=0.25, memory="512Mi"))],
+        ]
+        for pods in cases:
+            res = fastpath.try_admit(ts, pods)
+            assert res.outcome != "mismatch", res.reason
+            if res.outcome != "nominated":
+                assert res.placements == {}
+                continue
+            result = ts.solve(pods)
+            assert result.existing_placements == res.placements
+
+
+# ------------------------------------------------------------- twin test
+def _run_cluster(fastpath_on: bool):
+    """Drive one cluster through a seed batch + a single-pod trickle and
+    return (env, pod -> node placement map)."""
+    reset_name_sequences()
+    env = Environment(
+        settings=Settings(
+            cluster_name="test",
+            enable_admission_fastpath=fastpath_on,
+            provision_fastpath_bypass=fastpath_on,
+        )
+    )
+    env.default_node_class()
+    env.default_node_pool()
+    # seed batch: 12 pods whose 2.5-cpu requests never tile a power-of-2
+    # shape exactly, so every launched node keeps headroom for trickles
+    for i in range(12):
+        env.kube.put_pod(
+            Pod(name=f"seed-{i:02d}", requests=Resources(cpu=2.5,
+                                                         memory="2Gi"))
+        )
+    env.settle()
+    assert not env.kube.pending_pods()
+    # trickle: one fresh pod at a time, identically named in both twins
+    for i in range(6):
+        env.kube.put_pod(
+            Pod(name=f"trickle-{i}", requests=Resources(cpu=0.1,
+                                                        memory="64Mi"))
+        )
+        env.step(2.0)
+    env.settle()
+    assert not env.kube.pending_pods()
+    placements = {k: p.node_name for k, p in sorted(env.kube.pods.items())}
+    return env, placements
+
+
+# -------------------------------------------------- tick trust window
+class TestTickTrustWindow:
+    """The resident cache's note_sync window (ops/resident.py): the
+    provisioner computes the tick-wide invariants once per sync, and
+    every admission inside the window skips the O(cluster) rescan.
+    These tests pin the three-sided contract: rigor without a window,
+    trust inside one, and witness self-invalidation when the node set
+    changes under it."""
+
+    def _carrier(self) -> Pod:
+        return Pod(
+            name="bound-carrier",
+            requests=SIZE,
+            pod_affinity=[
+                PodAffinityTerm(topology_key="kubernetes.io/hostname")
+            ],
+        )
+
+    def test_no_window_detects_inplace_carrier(self):
+        """A raw caller that never opened a window keeps the rigorous
+        per-call carrier scan — in-place node mutation included."""
+        ts = _warm_scheduler()
+        ts.existing[0].pods.append(self._carrier())
+        res = fastpath.try_admit(ts, [Pod(name="tw-1", requests=SIZE)])
+        assert res.outcome == "fallback"
+        assert res.reason == fastpath.REASON_AFFINITY_CARRIER
+
+    def test_window_trusts_cached_invariants(self):
+        """Inside an open window the carrier scan is NOT re-run: the
+        caller's contract is that nodes are not mutated mid-window, so
+        an (illegal) in-place mutation goes unseen until re-sync — and
+        a re-sync sees it again."""
+        ts = _warm_scheduler()
+        ts._resident.note_sync(ts)
+        ts.existing[0].pods.append(self._carrier())
+        res = fastpath.try_admit(ts, [Pod(name="tw-2", requests=SIZE)])
+        assert res.outcome == "nominated", (res.outcome, res.reason)
+        # the next sync recomputes the invariants over the mutated nodes
+        ts._resident.note_sync(ts)
+        res = fastpath.try_admit(ts, [Pod(name="tw-3", requests=SIZE)])
+        assert res.outcome == "fallback"
+        assert res.reason == fastpath.REASON_AFFINITY_CARRIER
+
+    def test_window_witness_invalidates_on_node_set_change(self):
+        """Changing the node SET under an open window (append of a new
+        node carrying a carrier pod) fails the witness — the admission
+        falls back to the rigorous scan and sees the carrier without
+        any re-sync."""
+        ts = _warm_scheduler()
+        ts._resident.note_sync(ts)
+        ts.existing.append(
+            _live_node("default", 99, pods=[self._carrier()])
+        )
+        res = fastpath.try_admit(ts, [Pod(name="tw-4", requests=SIZE)])
+        assert res.outcome == "fallback"
+        assert res.reason == fastpath.REASON_AFFINITY_CARRIER
+
+    def test_window_admissions_match_windowless(self):
+        """The window is a pure cache: the same arrival sequence with
+        and without note_sync nominates onto the same nodes."""
+        ts_a = _warm_scheduler()
+        ts_b = _warm_scheduler()
+        ts_a._resident.note_sync(ts_a)
+        for i in range(4):
+            ra = fastpath.try_admit(
+                ts_a, [Pod(name=f"tw-p{i}", requests=SIZE)]
+            )
+            rb = fastpath.try_admit(
+                ts_b, [Pod(name=f"tw-p{i}", requests=SIZE)]
+            )
+            assert ra.outcome == rb.outcome == "nominated"
+            assert list(ra.placements.values()) == list(
+                rb.placements.values()
+            )
+
+
+def test_twin_fast_on_off_identical_eventual_placements():
+    """THE twin test: the identical arrival sequence, fast path on vs
+    off, must converge to the identical pod -> node map.  The fast
+    half must actually have used the fast path (nominated > 0, zero
+    mismatches); the slow half must never have touched it."""
+    env_fast, placed_fast = _run_cluster(fastpath_on=True)
+    env_slow, placed_slow = _run_cluster(fastpath_on=False)
+    assert placed_fast == placed_slow
+    reg = env_fast.registry
+    assert reg.counter(
+        "karpenter_admission_fastpath_total", {"outcome": "nominated"}
+    ) > 0
+    assert reg.counter("karpenter_admission_fastpath_mismatch_total") == 0
+    # the latency histogram splits by admission path
+    assert reg.histogram(
+        "karpenter_admission_latency_seconds", {"path": "fast"}
+    )
+    slow_reg = env_slow.registry
+    for outcome in ("nominated", "fallback", "mismatch"):
+        assert slow_reg.counter(
+            "karpenter_admission_fastpath_total", {"outcome": outcome}
+        ) == 0
+    assert not slow_reg.histogram(
+        "karpenter_admission_latency_seconds", {"path": "fast"}
+    )
+    assert slow_reg.histogram(
+        "karpenter_admission_latency_seconds", {"path": "batch"}
+    )
+
+
+# ------------------------------------------------------- singleton bypass
+class TestSingletonBypass:
+    def _env(self, bypass: bool) -> Environment:
+        env = Environment(
+            settings=Settings(
+                cluster_name="test",
+                enable_admission_fastpath=False,
+                provision_fastpath_bypass=bypass,
+            )
+        )
+        env.default_node_class()
+        env.default_node_pool()
+        return env
+
+    def test_lone_pod_skips_the_batch_window(self):
+        env = self._env(bypass=True)
+        env.kube.put_pod(Pod(name="lone", requests=SIZE))
+        env.step(0.1)  # far inside the idle window
+        assert env.kube.node_claims, (
+            "a lone fresh pod has nothing to coalesce with: the bypass "
+            "must release it to the solve immediately"
+        )
+
+    def test_bypass_off_waits_for_idle(self):
+        env = self._env(bypass=False)
+        env.kube.put_pod(Pod(name="lone", requests=SIZE))
+        env.step(0.1)
+        assert not env.kube.node_claims
+        env.step(1.1)  # idle elapsed -> the batched solve runs
+        assert env.kube.node_claims
+
+    def test_bypass_only_fires_for_singletons(self):
+        env = self._env(bypass=True)
+        for i in range(2):
+            env.kube.put_pod(Pod(name=f"pair-{i}", requests=SIZE))
+        env.step(0.1)
+        assert not env.kube.node_claims  # two pods: the window coalesces
+
+
+# ------------------------------------------------------------ sim plane
+@pytest.mark.sim
+def test_single_pod_trickle_byte_identical(tmp_path):
+    """The fast path's acceptance scenario: run/run and run/replay are
+    byte-identical WITH the fast path nominating live traffic, and the
+    convergence invariant (mismatch counter pinned at 0) holds every
+    tick."""
+    from karpenter_tpu.sim.runner import replay, run_scenario
+    from karpenter_tpu.sim.trace import TraceWriter
+
+    path = str(tmp_path / "trickle.jsonl")
+    w1 = TraceWriter(path)
+    runner, r1 = run_scenario("single-pod-trickle", seed=11, ticks=60,
+                              trace=w1)
+    assert r1["invariants"]["violations"] == []
+    reg = runner.env.operator.provisioner.registry
+    assert reg.counter(
+        "karpenter_admission_fastpath_total", {"outcome": "nominated"}
+    ) > 0, "the trickle scenario must exercise the fast path"
+    assert reg.counter("karpenter_admission_fastpath_mismatch_total") == 0
+    # run/run determinism
+    w2 = TraceWriter()
+    _, r2 = run_scenario("single-pod-trickle", seed=11, ticks=60, trace=w2)
+    assert w1.text() == w2.text()
+    assert r1 == r2
+    # record/replay byte-identity (no generators in the loop)
+    w3 = TraceWriter()
+    _, replayed, recorded = replay(path, trace=w3)
+    assert recorded == r1
+    assert replayed == r1
+    assert w3.text() == open(path).read()
+
+
+# --------------------------------------------------------------- doctor
+def test_doctor_names_fallback_storm_and_mismatch(tmp_path):
+    """A forged flight dump with a late fallback storm (dominant reason
+    catalog_roll) and one verdict mismatch: doctor must name both,
+    citing the dominant reason and the convergence contract."""
+    from karpenter_tpu.metrics.registry import Registry
+    from karpenter_tpu.obs.context import set_tick
+    from karpenter_tpu.obs.doctor import diagnose
+    from karpenter_tpu.obs.events import EventLedger
+    from karpenter_tpu.obs.flight import FlightRecorder, load_flight
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    reg = Registry()
+    led = EventLedger(clock=clock, registry=reg)
+    reg.ledger = led
+    fr = FlightRecorder(clock, reg, ledger=led, capacity=64)
+    try:
+        for i in range(24):
+            clock.step(1.0)
+            set_tick(f"tick-{i + 1:06d}")
+            if i >= 16:  # the storm: past the doctor's split index
+                for _ in range(2):
+                    reg.inc(
+                        "karpenter_admission_fastpath_total",
+                        {"outcome": "fallback"},
+                    )
+                    reg.inc(
+                        "karpenter_admission_fastpath_fallback_total",
+                        {"reason": "catalog_roll"},
+                    )
+            if i == 20:
+                reg.inc("karpenter_admission_fastpath_mismatch_total")
+            fr.record(i + 1, f"tick-{i + 1:06d}", 0.01, {"pending": 0})
+        path = tmp_path / "flight-fastpath.jsonl"
+        fr.dump(str(path), trigger="manual")
+    finally:
+        set_tick("")
+    diag = diagnose(load_flight(str(path)))
+    causes = diag["suspected_causes"]
+    (storm,) = [c for c in causes if "fallback storm" in c]
+    assert "catalog_roll" in storm
+    assert "16 fallback(s)" in storm
+    (mismatch,) = [c for c in causes if "verdict" in c and "mismatch" in c]
+    assert "convergence contract" in mismatch

@@ -360,6 +360,40 @@ def test_rule_has_teeth(tmp_path, rule):
     assert not silenced, "\n".join(f.render() for f in silenced)
 
 
+def test_full_tensorize_deny_fence(tmp_path):
+    """Rule 7's deny fence: scheduling/fastpath.py may NEVER call the
+    tensorize entry points, and — unlike every other finding — no
+    allowlist entry can sanction it.  An attempted entry is itself an
+    additional finding, so the fence cannot be quietly weakened."""
+    forged = forge(
+        tmp_path,
+        {
+            "scheduling/fastpath.py": (
+                "def admit(s):\n"
+                "    return compile_problem(s, [], {})\n"
+            )
+        },
+    )
+    live, _ = run_rules(
+        forged, rule_names=["full-tensorize"],
+        allowlists={"full-tensorize": frozenset()},
+    )
+    text = "\n".join(f.render() for f in live)
+    assert live, "deny fence did not fire"
+    assert "DENIED" in text
+    # the allowlist is powerless: the call STILL fires, and the entry
+    # itself becomes a finding naming the attempted exception
+    entry = ("forged/scheduling/fastpath.py", "admit")
+    still, _ = run_rules(
+        forged, rule_names=["full-tensorize"],
+        allowlists={"full-tensorize": frozenset({entry})},
+    )
+    text2 = "\n".join(f.render() for f in still)
+    assert len(still) >= 2, text2
+    assert "DENIED" in text2
+    assert "no exception" in text2
+
+
 def test_tracer_safety_call_site_allowlist(tmp_path):
     """The seam-dispatch half of tracer-safety is allowlistable by
     (file, qualname) — the impure-body half deliberately is not."""
