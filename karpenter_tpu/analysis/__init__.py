@@ -60,6 +60,7 @@ def load_rules() -> None:
         reachability,
         rules_legacy,
         settings_flow,
+        tenant_metrics,
         tracer,
     )
 

@@ -236,6 +236,18 @@ _SETTINGS_FLOW = frozenset(
     }
 )
 
+# ---------------------------------------- service tenant metrics (rule 12)
+# service/ files exempt from the tenant-label requirement because they
+# are the STORE plane, not the solver service: one shared cluster store
+# per deployment, tenant-less by design — its karpenter_store_* families
+# key on method/codec, and tenancy is a solver-service concept.
+_SERVICE_TENANT_METRICS = frozenset(
+    {
+        "karpenter_tpu/service/store_server.py",
+        "karpenter_tpu/service/shardrouter.py",
+    }
+)
+
 # lock-seam: raw constructions sanctioned by (file, "Class.attr"):
 _LOCK_SEAM = frozenset(
     {
@@ -256,5 +268,6 @@ ALLOWLISTS: Dict[str, frozenset] = {
     "determinism-reachability": _DETERMINISM,
     "tracer-safety": _TRACER_SAFETY,
     "settings-flow": _SETTINGS_FLOW,
+    "service-tenant-metrics": _SERVICE_TENANT_METRICS,
     "lock-seam": _LOCK_SEAM,
 }

@@ -13,7 +13,11 @@ operators "configure" a knob that changes nothing.  Machine-checked:
    under ``settings:`` AND in the configmap template, so the rendered
    ``settings.json`` can actually carry it (tests/test_deploy.py proves
    the rendered payload loads — this rule proves the key EXISTS to
-   render).
+   render).  A field may instead live under a STRUCTURED values block
+   (the ``service.multiTenant.*`` shape): its configmap line then
+   references ``.Values.<dotted>`` paths, and the rule resolves each
+   against the values.yaml document — an unresolvable path is the same
+   dead knob, just spelled nested.
 
 Read detection is deliberately name-based and over-approximating: any
 ``x.field_name`` counts, whoever ``x`` is.  A false "read" keeps the
@@ -88,6 +92,42 @@ def _attribute_reads(snap: PackageSnapshot) -> Set[str]:
     return reads
 
 
+_VALUES_REF = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
+
+
+def _values_paths(values_text: str) -> Set[str]:
+    """Every dotted path definable from values.yaml's mapping structure
+    ("service.multiTenant.enabled", ...), by indentation walk — no YAML
+    dependency, and forgiving of the teeth harness's forged snippets."""
+    paths: Set[str] = set()
+    stack: List[tuple] = []  # (indent, key)
+    for line in values_text.splitlines():
+        stripped = line.split("#", 1)[0].rstrip()
+        m = re.match(r"^(\s*)([A-Za-z0-9_]+):", stripped)
+        if not m:
+            continue
+        indent = len(m.group(1))
+        while stack and stack[-1][0] >= indent:
+            stack.pop()
+        stack.append((indent, m.group(2)))
+        paths.add(".".join(k for _, k in stack))
+    return paths
+
+
+def _configmap_refs_resolve(
+    fname: str, configmap_text: str, values_paths: Set[str]
+) -> bool:
+    """True when the configmap line carrying ``"fname"`` references at
+    least one ``.Values.`` path and every referenced path resolves in
+    values.yaml — the nested-values exposure route."""
+    for line in configmap_text.splitlines():
+        if f'"{fname}"' not in line:
+            continue
+        refs = _VALUES_REF.findall(line)
+        return bool(refs) and all(r in values_paths for r in refs)
+    return False
+
+
 def _settings_block(values_text: str) -> str:
     """The ``settings:`` mapping of values.yaml — keys are matched
     INSIDE this block only, so a Settings field named like some other
@@ -112,9 +152,9 @@ class SettingsFlowRule(Rule):
         if not fields:
             return []
         reads = _attribute_reads(snap)
-        values_text = _settings_block(
-            snap.doc_text("deploy", "chart", "values.yaml")
-        )
+        full_values = snap.doc_text("deploy", "chart", "values.yaml")
+        values_text = _settings_block(full_values)
+        values_paths = _values_paths(full_values)
         configmap_text = snap.doc_text(
             "deploy", "chart", "templates", "configmap.yaml"
         )
@@ -131,14 +171,22 @@ class SettingsFlowRule(Rule):
                         "argument",
                     )
                 )
-            if values_text and not re.search(
-                rf"^\s+{re.escape(fname)}:", values_text, re.M
+            if (
+                values_text
+                and not re.search(
+                    rf"^\s+{re.escape(fname)}:", values_text, re.M
+                )
+                and not _configmap_refs_resolve(
+                    fname, configmap_text, values_paths
+                )
             ):
                 out.append(
                     self.finding(
                         rel, line,
                         f"Settings.{fname} missing from deploy/chart/"
-                        "values.yaml — the chart cannot set it",
+                        "values.yaml — the chart cannot set it (neither "
+                        "a settings: key nor a resolvable nested "
+                        ".Values path in its configmap line)",
                     )
                 )
             if configmap_text and f'"{fname}"' not in configmap_text:

@@ -118,6 +118,21 @@ class Settings:
     # a witness artifact on shutdown at measured per-acquisition cost
     # (the sanitizer_lock_overhead bench line)
     enable_lock_sanitizer: bool = False
+    # multi-tenant SolverService (service/server.py + docs/designs/
+    # solver-service.md): one solver process serving a fleet of operator
+    # tenants.  OFF keeps the legacy single-operator sidecar contract
+    # exactly (no batching, no admission, no resident pooling).  The
+    # window pair is the cross-tenant CoalesceWindow (batch_idle_s of
+    # quiet or batch_max_s total closes a solve batch); the inflight cap
+    # bounds any one tenant's concurrent solves (excess gets an explicit
+    # RETRY-AFTER refusal, never a silent queue slot); the resident
+    # budget caps total device bytes pinned across all tenants' warm
+    # solve tensors (cross-tenant LRU eviction above it)
+    service_multi_tenant: bool = False
+    service_batch_idle_s: float = 0.005
+    service_batch_max_s: float = 0.05
+    service_tenant_inflight_cap: int = 4
+    service_resident_budget_mb: int = 256
     # deadlock watchdog (sanitizer.LockWatchdog): when the sanitizer is
     # enabled and EVERY currently-held lock has been held longer than
     # this many seconds, dump the live lock graph + a flight record.
@@ -229,6 +244,16 @@ class Settings:
             raise ValueError("store_codec must be 'auto' or 'json'")
         if self.store_events_cap < 1:
             raise ValueError("store_events_cap must be >= 1")
+        if self.service_batch_idle_s < 0 or self.service_batch_max_s < 0:
+            raise ValueError("service batch windows must be non-negative")
+        if self.service_batch_max_s < self.service_batch_idle_s:
+            raise ValueError(
+                "service_batch_max_s must be >= service_batch_idle_s"
+            )
+        if self.service_tenant_inflight_cap < 1:
+            raise ValueError("service_tenant_inflight_cap must be >= 1")
+        if self.service_resident_budget_mb < 0:
+            raise ValueError("service_resident_budget_mb must be >= 0")
         if self.lock_watchdog_stall_s < 0:
             raise ValueError("lock_watchdog_stall_s must be >= 0")
         if self.lock_watchdog_stall_s and not self.enable_lock_sanitizer:

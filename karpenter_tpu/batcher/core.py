@@ -201,3 +201,69 @@ class _Bucket:
             for fut in futures:
                 if not fut.done():
                     fut.set_exception(exc)
+
+
+class WeightedRoundRobin:
+    """Deterministic smooth weighted round-robin over named queues.
+
+    The fairness half of the multi-tenant SolverService's admission plane
+    (docs/designs/solver-service.md): when a coalescing window closes with
+    more queued solves than one batch can carry, the drain order decides
+    who rides the next dispatch — and a tenant flooding requests at 10x
+    the others' rate must not buy itself 10x the batch slots.
+
+    Smooth WRR (the nginx upstream discipline): every pick, each candidate
+    accrues its weight; the highest accumulated credit wins and pays back
+    the total.  Over any window, a candidate's share of picks converges to
+    weight/total, and between two picks of one candidate every other
+    candidate with comparable weight is picked — bounded burstiness, not
+    just bounded share.  Ties break by sorted name, so the schedule is a
+    pure function of (pick history, candidate sets) — the determinism the
+    fleet sim's tape discipline demands.  Not thread-safe; callers hold
+    their own admission lock.
+    """
+
+    def __init__(self):
+        self._credit: Dict[Hashable, float] = {}
+
+    def select(self, weights: Dict[Hashable, float]) -> Hashable:
+        """One pick among ``weights`` (name -> positive weight)."""
+        if not weights:
+            raise ValueError("select from no candidates")
+        total = sum(weights.values())
+        best = None
+        for name in sorted(weights, key=str):
+            cur = self._credit.get(name, 0.0) + weights[name]
+            self._credit[name] = cur
+            if best is None or cur > self._credit[best]:
+                best = name
+        self._credit[best] -= total
+        return best
+
+    def drain(
+        self,
+        queues: Dict[Hashable, Any],
+        limit: int,
+        weights: Optional[Dict[Hashable, float]] = None,
+    ) -> List[Tuple[Hashable, Any]]:
+        """Pop up to ``limit`` items from the named queues (anything with
+        ``popleft`` and truthiness, e.g. collections.deque) in smooth-WRR
+        order; missing weights default to 1.0.  Returns (name, item)
+        pairs in drain order."""
+        out: List[Tuple[Hashable, Any]] = []
+        while len(out) < limit:
+            cands = {
+                n: (weights or {}).get(n, 1.0)
+                for n, q in queues.items()
+                if q
+            }
+            if not cands:
+                break
+            pick = self.select(cands)
+            out.append((pick, queues[pick].popleft()))
+        return out
+
+    def forget(self, name: Hashable) -> None:
+        """Drop a departed tenant's credit so its name's return starts
+        fresh instead of inheriting stale debt."""
+        self._credit.pop(name, None)

@@ -576,4 +576,58 @@ METRIC_DETAILS: Dict[str, Tuple[str, str, str]] = {
         "full-tensor upload), 'noop' (refresh hit with no tensor "
         "change); warm steady state should be donated/noop-dominated",
     ),
+    # ---- multi-tenant solver service (docs/designs/solver-service.md);
+    # every family carries `tenant` (lint rule 12) and is served from the
+    # solver process's OWN registry on ITS telemetry endpoint
+    "karpenter_service_requests_total": (
+        "counter",
+        "tenant, method",
+        "solver-service RPCs dispatched (ping / info / pack), per tenant "
+        "— the fleet's per-cluster demand in one family",
+    ),
+    "karpenter_service_solves_total": (
+        "counter",
+        "tenant, path",
+        "completed pack solves per tenant, split by execution path: "
+        "'solo' (idle-group fall-through straight into the single-problem "
+        "kernel) vs 'batched' (rode a coalesced fleet dispatch); a "
+        "healthy busy mesh is batched-dominated, a quiet one solo-only",
+    ),
+    "karpenter_service_solve_wait_seconds": (
+        "histogram",
+        "tenant",
+        "arrival-to-answer latency of one pack RPC including queue wait, "
+        "per tenant — the fairness ground truth: doctor's tenant-"
+        "starvation rule flags a tenant whose p99 runs far above the "
+        "fleet median from this family's flight deltas",
+    ),
+    "karpenter_service_refusals_total": (
+        "counter",
+        "tenant, reason",
+        "solves refused under backpressure with an explicit retry-after "
+        "hint ('inflight-cap' = that tenant over its concurrent-solve "
+        "cap, 'saturated' = the whole mesh's queue bound hit) — refusals "
+        "are the DESIGNED overload behavior, never silent queuing",
+    ),
+    "karpenter_service_inflight": (
+        "gauge",
+        "tenant",
+        "solves currently admitted (queued or on-device) per tenant; "
+        "pinned at the inflight cap means that tenant is being shed",
+    ),
+    "karpenter_service_resident_bytes": (
+        "gauge",
+        "tenant",
+        "device bytes pinned by this tenant's warm solve tensors in the "
+        "budgeted cross-tenant resident pool (ops/resident.py); the sum "
+        "across tenants stays under service_resident_budget_mb",
+    ),
+    "karpenter_service_resident_evictions_total": (
+        "counter",
+        "tenant",
+        "times this tenant's WHOLE resident set was dropped as the "
+        "coldest entry to fit another tenant under the device-bytes "
+        "budget; a hot tenant evicting repeatedly means the budget is "
+        "too small for the working set",
+    ),
 }

@@ -64,6 +64,10 @@ WATCHED_FAMILIES = (
     # absorbing traffic the fast path used to take — judges exactly
     # like a phase blowup, attributed per path label
     "karpenter_admission_latency_seconds",
+    # solver service: per-tenant solve-wait blowing up (backpressure,
+    # a noisy neighbor monopolizing the batch window) judges like a
+    # phase blowup, attributed per tenant label
+    "karpenter_service_solve_wait_seconds",
 )
 
 _MAD_SCALE = 1.4826  # MAD -> stddev-equivalent under normality

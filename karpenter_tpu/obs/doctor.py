@@ -55,6 +55,15 @@ _FAMILY_SHORT = {
     "karpenter_admission_latency_seconds": "admission",
 }
 
+# tenant-starvation thresholds (solver service): one tenant's mean
+# solve-wait running this factor past the fleet median (with an
+# absolute floor so microsecond jitter on an idle service never pages)
+# means the weighted-round-robin share is not protecting it — a noisy
+# neighbor is monopolizing the batch window or its weight is wrong
+_STARVATION_FACTOR = 4.0
+_STARVATION_FLOOR_S = 0.01
+_STARVATION_MIN_SOLVES = 4
+
 # device-rule thresholds: a warm tick's upload bytes must not grow past
 # this factor of the baseline median (with an absolute floor so byte
 # jitter on tiny problems never pages) while its resident delta rows
@@ -137,6 +146,24 @@ def counter_deltas(ticks: List[dict], family: str) -> List[float]:
             if name == family:
                 total += float(delta)
         out.append(total)
+    return out
+
+
+def tenant_wait_stats(ticks: List[dict]) -> Dict[str, Tuple[int, float]]:
+    """tenant -> (solves, total wait seconds) aggregated over the dump,
+    from the solver service's per-tenant solve-wait histogram deltas."""
+    out: Dict[str, Tuple[int, float]] = {}
+    for tick in ticks:
+        for key, delta in tick.get("hists", {}).items():
+            name, labels = _parse_series(key)
+            if name != "karpenter_service_solve_wait_seconds":
+                continue
+            tenant = labels.get("tenant", "?")
+            c, s = out.get(tenant, (0, 0.0))
+            out[tenant] = (
+                c + int(delta.get("count", 0)),
+                s + float(delta.get("sum_s", 0.0)),
+            )
     return out
 
 
@@ -362,6 +389,49 @@ def suspected_causes(
             "disagreeing verdict, but the device arithmetic (or the "
             "resident mirrors) has drifted and needs a bug hunt"
         )
+
+    # ---- solver service rules (service/server.py) ---------------------
+    # tenant starvation: one tenant's mean solve-wait running far past
+    # the fleet median — the weighted-round-robin share is not
+    # protecting it (noisy neighbor monopolizing the coalesce window,
+    # or a misconfigured weight); refusal counts name the backpressure
+    # the starved tenant also ate
+    waits = tenant_wait_stats(ticks)
+    means = {
+        t: s / c
+        for t, (c, s) in waits.items()
+        if c >= _STARVATION_MIN_SOLVES
+    }
+    if len(means) >= 2:
+        fleet_median = _median(sorted(means.values()))
+        refusals: Dict[str, float] = {}
+        for tick in ticks:
+            for key, delta in tick.get("counters", {}).items():
+                name, labels = _parse_series(key)
+                if name == "karpenter_service_refusals_total":
+                    t = labels.get("tenant", "?")
+                    refusals[t] = refusals.get(t, 0.0) + float(delta)
+        for t in sorted(means):
+            mean = means[t]
+            if (
+                mean > fleet_median * _STARVATION_FACTOR
+                and mean - fleet_median > _STARVATION_FLOOR_S
+            ):
+                msg = (
+                    f"tenant '{t}' starving in the solver service: mean "
+                    f"solve-wait {mean * 1000.0:.1f}ms over "
+                    f"{waits[t][0]} solve(s) vs fleet median "
+                    f"{fleet_median * 1000.0:.1f}ms "
+                    f"({mean / fleet_median:.1f}x) — check its "
+                    "round-robin weight and the noisy neighbors "
+                    "sharing its batch bucket"
+                )
+                if refusals.get(t):
+                    msg += (
+                        f"; it also ate {int(refusals[t])} "
+                        "backpressure refusal(s)"
+                    )
+                causes.append(msg)
 
     # warm-recompile attributions are causes by construction
     for i, ev in events:

@@ -56,6 +56,10 @@ DELTA_HISTOGRAMS = (
     # store latency, so a flight dump brackets store slowness next to
     # the solver phases it stalls
     "karpenter_store_rpc_seconds",
+    # solver service (docs/designs/solver-service.md): per-tenant
+    # solve-wait — the doctor's tenant-starvation rule reads these
+    # tenant-labeled deltas out of a service flight dump
+    "karpenter_service_solve_wait_seconds",
 )
 
 

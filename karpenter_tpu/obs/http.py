@@ -29,7 +29,12 @@ that surface for the reproduction, mounted on BOTH the operator process
                 (obs/device.py): compiles / warm recompiles / compile
                 seconds per jit entry point, transfer bytes per site,
                 and the resident device-buffer footprint per consumer —
-                "what lives on the device and what crossed the link".
+                "what lives on the device and what crossed the link";
+- ``/debug/tenants``  the solver service's per-tenant admission state
+                (service/server.py tenants_payload): in-flight counts,
+                solve/batch/refusal tallies, resident footprints vs the
+                device-bytes budget, and tenant-scoped ledger slices —
+                "who is on the mesh and what are they costing".
 
 Every request bumps ``karpenter_telemetry_scrapes_total{endpoint}`` so
 the scrape cadence is itself observable (a stalled scraper is an
@@ -123,6 +128,7 @@ def start_telemetry(
     ledger=None,
     flight=None,
     device=None,
+    tenants=None,
     host: str = "",
 ) -> ThreadingHTTPServer:
     """Serve the telemetry surface on (host, port) in a daemon thread;
@@ -134,7 +140,7 @@ def start_telemetry(
             path, _, query = self.path.partition("?")
             known = (
                 "/metrics", "/healthz", "/events", "/trace",
-                "/debug/flight", "/debug/device",
+                "/debug/flight", "/debug/device", "/debug/tenants",
             )
             if path not in known:
                 self.send_response(404)
@@ -179,6 +185,12 @@ def start_telemetry(
                 payload = (
                     device.snapshot() if device is not None else {}
                 )
+                body = json.dumps(payload, sort_keys=True).encode()
+                ctype = "application/json"
+            elif path == "/debug/tenants":
+                # ``tenants`` is a callable (the solver service's
+                # tenants_payload) so every scrape sees live state
+                payload = tenants() if tenants is not None else {}
                 body = json.dumps(payload, sort_keys=True).encode()
                 ctype = "application/json"
             else:  # /trace

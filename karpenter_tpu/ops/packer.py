@@ -30,7 +30,7 @@ pjit wrappers used by the multi-chip dry run.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -901,6 +901,95 @@ def _device_constants(prob, alloc_p, price_p, openable_p):
         lambda: (alloc_p, price_p, openable_p),
         site="pack_constants",
     )
+
+
+# ---------------------------------------------------------------------------
+# Fleet kernel: many tenants' solves in ONE vmapped dispatch
+# (docs/designs/solver-service.md).  The multi-tenant SolverService stacks
+# same-bucket problems from different tenants along a leading axis and runs
+# _pack_core under vmap — one device round trip amortizes dispatch overhead
+# across the whole batch.
+#
+# Bit-identity contract: every op in _pack_core is per-problem under vmap
+# (the scan, the cumsums, the argmin all reduce over NON-batch axes in the
+# same order as the solo kernel), and the only float reductions are
+# max/min/floor — order-insensitive — while the accumulating sums are all
+# int32.  A tenant's row of the fleet solve is therefore bit-equal to its
+# solo pack_kernel solve; tests/test_service_tenants.py pins it.
+# ---------------------------------------------------------------------------
+
+
+def fleet_row_len(Gp: int, Kp: int, R: int) -> int:
+    """Length of one tenant's flat output row: dense take + leftover +
+    node_cfg + node_used.  Dense (not compact_take) because per-row nnz
+    varies across tenants and a static sparse cap would force the whole
+    batch onto the overflow path whenever one tenant's solve is dense."""
+    return Gp * Kp + Gp + Kp + Kp * R
+
+
+@partial(jax.jit, static_argnames=("k_slots", "objective"))
+def fleet_pack_kernel(
+    cols,  # 13-tuple (PACK_ARG_ORDER) of length-B tuples of per-tenant arrays
+    *,
+    k_slots: int,
+    objective: str = "nodes",
+):
+    """B same-bucket solves in one dispatch; returns ONE [B, L] float32
+    buffer (L = fleet_row_len) so the service's fetch is a single read.
+
+    ``cols`` is a pytree: stacking happens INSIDE the jit, so a tenant
+    whose arrays are already device-resident (the service's tenant pool)
+    uploads nothing — only numpy leaves cross the link, and the counted
+    dispatch seam attributes them.  The batch size B is part of the trace
+    signature (tuple length); the service pads B to a power-of-two bucket
+    by repeating a row, so XLA compiles once per (B bucket, shape bucket).
+    Feasibility must arrive as bool rows (pad_problem's layout) — the
+    bit-packed upload variants stay solo-path-only.
+    """
+    stacked = [jnp.stack(col) for col in cols]
+
+    def one(req, cnt, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, next0, sig0):
+        res = _pack_core(
+            req, cnt, maxper, slot, feas, alloc, price, openable,
+            used0, cfg0, npods0, next0, sig0,
+            k_slots=k_slots, objective=objective,
+        )
+        as_f32 = lambda a: jax.lax.bitcast_convert_type(
+            a.astype(jnp.int32), jnp.float32
+        ).reshape(-1)
+        return jnp.concatenate(
+            [
+                as_f32(res.take),
+                as_f32(res.leftover),
+                as_f32(res.node_cfg),
+                res.node_used.astype(jnp.float32).reshape(-1),
+            ]
+        )
+
+    return jax.vmap(one)(*stacked)
+
+
+def fleet_unbundle(
+    buf: np.ndarray, Gp: int, Kp: int, R: int
+) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Host-side inverse of one fleet_pack_kernel row, applied per row:
+    returns [(take, leftover, node_cfg, node_used)] * B.  Bitcast (view),
+    not cast, so int32 sections round-trip losslessly — the same contract
+    as unbundle_outputs."""
+    rows = np.ascontiguousarray(buf, dtype=np.float32)
+    out = []
+    for row in rows:
+        i32 = row.view(np.int32)
+        off = Gp * Kp
+        take = i32[:off].reshape(Gp, Kp).copy()
+        leftover = i32[off : off + Gp].copy()
+        off += Gp
+        node_cfg = i32[off : off + Kp].copy()
+        off += Kp
+        node_used = row[off : off + Kp * R].reshape(Kp, R).copy()
+        out.append((take, leftover, node_cfg, node_used))
+    return out
 
 
 def run_pack(

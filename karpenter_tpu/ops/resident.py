@@ -1067,3 +1067,134 @@ class ResidentCache:
             ):
                 return st
         return None
+
+
+# ---------------------------------------------------------------------------
+# Tenant-keyed resident pool (docs/designs/solver-service.md)
+#
+# ResidentCache above keeps TWO states warm for ONE operator.  The
+# multi-tenant SolverService generalizes the same discipline across a fleet:
+# each tenant's upload-heavy solve tensors stay device-resident between its
+# solves, keyed by CONTENT fingerprint (the wire arrays are fresh numpy
+# objects every RPC, so identity keys — the in-process caches' trick — can
+# never hit).  A global device-bytes budget bounds the accelerator footprint;
+# crossing it evicts whole tenants least-recently-used first, never a tenant
+# currently being served.
+# ---------------------------------------------------------------------------
+
+
+def _content_fp(arr: np.ndarray) -> tuple:
+    """Content fingerprint of a wire array: shape + dtype + payload hash.
+    sha1 over the raw bytes — collision-safe at cache-key strength, and
+    cheap next to the device upload it saves."""
+    import hashlib
+
+    arr = np.ascontiguousarray(arr)
+    return (
+        arr.shape,
+        arr.dtype.str,
+        hashlib.sha1(arr.tobytes()).digest(),
+    )
+
+
+class _TenantEntry:
+    """One tenant's resident arrays: name -> (fingerprint, device array,
+    nbytes).  The pinned numpy source is NOT kept — the fingerprint is
+    content-based, so a re-sent identical array hits without it."""
+
+    __slots__ = ("arrays", "nbytes")
+
+    def __init__(self):
+        self.arrays: Dict[str, tuple] = {}
+        self.nbytes = 0
+
+
+class TenantResidentPool:
+    """Device-resident per-tenant array cache with a global bytes budget.
+
+    ``get(tenant, name, arr)`` returns a device array for ``arr``: a
+    fingerprint hit reuses the resident buffer (zero transfer), a miss
+    uploads through the counted seam and replaces the tenant's entry for
+    ``name``.  ``budget_bytes <= 0`` disables caching entirely (every get
+    returns the host array untouched — the legacy single-tenant upload
+    path).  Eviction is tenant-granular LRU: python dicts iterate in
+    insertion order and hits re-insert, the same discipline as
+    cached_device_put.  NOT thread-safe — the service serializes access
+    under its own admission lock.
+    """
+
+    def __init__(self, budget_bytes: int, site: str = "tenant_resident"):
+        self.budget_bytes = int(budget_bytes)
+        self.site = site
+        self.tenants: Dict[str, _TenantEntry] = {}
+        # lifetime counters the service exports per tenant
+        self.hits = 0
+        self.misses = 0
+        self.evictions: List[str] = []  # evicted tenant names, in order
+
+    # ------------------------------------------------------------- access
+    def get(self, tenant: str, name: str, arr: np.ndarray):
+        if self.budget_bytes <= 0:
+            return arr
+        ent = self.tenants.get(tenant)
+        if ent is None:
+            ent = self.tenants[tenant] = _TenantEntry()
+        else:
+            # mark most-recently-used (insertion-order LRU)
+            del self.tenants[tenant]
+            self.tenants[tenant] = ent
+        fp = _content_fp(arr)
+        cached = ent.arrays.get(name)
+        if cached is not None and cached[0] == fp:
+            self.hits += 1
+            return cached[1]
+        self.misses += 1
+        dev = OBSERVATORY.put(self.site, np.ascontiguousarray(arr))
+        nbytes = int(dev.nbytes)
+        if cached is not None:
+            ent.nbytes -= cached[2]
+        ent.arrays[name] = (fp, dev, nbytes)
+        ent.nbytes += nbytes
+        self.evict_to_budget(active={tenant})
+        return dev
+
+    # ----------------------------------------------------------- eviction
+    def total_bytes(self) -> int:
+        return sum(e.nbytes for e in self.tenants.values())
+
+    def bytes_of(self, tenant: str) -> int:
+        ent = self.tenants.get(tenant)
+        return ent.nbytes if ent is not None else 0
+
+    def evict_to_budget(self, active=()) -> List[str]:
+        """Drop least-recently-used tenants until the pool fits the
+        budget; tenants in ``active`` (currently being served) are never
+        dropped, so a single oversized tenant can transiently exceed the
+        budget rather than thrash its own working set mid-solve.  Returns
+        the tenant names evicted by THIS call (also appended to
+        ``self.evictions`` for the service's counters)."""
+        dropped: List[str] = []
+        while self.total_bytes() > self.budget_bytes:
+            victim = next(
+                (t for t in self.tenants if t not in active), None
+            )
+            if victim is None:
+                break
+            del self.tenants[victim]
+            dropped.append(victim)
+        self.evictions.extend(dropped)
+        return dropped
+
+    def drop(self, tenant: str) -> None:
+        self.tenants.pop(tenant, None)
+
+    # ---------------------------------------------------------- reporting
+    def footprint(self) -> Dict[str, int]:
+        """Per-tenant resident bytes — the karpenter_service_resident_bytes
+        truth, and the consumer-labeled observatory report."""
+        return {t: e.nbytes for t, e in self.tenants.items()}
+
+    def report_footprint(self) -> None:
+        OBSERVATORY.set_resident_footprint(
+            self, {f"tenant:{t}": b for t, b in self.footprint().items()}
+        )

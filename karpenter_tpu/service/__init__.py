@@ -1,7 +1,19 @@
 """Solver sidecar: the distributed boundary between the controller half
-and the accelerator half (SURVEY.md §5 north-star)."""
+and the accelerator half (SURVEY.md §5 north-star).  With
+``multi_tenant`` on, the same process is the fleet-serving SolverService
+(docs/designs/solver-service.md)."""
 
-from karpenter_tpu.service.client import RemoteSolver, SolverUnavailableError
-from karpenter_tpu.service.server import SolverServer
+from karpenter_tpu.service.client import (
+    RemoteSolver,
+    SolverBusyError,
+    SolverUnavailableError,
+)
+from karpenter_tpu.service.server import SolverServer, SolverService
 
-__all__ = ["RemoteSolver", "SolverServer", "SolverUnavailableError"]
+__all__ = [
+    "RemoteSolver",
+    "SolverBusyError",
+    "SolverServer",
+    "SolverService",
+    "SolverUnavailableError",
+]

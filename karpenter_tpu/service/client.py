@@ -14,6 +14,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from karpenter_tpu.obs.context import current_trace_id
 from karpenter_tpu.ops.packer import pad_problem
 from karpenter_tpu.ops.tensorize import CompiledProblem
 from karpenter_tpu.service.codec import decode, encode, recv_frame, send_frame
@@ -33,6 +34,19 @@ class SolverUnavailableError(ConnectionError):
     pass
 
 
+class SolverBusyError(RuntimeError):
+    """The service refused the solve under backpressure (explicit
+    RETRY-AFTER, never silent queuing — docs/designs/solver-service.md).
+    The caller keeps last tick's plan and retries after `retry_after_s`."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(
+            f"solver busy ({reason}); retry after {retry_after_s:.3f}s"
+        )
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+
 class RemoteSolver:
     def __init__(
         self,
@@ -40,6 +54,7 @@ class RemoteSolver:
         port: int = 7421,
         connect_timeout: float = 10.0,
         request_timeout: float = 300.0,
+        tenant: str = "",
     ):
         # request_timeout must cover a cold solve: the sidecar's first pack
         # at a new bucket shape jit-compiles (~20-40s on a TPU backend)
@@ -47,6 +62,10 @@ class RemoteSolver:
         self.port = port
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
+        # identity on a shared (multi-tenant) SolverService: names this
+        # client's resident pool, admission quota and metrics slice;
+        # empty means the server's "default" tenant (legacy sidecar)
+        self.tenant = tenant
         self._sock: Optional[socket.socket] = None
         self._lock = make_lock("RemoteSolver._lock")
 
@@ -66,6 +85,13 @@ class RemoteSolver:
 
     def _call(self, meta: dict, arrays: dict) -> Tuple[dict, dict]:
         note_blocking("_rpc")  # runtime blocking witness (sanitizer.py)
+        if self.tenant:
+            meta = dict(meta, tenant=self.tenant)
+        # ship the caller's trace ID so the server's handling span lands
+        # on this tick's cross-process timeline (store client idiom)
+        trace_id = current_trace_id()
+        if trace_id:
+            meta = dict(meta, ctx={"trace_id": trace_id})
         with self._lock:  # one in-flight request per connection
             sock = self._connect()
             try:
@@ -74,6 +100,11 @@ class RemoteSolver:
             except (ConnectionError, OSError) as exc:
                 self.close()
                 raise SolverUnavailableError(str(exc)) from exc
+        if header.get("status") == "retry":
+            raise SolverBusyError(
+                str(header.get("reason", "busy")),
+                float(header.get("retry_after_s", 0.05)),
+            )
         if header.get("status") != "ok":
             raise RuntimeError(f"solver error: {header.get('error')}")
         return header, out

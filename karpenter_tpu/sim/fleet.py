@@ -60,6 +60,8 @@ from karpenter_tpu.service.codec import (
     recv_frame,
     send_frame,
 )
+from karpenter_tpu.service.client import RemoteSolver
+from karpenter_tpu.service.server import SolverServer
 from karpenter_tpu.service.shardrouter import ShardCoordinator
 from karpenter_tpu.service.store_server import StoreServer, VersionedStore
 from karpenter_tpu.sim.faults import FailingFsync, WireFaultInjector
@@ -104,6 +106,12 @@ FLEET_SCENARIOS: Dict[str, str] = {
         "failover storm PLUS shard kills (restart-from-disk, delta "
         "resync), a live 4->5 split under the migration fence, scripted "
         "wire faults, and an injected fsync failure"
+    ),
+    "solver-fleet": (
+        "many real Operators, each a TENANT of one multi-tenant "
+        "SolverService (docs/designs/solver-service.md), through seeded "
+        "churn and the failover storm — every solve a remote RPC with "
+        "per-tenant resident state, zero refusals, zero double-launches"
     ),
 }
 
@@ -195,6 +203,7 @@ class FleetRunner:
         reset_name_sequences()
 
         self.sharded = scenario == "store-fleet-shard-chaos"
+        self.solver_fleet = scenario == "solver-fleet"
         self._pace_stop = threading.Event()
         if self.sharded:
             # N durable shard primaries, each with its own on-disk replay
@@ -273,6 +282,24 @@ class FleetRunner:
             op.pipeline.enabled = False
             self.kubes[name] = kube
             self.ops[name] = op
+        # the solver-fleet scenario: ONE multi-tenant SolverService
+        # serves every operator's solves, each operator a tenant under
+        # its own name.  Reconciles are sequential per tick, so every
+        # RPC rides the solo fall-through — deterministic, and
+        # bit-identical to a local solve (the twin contract the
+        # service's batched path also holds).
+        self.solver: Optional[SolverServer] = None
+        self._solver_clients: List[RemoteSolver] = []
+        if self.solver_fleet:
+            self.solver = SolverServer(
+                port=0, multi_tenant=True
+            ).start_background()
+            for name in self.names:
+                remote = RemoteSolver(*self.solver.address, tenant=name)
+                self.ops[
+                    name
+                ].provisioner.scheduler.pack_fn = remote.pack_problem
+                self._solver_clients.append(remote)
         # a passive reader mirroring the READ REPLICA: proves the
         # replica serves snapshot+watch traffic with primary ordering.
         # In the sharded scenario a SECOND reader merges all the shards'
@@ -947,6 +974,27 @@ class FleetRunner:
                 "fsync_failures": sum(f.failures for f in self._fsyncs),
                 "merged_reader_synced": merged_reader_synced,
             }
+        solver_section = None
+        if self.solver_fleet and self.solver is not None:
+            payload = self.solver.tenants_payload()
+            tenants = payload["tenants"]
+            # only DETERMINISTIC facts enter the byte-compared report:
+            # per-tenant solve tallies (a pure function of the tape),
+            # never wall-clock timestamps or wait histograms
+            solver_section = {
+                "multi_tenant": payload["multi_tenant"],
+                "tenants": sorted(tenants),
+                "solves_by_tenant": {
+                    t: tenants[t]["solves"] for t in sorted(tenants)
+                },
+                "refused": sum(t["refused"] for t in tenants.values()),
+            }
+            if solver_section["refused"]:
+                self._violation(
+                    "solver service refused a tenant in a sequential fleet"
+                )
+            if not solver_section["tenants"]:
+                self._violation("no tenant ever solved remotely")
         report = {
             "scenario": self.scenario,
             "seed": self.seed,
@@ -977,10 +1025,16 @@ class FleetRunner:
         }
         if shards_section is not None:
             report["shards"] = shards_section
+        if solver_section is not None:
+            report["solver"] = solver_section
         return report
 
     def close(self) -> None:
         self._pace_stop.set()
+        for client in self._solver_clients:
+            client.close()
+        if self.solver is not None:
+            self.solver.stop()
         for kube in self.kubes.values():
             kube.close()
         self.reader.close()
@@ -1002,8 +1056,15 @@ def run_fleet(
     seed: int,
     ticks: int,
     trace: Optional[_FleetTrace] = None,
+    operators: int = 3,
 ) -> Tuple[FleetRunner, dict]:
-    runner = FleetRunner(scenario, seed, ticks, trace=trace or _FleetTrace())
+    runner = FleetRunner(
+        scenario,
+        seed,
+        ticks,
+        operators=operators,
+        trace=trace or _FleetTrace(),
+    )
     report = runner.run()
     return runner, report
 

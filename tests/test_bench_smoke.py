@@ -193,6 +193,27 @@ class TestBenchSmoke:
         assert 0 < line["delta_bytes"] < line["snapshot_bytes"]
         assert line["bytes_ratio"] < 0.10, line
 
+    def test_solver_service_line(self, bench_lines):
+        """The multi-tenant service line: a barrier-released 16-tenant
+        burst must actually coalesce into fleet dispatches
+        (batched_solves > 0) and the measured window must compile
+        nothing — the cold window enumerates the whole padded-bucket
+        ladder.  The 2x aggregation floor asserts inside bench.py at
+        full scale only (tiny problems can't amortize a dispatch)."""
+        line = next(
+            l
+            for l in bench_lines
+            if l["metric"] == "solver_service_16_tenants_agg"
+        )
+        assert line["path"] == "batched" and line["kernel"] == "fleet"
+        assert line["tenants"] == 16
+        assert line["batched_solves"] > 0, line
+        assert line["cold_ms"] > 0
+        assert line["sequential_ms"] > 0
+        assert line["solves_per_sec_service"] > 0
+        assert line["speedup_vs_sidecars"] > 0
+        assert line["compile_count_warm"] == 0, line
+
     def test_solve_lines_carry_device_counters(self, bench_lines):
         """Every solve-style line reports the device observatory's cold
         vs warm split: compile counts and transfer bytes for the first

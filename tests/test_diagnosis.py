@@ -659,6 +659,87 @@ class TestDoctorDeviceRules:
         assert "doctor:" in capsys.readouterr().err
 
 
+def _tenant_wait_dump(tmp_path, name: str, starved: bool):
+    """Forge a service flight dump: four tenants solving every tick.
+    When ``starved``, tenant 'd' waits ~25x the fleet median and eats
+    backpressure refusals; otherwise every tenant waits the same."""
+    clock = FakeClock()
+    reg = Registry()
+    led = EventLedger(clock=clock, registry=reg)
+    reg.ledger = led
+    fr = FlightRecorder(clock, reg, ledger=led, capacity=64)
+    for i in range(12):
+        clock.step(1.0)
+        set_tick(f"tick-{i + 1:06d}")
+        for tenant in ("a", "b", "c"):
+            reg.observe(
+                "karpenter_service_solve_wait_seconds",
+                0.002, {"tenant": tenant},
+            )
+        reg.observe(
+            "karpenter_service_solve_wait_seconds",
+            0.05 if starved else 0.002, {"tenant": "d"},
+        )
+        if starved and i % 3 == 0:
+            reg.inc(
+                "karpenter_service_refusals_total",
+                {"tenant": "d", "reason": "inflight-cap"},
+            )
+        fr.record(i + 1, f"tick-{i + 1:06d}", 0.01, {"pending": 0})
+    path = tmp_path / f"flight-{name}.jsonl"
+    fr.dump(str(path), trigger="manual")
+    return path
+
+
+class TestDoctorTenantRules:
+    def test_starved_tenant_is_named_with_refusals(self, tmp_path):
+        """Acceptance: one tenant's solve-wait running far past the
+        fleet median is a suspected cause, from the dump alone, with
+        its backpressure refusals cited."""
+        path = _tenant_wait_dump(tmp_path, "starved", starved=True)
+        diag = diagnose(load_flight(str(path)))
+        (cause,) = [
+            c for c in diag["suspected_causes"]
+            if "starving in the solver service" in c
+        ]
+        assert "tenant 'd'" in cause
+        assert "50.0ms" in cause and "2.0ms" in cause
+        assert "25.0x" in cause
+        assert "4 backpressure refusal(s)" in cause
+        text = render_diagnosis(diag)
+        assert "starving in the solver service" in text
+
+    def test_balanced_fleet_raises_no_starvation_cause(self, tmp_path):
+        path = _tenant_wait_dump(tmp_path, "balanced", starved=False)
+        diag = diagnose(load_flight(str(path)))
+        assert not any(
+            "starving" in c for c in diag["suspected_causes"]
+        ), diag["suspected_causes"]
+
+    def test_single_tenant_dump_never_compares_to_itself(self, tmp_path):
+        """A lone tenant has no fleet to starve against — even a slow
+        one must not self-flag."""
+        clock = FakeClock()
+        reg = Registry()
+        led = EventLedger(clock=clock, registry=reg)
+        reg.ledger = led
+        fr = FlightRecorder(clock, reg, ledger=led, capacity=64)
+        for i in range(8):
+            clock.step(1.0)
+            set_tick(f"tick-{i + 1:06d}")
+            reg.observe(
+                "karpenter_service_solve_wait_seconds",
+                0.5, {"tenant": "only"},
+            )
+            fr.record(i + 1, f"tick-{i + 1:06d}", 0.01, {"pending": 0})
+        path = tmp_path / "flight-lone.jsonl"
+        fr.dump(str(path), trigger="manual")
+        diag = diagnose(load_flight(str(path)))
+        assert not any(
+            "starving" in c for c in diag["suspected_causes"]
+        ), diag["suspected_causes"]
+
+
 # ------------------------------------------------------- operator wiring
 class TestOperatorDiagnosis:
     def test_breach_dumps_flight_to_flight_dir(self, tmp_path):

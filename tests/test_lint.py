@@ -310,6 +310,43 @@ TEETH = {
         expect=["S._lock", "constructed raw", "make_lock"],
         silence=frozenset({("forged/service/x.py", "S._lock")}),
     ),
+    "service-tenant-metrics": dict(
+        # four emissions: tenant-labeled + documented (clean),
+        # tenant-blind (fires), undocumented (fires), and one OUTSIDE
+        # service/ (out of scope even when blind — the rule fences the
+        # service plane, not the world)
+        files={
+            "service/x.py": (
+                "def f(reg, t, name):\n"
+                "    reg.inc('karpenter_service_good_total',"
+                " {'tenant': t})\n"
+                "    reg.inc('karpenter_service_blind_total',"
+                " {'method': 'pack'})\n"
+                "    reg.set('karpenter_service_dark_bytes', 1,"
+                " {'tenant': t})\n"
+                "    reg.inc(name)\n"  # dynamic: out of scope
+            ),
+            "obs/y.py": (
+                "def g(reg):\n"
+                "    reg.inc('karpenter_service_elsewhere_total')\n"
+            ),
+        },
+        docs={
+            "docs/metrics.md": (
+                "`karpenter_service_good_total`\n"
+                "`karpenter_service_blind_total`\n"
+            )
+        },
+        expect=[
+            "karpenter_service_blind_total",
+            "without a 'tenant' label",
+            "karpenter_service_dark_bytes",
+            "absent from docs/metrics.md",
+        ],
+        silence=frozenset(
+            {"forged/service/x.py", "karpenter_service_dark_bytes"}
+        ),
+    ),
     "tracer-safety": dict(
         # the forged unseamed jit dispatch + an impure traced body
         files={
@@ -541,6 +578,46 @@ def test_settings_flow_scoped_to_the_settings_block(tmp_path):
     )
     assert len(live) == 1, "\n".join(f.render() for f in live)
     assert "missing from deploy/chart/values.yaml" in live[0].message
+
+
+def test_settings_flow_nested_values_route(tmp_path):
+    """The structured-values exposure route (the service.multiTenant.*
+    shape): a field absent from the settings: block is CLEAN when its
+    configmap line references .Values paths that resolve in values.yaml,
+    and fires when a referenced path does not resolve — the nested
+    spelling of the same dead-knob guarantee."""
+    files = {
+        "api/settings.py": (
+            "class Settings:\n"
+            "    nested_knob: bool = False\n"
+            "    broken_knob: int = 0\n"
+        ),
+        "operator.py": (
+            "def run(s):\n"
+            "    return (s.nested_knob, s.broken_knob)\n"
+        ),
+    }
+    docs = {
+        "deploy/chart/values.yaml": (
+            "service:\n"
+            "  multiTenant:\n"
+            "    enabled: \"false\"  # comment\n"
+            "settings:\n"
+            "  cluster_name: \"\"\n"
+        ),
+        "deploy/chart/templates/configmap.yaml": (
+            '{ "nested_knob": {{ .Values.service.multiTenant.enabled }},\n'
+            '  "broken_knob": {{ .Values.service.multiTenant.gone }} }\n'
+        ),
+    }
+    forged = forge(tmp_path, files, docs)
+    live, _ = run_rules(
+        forged, rule_names=["settings-flow"],
+        allowlists={"settings-flow": frozenset()},
+    )
+    text = "\n".join(f.render() for f in live)
+    assert "nested_knob" not in text, text
+    assert "broken_knob" in text and "values.yaml" in text, text
 
 
 def test_stale_baseline_entry_is_a_finding(tmp_path):

@@ -1,0 +1,399 @@
+"""Multi-tenant SolverService proofs (docs/designs/solver-service.md):
+
+- **twin**: a tenant solving through the shared service — batched path
+  FORCED by concurrent peers — gets bit-identical placements to the
+  same problems through a dedicated single-tenant sidecar (mismatch
+  count 0, batched count nonzero);
+- **fairness**: a tenant flooding at 10x gets a bounded share of every
+  weighted-round-robin drain; a refused tenant's backpressure carries a
+  machine-readable retry-after hint; cold tenants' resident tensors are
+  evicted before the device budget is exceeded;
+- **lifecycle**: ``stop()`` severs established handler connections (the
+  zombie-handler regression the store server fixed), and solve RPCs
+  adopt the CLIENT's trace ID so cross-process tick timelines stitch;
+- **fleet**: the ``solver-fleet`` sim scenario — a dozen real Operators,
+  each a tenant of ONE service — holds the chaos-suite invariants with
+  zero refusals, and (slow) run/run + run/replay byte-identity.
+"""
+
+import logging
+import threading
+from collections import deque
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import Pod, Resources
+from karpenter_tpu.batcher.core import WeightedRoundRobin
+from karpenter_tpu.obs.context import trace_context
+from karpenter_tpu.ops.packer import run_pack
+from karpenter_tpu.ops.tensorize import compile_problem
+from karpenter_tpu.service import (
+    RemoteSolver,
+    SolverBusyError,
+    SolverServer,
+    SolverUnavailableError,
+)
+from karpenter_tpu.service.server import _TenantStats
+from karpenter_tpu.testing import Environment
+
+
+def _problems(counts_or_cpus, n_pods=None):
+    """Compile one problem per entry: same pool/types (same shapes, so
+    the service groups them into ONE batch bucket) with per-entry pod
+    CPU when ``n_pods`` is set, else per-entry pod counts."""
+    env = Environment()
+    pool = env.default_node_pool()
+    env.default_node_class()
+    types = env.instance_types.list(pool, env.kube.get_node_class("default"))
+    out = {}
+    for x in counts_or_cpus:
+        if n_pods is None:
+            pods = [
+                Pod(requests=Resources(cpu=1, memory="1Gi"))
+                for _ in range(x)
+            ]
+        else:
+            pods = [
+                Pod(requests=Resources(cpu=x, memory="1Gi"))
+                for _ in range(n_pods)
+            ]
+        out[x] = compile_problem(pods, [pool], {pool.name: types})
+    return out
+
+
+# ------------------------------------------------------------- fairness
+class TestWeightedRoundRobin:
+    def test_flooding_tenant_gets_bounded_share(self):
+        """A tenant queueing 10x the work of its peers gets at most its
+        fair share of every drain — the weighted-RR invariant admission
+        leans on."""
+        wrr = WeightedRoundRobin()
+        queues = {
+            "flood": deque(f"f{i}" for i in range(100)),
+            "a": deque(f"a{i}" for i in range(10)),
+            "b": deque(f"b{i}" for i in range(10)),
+        }
+        picked = wrr.drain(queues, 15)
+        share = [name for name, _ in picked].count("flood")
+        assert share == 5, picked  # exactly limit/3: no flood advantage
+        # and over repeated drains the peers fully drain while the
+        # flooder is still being paced
+        while queues["a"] or queues["b"]:
+            wrr.drain(queues, 15)
+        assert queues["flood"]
+
+    def test_weights_shift_the_share(self):
+        wrr = WeightedRoundRobin()
+        queues = {
+            "gold": deque(range(100)),
+            "bronze": deque(range(100)),
+        }
+        picked = wrr.drain(queues, 12, weights={"gold": 3.0, "bronze": 1.0})
+        gold = [name for name, _ in picked].count("gold")
+        assert gold == 9  # 3:1 split of 12
+
+    def test_drain_order_is_deterministic(self):
+        def run():
+            wrr = WeightedRoundRobin()
+            queues = {
+                "c": deque(range(5)), "a": deque(range(5)),
+                "b": deque(range(5)),
+            }
+            return [name for name, _ in wrr.drain(queues, 15)]
+
+        assert run() == run()
+
+
+class TestBackpressure:
+    def test_refusal_carries_retry_after_hint(self):
+        """A tenant at its in-flight cap is refused EXPLICITLY — the
+        client raises SolverBusyError with the server's retry-after
+        hint, never a silent queue slot."""
+        srv = SolverServer(port=0, multi_tenant=True, inflight_cap=2)
+        srv.start_background()
+        try:
+            # deterministically saturate the tenant: in-flight at cap
+            with srv._cv:
+                ts = srv._tenants["full"] = _TenantStats("full")
+                ts.inflight = srv.inflight_cap
+            prob = _problems([3])[3]
+            c = RemoteSolver(*srv.address, tenant="full")
+            try:
+                with pytest.raises(SolverBusyError) as exc:
+                    c.pack_problem(prob)
+            finally:
+                c.close()
+            assert exc.value.reason == "inflight-cap"
+            assert exc.value.retry_after_s > 0
+            assert srv.registry.counter(
+                "karpenter_service_refusals_total",
+                {"tenant": "full", "reason": "inflight-cap"},
+            ) == 1
+            # the refusal is a ledger fact on the tenant's slice
+            refused = [
+                ev for ev in srv.ledger.recent()
+                if ev.type == "TenantRefused"
+            ]
+            assert refused and refused[0].attrs["tenant"] == "full"
+            assert float(refused[0].attrs["retry_after_s"]) > 0
+            # an unsaturated tenant still solves
+            c2 = RemoteSolver(*srv.address, tenant="fine")
+            try:
+                out = c2.pack_problem(prob)
+            finally:
+                c2.close()
+            np.testing.assert_array_equal(
+                out.take, np.asarray(run_pack(prob).take)
+            )
+        finally:
+            srv.stop()
+
+    def test_cold_tenant_evicted_before_budget_exceeded(self):
+        """With a budget sized for ~2 tenants, a third tenant's upload
+        drops the COLDEST tenant's resident tensors — the pool never
+        ends a solve over budget, and the eviction is counted + led."""
+        srv = SolverServer(
+            port=0, multi_tenant=True, resident_budget_mb=0.2
+        )
+        srv.start_background()
+        try:
+            prob = _problems([3])[3]
+            expected = np.asarray(run_pack(prob).take)
+            for tenant in ("t-0", "t-1", "t-2", "t-3"):
+                c = RemoteSolver(*srv.address, tenant=tenant)
+                try:
+                    out = c.pack_problem(prob)
+                finally:
+                    c.close()
+                # eviction never corrupts a solve
+                np.testing.assert_array_equal(out.take, expected)
+                with srv._pool_lock:
+                    assert (
+                        srv._pool.total_bytes() <= srv._pool.budget_bytes
+                    )
+            evicted = [
+                ev.attrs["tenant"]
+                for ev in srv.ledger.recent()
+                if ev.type == "TenantEvicted"
+            ]
+            assert "t-0" in evicted  # the coldest tenant went first
+            assert srv.registry.counter(
+                "karpenter_service_resident_evictions_total",
+                {"tenant": "t-0"},
+            ) >= 1
+            # the survivors' footprint is still reported per tenant
+            payload = srv.tenants_payload()
+            assert payload["resident_budget_bytes"] > 0
+            resident = {
+                t: d.get("resident_bytes", 0)
+                for t, d in payload["tenants"].items()
+            }
+            assert resident.get("t-3", 0) > 0
+            assert resident.get("t-0", 0) == 0
+        finally:
+            srv.stop()
+
+
+# ----------------------------------------------------------------- twin
+class TestTwin:
+    def test_batched_tenant_bit_identical_to_dedicated_sidecar(self):
+        """THE tentpole proof: tenants solving through the shared
+        service — including through the BATCHED vmap path — get
+        placements bit-identical to a dedicated single-tenant sidecar.
+        Mismatch count must be 0 and the batched path must actually
+        have run (a twin proof that only exercised the solo
+        fall-through proves nothing about batching)."""
+        tenants = ["acme", "globex", "initech", "umbrella"]
+        probs = _problems([0.5, 1, 2, 4], n_pods=24)
+        by_tenant = dict(zip(tenants, probs.values()))
+
+        sidecar = SolverServer(port=0)  # the dedicated twin, legacy mode
+        sidecar.start_background()
+        expected = {}
+        try:
+            for tenant, prob in by_tenant.items():
+                c = RemoteSolver(*sidecar.address)
+                try:
+                    expected[tenant] = c.pack_problem(prob)
+                finally:
+                    c.close()
+        finally:
+            sidecar.stop()
+
+        srv = SolverServer(port=0, multi_tenant=True)
+        srv.start_background()
+        mismatches = 0
+        errors = []
+        try:
+            for _round in range(10):
+                barrier = threading.Barrier(len(tenants))
+                results = {}
+
+                def worker(tenant):
+                    try:
+                        c = RemoteSolver(*srv.address, tenant=tenant)
+                        try:
+                            barrier.wait(timeout=30)
+                            results[tenant] = c.pack_problem(
+                                by_tenant[tenant]
+                            )
+                        finally:
+                            c.close()
+                    except Exception as exc:  # pragma: no cover
+                        errors.append((tenant, exc))
+
+                threads = [
+                    threading.Thread(target=worker, args=(t,))
+                    for t in tenants
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                assert not errors, errors
+                for tenant in tenants:
+                    twin = expected[tenant]
+                    got = results[tenant]
+                    for field in (
+                        "take", "leftover", "node_cfg", "node_pods",
+                        "node_used",
+                    ):
+                        if not np.array_equal(
+                            getattr(got, field), getattr(twin, field)
+                        ):
+                            mismatches += 1
+                batched = sum(
+                    t["batched"]
+                    for t in srv.tenants_payload()["tenants"].values()
+                )
+                if batched > 0 and _round >= 2:
+                    break
+            assert mismatches == 0
+            assert batched > 0, "batched path never ran — no twin proof"
+            # the fused dispatches are ledger facts naming their tenants
+            fleet_batches = [
+                ev for ev in srv.ledger.recent()
+                if ev.type == "TenantBatch"
+            ]
+            assert fleet_batches
+            assert int(fleet_batches[0].attrs["size"]) >= 2
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------------------ lifecycle
+class TestLifecycle:
+    def test_stop_severs_established_connections(self):
+        """Regression: ``stop()`` must kill live handler connections,
+        not just the accept loop — a pre-stop client with an
+        established socket must not keep getting answers from a
+        zombie handler thread."""
+        srv = SolverServer(port=0).start_background()
+        c = RemoteSolver(*srv.address)
+        try:
+            assert c.ping()  # establish the connection
+            srv.stop()
+            with pytest.raises(SolverUnavailableError):
+                c.ping()
+        finally:
+            c.close()
+
+    def test_solve_rpc_adopts_client_trace_id(self):
+        """The server's handling span records under the CALLER's tick
+        trace ID — cross-process timelines stitch on one ID."""
+        srv = SolverServer(port=0, multi_tenant=True).start_background()
+        try:
+            prob = _problems([3])[3]
+            c = RemoteSolver(*srv.address, tenant="traced")
+            try:
+                with trace_context("tick-004242"):
+                    c.pack_problem(prob)
+            finally:
+                c.close()
+            spans = [
+                s for s in srv.tracer.recent(500)
+                if s.path.startswith("solver.pack")
+            ]
+            assert spans
+            assert spans[-1].trace_id == "tick-004242"
+            assert spans[-1].meta.get("tenant") == "traced"
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------- fleet
+TICKS = 24
+OPERATORS = 12
+
+
+@pytest.fixture(scope="module")
+def solver_fleet_run():
+    from karpenter_tpu.sim.fleet import run_fleet
+
+    logging.disable(logging.WARNING)
+    try:
+        runner, report = run_fleet(
+            "solver-fleet", 0, TICKS, operators=OPERATORS
+        )
+    finally:
+        logging.disable(logging.NOTSET)
+    return runner, report
+
+
+class TestSolverFleet:
+    def test_scenario_registered(self):
+        from karpenter_tpu.sim.fleet import FLEET_SCENARIOS
+
+        assert "solver-fleet" in FLEET_SCENARIOS
+
+    def test_fleet_shares_one_service_cleanly(self, solver_fleet_run):
+        """A dozen real Operators, each a tenant of ONE SolverService:
+        chaos-suite invariants hold (zero double-launches, clean
+        violations) and the service refused nobody."""
+        _runner, report = solver_fleet_run
+        assert report["operators"] == OPERATORS
+        assert report["double_launches"] == 0
+        assert report["invariants"]["violations"] == []
+        assert report["launches"] > 0
+        solver = report["solver"]
+        assert solver["multi_tenant"] is True
+        assert solver["refused"] == 0
+        # leaders rotated through the storm, so MULTIPLE operator
+        # tenants solved against the one mesh
+        assert len(solver["tenants"]) >= 2
+        assert sum(solver["solves_by_tenant"].values()) > 0
+
+    @pytest.mark.slow
+    def test_run_run_byte_identical(self, solver_fleet_run):
+        from karpenter_tpu.sim.fleet import run_fleet
+
+        runner, report = solver_fleet_run
+        logging.disable(logging.WARNING)
+        try:
+            runner2, report2 = run_fleet(
+                "solver-fleet", 0, TICKS, operators=OPERATORS
+            )
+        finally:
+            logging.disable(logging.NOTSET)
+        assert report2 == report
+        assert runner2.trace.text() == runner.trace.text()
+
+    @pytest.mark.slow
+    def test_replay_byte_identical(self, solver_fleet_run, tmp_path):
+        from karpenter_tpu.sim.fleet import read_fleet_tape, replay_fleet
+
+        runner, report = solver_fleet_run
+        path = tmp_path / "solver-fleet.jsonl"
+        path.write_text(runner.trace.text())
+        logging.disable(logging.WARNING)
+        try:
+            runner3, report3, recorded = replay_fleet(str(path))
+        finally:
+            logging.disable(logging.NOTSET)
+        assert recorded == report
+        assert report3 == report
+        assert runner3.trace.text() == runner.trace.text()
+        meta = read_fleet_tape(str(path))[0]
+        assert meta["scenario"] == "solver-fleet"
+        assert meta["operators"] == OPERATORS
